@@ -1,0 +1,26 @@
+"""dgraph_tpu — a TPU-native distributed graph query engine.
+
+A brand-new framework with the capabilities of Dgraph v1.0.4 (the reference at
+/root/reference): a distributed, transactional graph database with a GraphQL-like
+query language (DQL / "GraphQL+-"), predicate-sharded storage, secondary indexes,
+reverse edges, traversal algorithms (@recurse, shortest path), @groupby and
+aggregations, and snapshot-isolation transactions — re-designed TPU-first:
+
+- Posting lists live as HBM-resident per-predicate CSR graphs
+  (descendant of the reference's bp128 blocks, bp128/bp128.go).
+- Sorted-uid set algebra (reference: algo/uidlist.go) is vectorized jnp/Pallas.
+- Multi-hop traversal is iterative SpMSpV under jit (reference: query/recurse.go,
+  query/shortest.go ran host-side Dijkstra over hash maps).
+- Cross-shard fan-out (reference: worker/task.go ProcessTaskOverNetwork over gRPC)
+  is shard_map + ICI collectives over a jax.sharding.Mesh.
+
+Layout:
+  ops/       device kernels: uid-set algebra, CSR expand, segmented reductions, Pallas
+  storage/   host-side storage: key scheme, packed posting codec, posting store, CSR build
+  query/     DQL parser, SubGraph plan, ProcessGraph engine, traversals, JSON encoding
+  parallel/  mesh construction, sharded CSR, frontier collectives
+  models/    graph generators & datasets for tests/benchmarks (RMAT, film graph, LDBC-ish)
+  utils/     value types, conversion matrix, tokenizers, watermark, config
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+"""Device-side kernel substrate.
+
+uidset:   sorted-uid set algebra (reference: algo/uidlist.go)
+csr:      CSR frontier expansion / SpMSpV gather (reference: posting list iteration,
+          worker/task.go handleUidPostings)
+segments: segmented reductions for @groupby / aggregation
+          (reference: query/groupby.go, query/aggregator.go)
+"""
+
+from dgraph_tpu.ops.uidset import (  # noqa: F401
+    SENTINEL32,
+    SENTINEL64,
+    sentinel,
+    make_set,
+    to_numpy,
+    size,
+    compact,
+    intersect,
+    merge,
+    difference,
+    is_member,
+    apply_filter,
+    index_of,
+    intersect_many,
+    merge_many,
+    paginate,
+)
+from dgraph_tpu.ops.csr import (  # noqa: F401
+    expand,
+    expand_dest,
+    degrees,
+)

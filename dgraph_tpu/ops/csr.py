@@ -1,0 +1,100 @@
+"""CSR frontier expansion — the SpMSpV gather at the heart of traversal.
+
+Reference semantics: worker/task.go handleUidPostings (:476-602) iterates, per uid in
+the query frontier, the posting list of (predicate, uid) and emits one sorted uid list
+per source uid (the "uidMatrix", intern.proto Result.uid_matrix). On TPU the posting
+lists of one predicate live as a CSR adjacency (see storage/csr_build.py) and the whole
+frontier is expanded in one gather:
+
+    counts  = indptr[row+1] - indptr[row]          (per-frontier-slot degree)
+    offsets = cumsum(counts)
+    out[j]  = indices[ starts[seg(j)] + j - offsets[seg(j)-1] ]
+
+where seg(j) = searchsorted(offsets, j) assigns each output slot to its source uid.
+The result is the uidMatrix in CSR form: a flat target array plus per-source counts.
+Output capacity is static; `total` reports the true edge count so the host can detect
+overflow and re-issue with a larger capacity class (the analog of the reference's
+1e6-edge query budget, x/init.go:53 QueryEdgeLimit).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops.uidset import sentinel, _dedup_sorted
+
+
+class ExpandResult(NamedTuple):
+    """uidMatrix in CSR form.
+
+    targets: [out_cap] flat neighbor uids, grouped by source slot, sorted within
+             each group, sentinel-padded at the tail.
+    seg:     [out_cap] frontier slot index of each target (-1 in padding).
+    counts:  [frontier_cap] per-slot degree.
+    total:   scalar true edge count (may exceed out_cap → truncated; host should
+             re-issue with a larger capacity class).
+    """
+
+    targets: jax.Array
+    seg: jax.Array
+    counts: jax.Array
+    total: jax.Array
+
+
+def degrees(indptr: jax.Array, rows: jax.Array) -> jax.Array:
+    """Per-slot out-degree for sentinel-padded row ids.
+
+    Reference: posting/list.go Length(readTs, afterUid) — degree is the `count`
+    index feature's base quantity (posting/index.go count mutations).
+    """
+    snt = sentinel(rows.dtype)
+    valid = rows != snt
+    r = jnp.where(valid, rows, 0).astype(jnp.int32)
+    return jnp.where(valid, jnp.take(indptr, r + 1) - jnp.take(indptr, r), 0)
+
+
+def expand(indptr: jax.Array, indices: jax.Array, rows: jax.Array, out_cap: int) -> ExpandResult:
+    """Expand a frontier of CSR row ids into the concatenated neighbor lists.
+
+    rows: sentinel-padded int32 row indices (NOT raw uids — map uids to rows with
+    storage-side subjects lookup). out_cap: static output capacity.
+    """
+    snt = sentinel(rows.dtype)
+    valid = rows != snt
+    r = jnp.where(valid, rows, 0).astype(jnp.int32)
+    starts = jnp.take(indptr, r)
+    counts = jnp.where(valid, jnp.take(indptr, r + 1) - starts, 0)
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1] if counts.shape[0] > 0 else jnp.int32(0)
+
+    pos = jnp.arange(out_cap, dtype=offsets.dtype)
+    seg = jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32)
+    seg_c = jnp.clip(seg, 0, rows.shape[0] - 1)
+    prev = jnp.where(seg_c > 0, jnp.take(offsets, jnp.maximum(seg_c - 1, 0)), 0)
+    src = jnp.take(starts, seg_c) + (pos - prev)
+    ok = pos < total
+    tgt_dtype = indices.dtype
+    out = jnp.where(
+        ok,
+        jnp.take(indices, jnp.clip(src, 0, max(indices.shape[0] - 1, 0)).astype(jnp.int32)),
+        sentinel(tgt_dtype),
+    )
+    seg_out = jnp.where(ok, seg_c, -1)
+    return ExpandResult(out, seg_out, counts, total)
+
+
+def expand_dest(
+    indptr: jax.Array, indices: jax.Array, rows: jax.Array, out_cap: int
+) -> tuple[jax.Array, jax.Array]:
+    """Frontier expand returning (deduped sorted union of neighbors, true total).
+
+    Reference: query/query.go:1928 DestUIDs = MergeSorted(uidMatrix) after a
+    non-intersecting expand — the per-level BFS step of ProcessGraph. `total`
+    must be checked against out_cap by the host: if total > out_cap the union
+    is incomplete and the step should be re-issued at a larger capacity class.
+    """
+    res = expand(indptr, indices, rows, out_cap)
+    return _dedup_sorted(jnp.sort(res.targets)), res.total

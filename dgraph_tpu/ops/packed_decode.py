@@ -1,0 +1,87 @@
+"""On-device decode of block-packed uid lists (HBM-resident packed postings).
+
+Counterpart of the reference's bp128 unpack kernels (bp128/unpack_amd64.s,
+77k lines of generated SSE2 — one unrolled kernel per bit width). On TPU a
+single branch-free jnp program covers every width: the packed delta of lane i
+in block b sits at bit position i*w(b) in the block's word stream, so
+
+    v = (words[k] >> s) | (words[k+1] << (32-s))   (two-word funnel shift)
+    uid[b, i] = first[b] + cumsum_i(v & mask(w))
+
+Shifts by data-dependent vector amounts and rowwise cumsum are native VPU ops.
+The decoded layout is a [nb*128] sentinel-padded sorted uid-set — directly
+consumable by ops.uidset algebra with no host round-trip.
+
+Device lists use int32 uids (max uid < 2**31), so every delta fits in 31 bits
+and the raw64 escape never appears on device; storage/packed.py retains full
+uint64 fidelity on the host.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.storage import packed as hostpacked
+from dgraph_tpu.ops.uidset import sentinel
+
+BLOCK = hostpacked.BLOCK
+
+
+class DevicePackedList(NamedTuple):
+    """Struct-of-arrays packed list, uploaded once and decoded in HBM."""
+
+    block_first: jax.Array  # int32[nb]
+    block_count: jax.Array  # int32[nb]
+    block_width: jax.Array  # int32[nb]
+    block_off: jax.Array    # int32[nb]
+    words: jax.Array        # uint32[W+1] (one pad word for funnel reads)
+
+    @property
+    def capacity(self) -> int:
+        return self.block_first.shape[0] * BLOCK
+
+
+def to_device(pl: hostpacked.PackedUidList) -> DevicePackedList:
+    if (pl.block_width == 64).any():
+        raise ValueError("raw64 blocks imply uids >= 2**32; device lists are int32")
+    if pl.count and int(pl.block_last[-1]) >= 2**31 - 1:
+        raise ValueError("device uid space is int32; max uid must be < 2**31 - 1 "
+                         "(2**31 - 1 is the padding sentinel)")
+    return DevicePackedList(
+        jnp.asarray(pl.block_first.astype(np.int32)),
+        jnp.asarray(pl.block_count),
+        jnp.asarray(pl.block_width),
+        jnp.asarray(pl.block_off.astype(np.int32)),
+        jnp.asarray(np.concatenate([pl.words, np.zeros(1, dtype=np.uint32)])),
+    )
+
+
+def unpack_device(pl: DevicePackedList) -> jax.Array:
+    """Decode to a sentinel-padded sorted uid-set of shape [nb*BLOCK], int32."""
+    nb = pl.block_first.shape[0]
+    if nb == 0:
+        return jnp.zeros((0,), dtype=jnp.int32)
+    lane = jnp.arange(BLOCK, dtype=jnp.int32)[None, :]
+    w = pl.block_width[:, None]
+    bitpos = lane * w
+    widx = pl.block_off[:, None] + (bitpos >> 5)
+    shift = (bitpos & 31).astype(jnp.uint32)
+    w0 = jnp.take(pl.words, widx)
+    w1 = jnp.take(pl.words, widx + 1)
+    # funnel shift; (w1 << (32-s)) is undefined at s==0, where w0 alone is exact
+    hi = jnp.where(shift == 0, jnp.uint32(0), w1 << (jnp.uint32(32) - shift))
+    v = (w0 >> shift) | hi
+    mask = jnp.where(
+        w >= 32,
+        jnp.uint32(0xFFFFFFFF),
+        (jnp.uint32(1) << jnp.clip(w, 0, 31).astype(jnp.uint32)) - jnp.uint32(1),
+    )
+    deltas = (v & mask).astype(jnp.int32)
+    deltas = deltas.at[:, 0].set(0)
+    uids = pl.block_first[:, None] + jnp.cumsum(deltas, axis=1)
+    valid = lane < pl.block_count[:, None]
+    return jnp.where(valid, uids, sentinel(jnp.int32)).reshape(-1)

@@ -1,0 +1,155 @@
+"""Block-packed sorted-uid codec — the TPU descendant of SIMD-BP128.
+
+Reference semantics: bp128/ — delta compression of sorted uint64 uid lists in
+256-int blocks with per-block metadata {2 seed uint64s, byte offset}
+(bp128/bp128.go:23,137-144), block-skipping seek for galloping intersection
+(BPackIterator.Init/AfterUid, :219-340), generated SSE2 kernels for each bit
+width (bp128/peachpy/*.py).
+
+TPU redesign — NOT a translation:
+- Block size is 128 (the VPU lane width) so one block decodes as one vector op.
+- Per-block metadata is a struct-of-arrays (first uid, last uid, count, bit
+  width, word offset) instead of interleaved bytes: on device these become
+  gatherable int arrays; `last` gives block-skip seek (the AfterUid analog) as
+  a vectorized binary search instead of a pointer walk.
+- Deltas are packed little-endian into a flat uint32 word stream, each block
+  word-aligned. Decode is branch-free for every width w<=32:
+      pair = words[k] | words[k+1] << 32 ;  v = (pair >> s) & mask
+  followed by an intra-block cumsum — shifts-by-vector + cumsum are native VPU
+  ops, so ONE kernel handles all widths (the reference generates 33 unrolled
+  asm kernels per direction; XLA's vectorizer makes that unnecessary).
+- Blocks whose deltas need >32 bits use a word-aligned raw64 escape
+  (width=64, two words per value).
+
+The host codec here is vectorized numpy; `native/` provides the same format in
+C++ for ingest (see storage/native.py); `ops/packed_decode.py` decodes on
+device so packed lists can live in HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+BLOCK = 128
+_MASK32 = np.uint64(0xFFFFFFFF)
+
+
+@dataclass
+class PackedUidList:
+    """Immutable packed sorted uid list (struct-of-arrays block metadata)."""
+
+    count: int                 # total uids
+    block_first: np.ndarray    # uint64[nb] first uid of block
+    block_last: np.ndarray     # uint64[nb] last uid of block (seek metadata)
+    block_count: np.ndarray    # int32[nb]  uids in block (<= BLOCK; only last partial)
+    block_width: np.ndarray    # int32[nb]  bits per delta (0..32, or 64 = raw escape)
+    block_off: np.ndarray      # int64[nb]  word offset of block's packed deltas
+    words: np.ndarray          # uint32[W]  packed delta stream
+
+    @property
+    def nblocks(self) -> int:
+        return len(self.block_first)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.words.nbytes + self.block_first.nbytes + self.block_last.nbytes
+                   + self.block_count.nbytes + self.block_width.nbytes + self.block_off.nbytes)
+
+
+def _width_for(maxdelta: np.ndarray) -> np.ndarray:
+    """Bits needed per block; 64 = raw escape for deltas >= 2**32."""
+    w = np.zeros(maxdelta.shape, dtype=np.int32)
+    nz = maxdelta > 0
+    w[nz] = np.floor(np.log2(maxdelta[nz].astype(np.float64))).astype(np.int32) + 1
+    # float64 log2 is exact enough below 2**48; verify and bump any edge cases
+    bad = (maxdelta >> np.minimum(w, 63).astype(np.uint64)) > 0
+    w[bad] += 1
+    w[w > 32] = 64
+    return w
+
+
+def pack(uids) -> PackedUidList:
+    """Pack a sorted, duplicate-free uid array."""
+    uids = np.asarray(uids, dtype=np.uint64)
+    n = len(uids)
+    if n == 0:
+        z64 = np.zeros(0, dtype=np.uint64)
+        z32 = np.zeros(0, dtype=np.int32)
+        return PackedUidList(0, z64, z64.copy(), z32, z32.copy(),
+                             np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.uint32))
+    nb = -(-n // BLOCK)
+    padded = np.empty(nb * BLOCK, dtype=np.uint64)
+    padded[:n] = uids
+    padded[n:] = uids[-1]  # zero deltas in the tail of the last block
+    blocks = padded.reshape(nb, BLOCK)
+
+    deltas = np.zeros_like(blocks)
+    deltas[:, 1:] = blocks[:, 1:] - blocks[:, :-1]
+    block_first = blocks[:, 0].copy()
+    counts = np.full(nb, BLOCK, dtype=np.int32)
+    counts[-1] = n - (nb - 1) * BLOCK
+    block_last = padded.reshape(nb, BLOCK)[np.arange(nb), counts - 1].copy()
+    widths = _width_for(deltas.max(axis=1))
+
+    words_per_block = np.where(widths == 64, 2 * BLOCK, -(-(BLOCK * widths) // 32)).astype(np.int64)
+    offs = np.zeros(nb, dtype=np.int64)
+    offs[1:] = np.cumsum(words_per_block)[:-1]
+    total_words = int(words_per_block.sum())
+    words = np.zeros(total_words + 1, dtype=np.uint32)  # +1 pad word for pair reads
+
+    # raw64 escape blocks: word-aligned lo/hi pairs
+    raw = widths == 64
+    if raw.any():
+        for b in np.nonzero(raw)[0]:
+            d = deltas[b]
+            o = offs[b]
+            words[o : o + 2 * BLOCK : 2] = (d & _MASK32).astype(np.uint32)
+            words[o + 1 : o + 1 + 2 * BLOCK : 2] = (d >> np.uint64(32)).astype(np.uint32)
+
+    # bitpacked blocks, fully vectorized across all blocks at once
+    bp = np.nonzero(~raw & (widths > 0))[0]
+    if len(bp) > 0:
+        w = widths[bp][:, None].astype(np.int64)                     # [B,1]
+        bitpos = np.arange(BLOCK, dtype=np.int64)[None, :] * w       # [B,128]
+        widx = offs[bp][:, None] + (bitpos >> 5)
+        shift = (bitpos & 31).astype(np.uint64)
+        v = deltas[bp]
+        lo = ((v << shift) & _MASK32).astype(np.uint32)
+        hi = (v >> (np.uint64(32) - shift)).astype(np.uint32)        # shift==0 → v>>32
+        np.bitwise_or.at(words, widx.ravel(), lo.ravel())
+        np.bitwise_or.at(words, (widx + 1).ravel(), hi.ravel())
+
+    return PackedUidList(n, block_first, block_last, counts, widths, offs, words[:-1])
+
+
+def unpack(pl: PackedUidList) -> np.ndarray:
+    """Decode every uid (numpy mirror of the device kernel in ops/packed_decode.py)."""
+    nb = pl.nblocks
+    if nb == 0:
+        return np.zeros(0, dtype=np.uint64)
+    words = np.concatenate([pl.words, np.zeros(2, dtype=np.uint32)])
+    w = pl.block_width[:, None].astype(np.int64)
+    raw = pl.block_width == 64
+    bitpos = np.arange(BLOCK, dtype=np.int64)[None, :] * np.where(w == 64, 0, w)
+    widx = pl.block_off[:, None] + (bitpos >> 5)
+    shift = (bitpos & 31).astype(np.uint64)
+    pair = words[widx].astype(np.uint64) | (words[widx + 1].astype(np.uint64) << np.uint64(32))
+    mask = np.where(w >= 32, _MASK32, (np.uint64(1) << w.astype(np.uint64)) - np.uint64(1))
+    deltas = (pair >> shift) & mask
+    deltas = np.where(w == 0, np.uint64(0), deltas)
+    if raw.any():
+        ro = pl.block_off[raw][:, None] + 2 * np.arange(BLOCK, dtype=np.int64)[None, :]
+        deltas[raw] = words[ro].astype(np.uint64) | (words[ro + 1].astype(np.uint64) << np.uint64(32))
+    deltas[:, 0] = 0
+    out = pl.block_first[:, None] + np.cumsum(deltas, axis=1)
+    lane = np.tile(np.arange(BLOCK), nb)
+    keep = lane < np.repeat(pl.block_count, BLOCK)
+    return out.ravel()[keep]
+
+
+def seek_block(pl: PackedUidList, after_uid: int) -> int:
+    """First block that can contain a uid > after_uid (AfterUid seek,
+    reference bp128/bp128.go:276). Returns pl.nblocks when exhausted."""
+    return int(np.searchsorted(pl.block_last, np.uint64(after_uid), side="right"))

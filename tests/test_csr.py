@@ -1,0 +1,79 @@
+"""CSR expand vs a scipy-free numpy reference."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from dgraph_tpu.ops import csr, uidset as us
+
+
+def build_csr(edges, n_rows):
+    """edges: list of (src_row, dst). Returns (indptr, indices) numpy."""
+    edges = sorted(set(edges))
+    counts = np.zeros(n_rows, dtype=np.int32)
+    for s, _ in edges:
+        counts[s] += 1
+    indptr = np.zeros(n_rows + 1, dtype=np.int32)
+    indptr[1:] = np.cumsum(counts)
+    indices = np.asarray([d for _, d in edges], dtype=np.int32)
+    return indptr, indices
+
+
+def test_expand_basic():
+    #   0 -> {10, 11}, 1 -> {}, 2 -> {11, 12, 13}
+    indptr, indices = build_csr([(0, 10), (0, 11), (2, 11), (2, 12), (2, 13)], 3)
+    frontier = us.make_set([0, 2], capacity=4)
+    res = csr.expand(jnp.asarray(indptr), jnp.asarray(indices), frontier, out_cap=8)
+    assert int(res.total) == 5
+    np.testing.assert_array_equal(np.asarray(res.targets)[:5], [10, 11, 11, 12, 13])
+    np.testing.assert_array_equal(np.asarray(res.seg)[:5], [0, 0, 1, 1, 1])
+    np.testing.assert_array_equal(np.asarray(res.counts)[:2], [2, 3])
+    # padding
+    assert np.asarray(res.seg)[5] == -1
+    assert np.asarray(res.targets)[5] == us.SENTINEL32
+
+
+def test_expand_dest_dedups():
+    indptr, indices = build_csr([(0, 10), (0, 11), (2, 11), (2, 12)], 3)
+    frontier = us.make_set([0, 2], capacity=4)
+    dest, total = csr.expand_dest(jnp.asarray(indptr), jnp.asarray(indices), frontier, out_cap=8)
+    assert int(total) == 4
+    np.testing.assert_array_equal(us.to_numpy(dest), [10, 11, 12])
+
+
+def test_expand_overflow_reports_total():
+    indptr, indices = build_csr([(0, i) for i in range(10)], 1)
+    frontier = us.make_set([0], capacity=2)
+    res = csr.expand(jnp.asarray(indptr), jnp.asarray(indices), frontier, out_cap=4)
+    assert int(res.total) == 10  # host sees overflow vs out_cap=4 and can retry bigger
+    np.testing.assert_array_equal(np.asarray(res.targets), [0, 1, 2, 3])
+
+
+def test_expand_empty_frontier():
+    indptr, indices = build_csr([(0, 1)], 2)
+    frontier = us.make_set([], capacity=4)
+    res = csr.expand(jnp.asarray(indptr), jnp.asarray(indices), frontier, out_cap=4)
+    assert int(res.total) == 0
+    assert np.all(np.asarray(res.targets) == us.SENTINEL32)
+
+
+def test_degrees():
+    indptr, indices = build_csr([(0, 1), (0, 2), (1, 2)], 3)
+    rows = us.make_set([0, 1, 2], capacity=5)
+    d = csr.degrees(jnp.asarray(indptr), rows)
+    np.testing.assert_array_equal(np.asarray(d)[:3], [2, 1, 0])
+
+
+def test_expand_random(rng):
+    n = 200
+    edges = {(int(rng.integers(0, n)), int(rng.integers(0, 5000))) for _ in range(2000)}
+    indptr, indices = build_csr(list(edges), n)
+    rows_np = np.unique(rng.integers(0, n, size=40))
+    frontier = us.make_set(rows_np, capacity=64)
+    res = csr.expand(jnp.asarray(indptr), jnp.asarray(indices), frontier, out_cap=4096)
+    want = []
+    for r in rows_np:
+        want.extend(sorted(d for s, d in edges if s == r))
+    assert int(res.total) == len(want)
+    np.testing.assert_array_equal(np.asarray(res.targets)[: len(want)], want)
+    dest, _ = csr.expand_dest(jnp.asarray(indptr), jnp.asarray(indices), frontier, out_cap=4096)
+    np.testing.assert_array_equal(us.to_numpy(dest), np.unique(want))
