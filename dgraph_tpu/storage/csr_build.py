@@ -1,0 +1,240 @@
+"""Build immutable device snapshots: posting store → HBM-resident CSR graphs.
+
+This is the load-bearing TPU redesign (SURVEY.md §7): the reference reads
+posting lists one (predicate, uid) at a time through an LRU over badger
+(posting/lists.go Get → mvcc.ReadPostingList), merging the mutable layer on
+every read. Here a *snapshot at read_ts* is folded once into flat arrays and
+uploaded; the device then serves every read of that epoch with zero host
+round-trips:
+
+  - uid predicates      → forward CSR (subjects / indptr / indices) and, for
+                          @reverse predicates, a reverse CSR
+                          (ReverseKey tablets, posting/index.go:190).
+  - indexed predicates  → per-tokenizer token→uid CSR. The host keeps the
+                          sorted term list; inequality functions binary-search
+                          it and the device unions the chosen token rows
+                          (worker/tokens.go:124 getInequalityTokens redesigned
+                          as an expand over token rows).
+  - value predicates    → host-side exact {uid: Val} map (post-filters,
+                          output encoding) plus a best-effort numeric mirror
+                          aligned to value_subjects for device aggregation.
+  - count index         → implicit: degree = indptr[i+1]-indptr[i] on device
+                          (CountKey tablets exist host-side for exactness).
+
+Snapshot isolation falls out naturally: a snapshot is just read_ts plus
+immutable arrays; concurrent txns keep writing to the store and later epochs
+build new snapshots (posting/mvcc.go's readTs gating, without device MVCC).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable
+
+import numpy as np
+import jax.numpy as jnp
+
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.types import TypeID, Val, to_device_scalar
+
+MAX_DEVICE_UID = 2**31 - 2  # int32 space, sentinel-exclusive
+
+
+@dataclass
+class PredCSR:
+    """Adjacency of one predicate: row r = subjects[r] → indices[indptr[r]:indptr[r+1]]."""
+
+    subjects: jnp.ndarray   # int32[N] sorted
+    indptr: jnp.ndarray     # int32[N+1]
+    indices: jnp.ndarray    # int32[E] sorted within each row
+
+    @property
+    def num_subjects(self) -> int:
+        return int(self.subjects.shape[0])
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.indices.shape[0])
+
+
+@dataclass
+class TokenIndex:
+    """token→uid CSR for one (predicate, tokenizer)."""
+
+    terms: list[bytes]      # sorted; host-side (binary-searched for ranges)
+    indptr: jnp.ndarray     # int32[T+1]
+    uids: jnp.ndarray       # int32[sum row lens], sorted per row
+
+    def term_row(self, term: bytes) -> int:
+        import bisect
+
+        i = bisect.bisect_left(self.terms, term)
+        return i if i < len(self.terms) and self.terms[i] == term else -1
+
+
+@dataclass
+class PredData:
+    attr: str
+    type_id: TypeID
+    csr: PredCSR | None = None
+    rev_csr: PredCSR | None = None
+    value_subjects: jnp.ndarray | None = None    # int32[N] sorted uids with a value
+    num_values: jnp.ndarray | None = None        # float32[N] numeric mirror (NaN=non-numeric)
+    host_values: dict[int, Val] = field(default_factory=dict)
+    lang_values: dict[int, dict[str, Val]] = field(default_factory=dict)
+    facets: dict[tuple[int, int], tuple] = field(default_factory=dict)  # (subj,obj/slot)->facets
+    indexes: dict[str, TokenIndex] = field(default_factory=dict)
+
+    def has_subjects(self) -> np.ndarray:
+        """uids for has(attr): subjects with any edge or value."""
+        outs = []
+        if self.csr is not None:
+            outs.append(np.asarray(self.csr.subjects))
+        if self.value_subjects is not None:
+            outs.append(np.asarray(self.value_subjects))
+        if not outs:
+            return np.zeros(0, dtype=np.int32)
+        return np.unique(np.concatenate(outs))
+
+
+def _csr_from_rows(rows: list[tuple[int, np.ndarray]]) -> PredCSR | None:
+    rows = [(s, o) for s, o in rows if len(o)]
+    if not rows:
+        return None
+    rows.sort(key=lambda x: x[0])
+    subjects = np.asarray([s for s, _ in rows], dtype=np.int64)
+    if len(subjects) and subjects[-1] > MAX_DEVICE_UID:
+        raise ValueError(f"uid {subjects[-1]} exceeds device uid space")
+    lens = np.asarray([len(o) for _, o in rows], dtype=np.int64)
+    indptr = np.zeros(len(rows) + 1, dtype=np.int32)
+    np.cumsum(lens, out=indptr[1:])
+    indices = np.concatenate([o for _, o in rows]).astype(np.int64)
+    if len(indices) and indices.max() > MAX_DEVICE_UID:
+        raise ValueError("object uid exceeds device uid space")
+    return PredCSR(
+        jnp.asarray(subjects.astype(np.int32)),
+        jnp.asarray(indptr),
+        jnp.asarray(indices.astype(np.int32)),
+    )
+
+
+def _token_index(rows: list[tuple[bytes, np.ndarray]]) -> TokenIndex:
+    rows.sort(key=lambda x: x[0])
+    terms = [t for t, _ in rows]
+    lens = np.asarray([len(u) for _, u in rows], dtype=np.int64)
+    indptr = np.zeros(len(rows) + 1, dtype=np.int32)
+    if len(rows):
+        np.cumsum(lens, out=indptr[1:])
+        uids = np.concatenate([u for _, u in rows]).astype(np.int32)
+    else:
+        uids = np.zeros(0, dtype=np.int32)
+    return TokenIndex(terms, jnp.asarray(indptr), jnp.asarray(uids))
+
+
+class GraphSnapshot:
+    """Immutable device-resident view of (a subset of) the graph at read_ts."""
+
+    def __init__(self, read_ts: int) -> None:
+        self.read_ts = read_ts
+        self.preds: dict[str, PredData] = {}
+
+    def pred(self, attr: str) -> PredData | None:
+        return self.preds.get(attr)
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for pd in self.preds.values():
+            for csr in (pd.csr, pd.rev_csr):
+                if csr is not None:
+                    total += csr.subjects.nbytes + csr.indptr.nbytes + csr.indices.nbytes
+            if pd.value_subjects is not None:
+                total += pd.value_subjects.nbytes
+            if pd.num_values is not None:
+                total += pd.num_values.nbytes
+            for ti in pd.indexes.values():
+                total += ti.indptr.nbytes + ti.uids.nbytes
+        return total
+
+
+def build_snapshot(store: Store, read_ts: int,
+                   attrs: Iterable[str] | None = None) -> GraphSnapshot:
+    """Fold the store at read_ts into a GraphSnapshot (upload to device)."""
+    snap = GraphSnapshot(read_ts)
+    todo = sorted(attrs) if attrs is not None else store.predicates()
+    for attr in todo:
+        entry = store.schema.get(attr)
+        tid = entry.type_id if entry else TypeID.DEFAULT
+        pd = PredData(attr, tid)
+
+        fwd_rows: list[tuple[int, np.ndarray]] = []
+        val_subjects: list[int] = []
+        num_vals: list[float] = []
+        for kb in store.keys_of(K.KeyKind.DATA, attr):
+            key = K.parse_key(kb)
+            pl = store.lists[kb]
+            if tid == TypeID.UID or (tid == TypeID.DEFAULT and pl.value(read_ts) is None):
+                u = pl.uids(read_ts)
+                if len(u):
+                    fwd_rows.append((key.uid, u))
+                for p in pl.postings(read_ts):
+                    if p.facets:
+                        pd.facets[(key.uid, p.uid)] = p.facets
+            else:
+                v = pl.value(read_ts)
+                if v is not None:
+                    pd.host_values[key.uid] = v
+                    val_subjects.append(key.uid)
+                    s = to_device_scalar(v)
+                    num_vals.append(np.nan if s is None else float(s))
+                # language-tagged values
+                for p in pl.postings(read_ts):
+                    if p.value is not None and p.lang:
+                        pd.lang_values.setdefault(key.uid, {})[p.lang] = p.value
+                    if p.facets:
+                        pd.facets[(key.uid, p.uid)] = p.facets
+        if fwd_rows:
+            pd.csr = _csr_from_rows(fwd_rows)
+        if val_subjects:
+            order = np.argsort(np.asarray(val_subjects, dtype=np.int64))
+            vs = np.asarray(val_subjects, dtype=np.int64)[order]
+            if vs[-1] > MAX_DEVICE_UID:
+                raise ValueError("value subject uid exceeds device uid space")
+            pd.value_subjects = jnp.asarray(vs.astype(np.int32))
+            pd.num_values = jnp.asarray(
+                np.asarray(num_vals, dtype=np.float32)[order])
+
+        # reverse CSR
+        if entry is not None and entry.reverse:
+            rev_rows = []
+            for kb in store.keys_of(K.KeyKind.REVERSE, attr):
+                key = K.parse_key(kb)
+                u = store.lists[kb].uids(read_ts)
+                if len(u):
+                    rev_rows.append((key.uid, u))
+            if rev_rows:
+                pd.rev_csr = _csr_from_rows(rev_rows)
+
+        # token indexes, split per tokenizer by the 1-byte term prefix
+        if entry is not None and entry.indexed:
+            from dgraph_tpu.utils import tok as tokmod
+
+            by_tok: dict[str, list[tuple[bytes, np.ndarray]]] = {
+                name: [] for name in entry.tokenizers}
+            ident_to_name = {tokmod.get(n).ident: n for n in entry.tokenizers}
+            for kb in store.keys_of(K.KeyKind.INDEX, attr):
+                key = K.parse_key(kb)
+                if not key.term:
+                    continue
+                name = ident_to_name.get(key.term[0])
+                if name is None:
+                    continue
+                u = store.lists[kb].uids(read_ts)
+                if len(u):
+                    by_tok[name].append((key.term[1:], u))
+            for name, rows in by_tok.items():
+                pd.indexes[name] = _token_index(rows)
+
+        snap.preds[attr] = pd
+    return snap

@@ -1,0 +1,223 @@
+"""Tokenizers: value → index terms.
+
+Reference semantics: tok/tok.go — registry keyed by a 1-byte identifier that
+prefixes every index term (so one index posting space can hold many tokenizer
+families, tok/tok.go:34-60); IsSortable drives index-ordered sort
+(worker/sort.go sortWithIndex), IsLossy forces post-filter re-checks of
+candidates against actual values (worker/task.go:837-919). Full-text uses
+per-language stemming + stopwords (tok/fts.go, Bleve); ours is a self-contained
+Porter stemmer + English stopword list. Custom tokenizers: the reference loads
+Go plugin .so files (tok/tok.go:92-109); here a custom tokenizer is a Python
+module registered via register_custom / --custom_tokenizers.
+
+Term bytes returned by tokenize() are exactly what lands in INDEX keys
+(storage/keys.py index_key) and therefore define index-bucket sort order:
+int/float/datetime tokens are big-endian order-preserving encodings so walking
+index buckets in key order IS the sorted order (the sortWithIndex contract).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Callable
+
+from dgraph_tpu.utils.types import TypeID, Val, convert
+
+
+@dataclass(frozen=True)
+class Tokenizer:
+    name: str
+    ident: int           # 1-byte term prefix
+    type_id: TypeID      # value type it accepts
+    sortable: bool
+    lossy: bool
+    fn: Callable[[Val], list[bytes]]
+
+    def tokens(self, v: Val) -> list[bytes]:
+        prefix = bytes([self.ident])
+        return [prefix + t for t in self.fn(v)]
+
+
+_REGISTRY: dict[str, Tokenizer] = {}
+
+
+def register(t: Tokenizer) -> None:
+    if t.name in _REGISTRY:
+        raise ValueError(f"duplicate tokenizer {t.name}")
+    for existing in _REGISTRY.values():
+        if existing.ident == t.ident:
+            raise ValueError(f"duplicate tokenizer ident 0x{t.ident:x}")
+    _REGISTRY[t.name] = t
+
+
+def get(name: str) -> Tokenizer:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown tokenizer {name!r}") from None
+
+
+def has(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def default_tokenizer(tid: TypeID) -> Tokenizer:
+    """Tokenizer used when @index has no argument (reference schema/parse.go)."""
+    return get({
+        TypeID.INT: "int", TypeID.FLOAT: "float", TypeID.BOOL: "bool",
+        TypeID.DATETIME: "year", TypeID.GEO: "geo",
+        TypeID.STRING: "term", TypeID.DEFAULT: "term",
+    }[tid])
+
+
+# ---------------------------------------------------------------------------
+# Scalar encodings (order-preserving big-endian; sortable indexes)
+# ---------------------------------------------------------------------------
+
+def _enc_int(v: int) -> bytes:
+    if not (-(1 << 63) <= v < (1 << 63)):
+        raise ValueError(f"int value {v} outside int64 range")
+    return struct.pack(">Q", v + (1 << 63))  # bias: preserves order across sign
+
+
+def _enc_float(f: float) -> bytes:
+    bits = struct.unpack(">Q", struct.pack(">d", f))[0]
+    bits = bits ^ ((1 << 63) if bits >> 63 == 0 else 0xFFFFFFFFFFFFFFFF)
+    return struct.pack(">Q", bits)
+
+
+def _int_tokens(v: Val) -> list[bytes]:
+    return [_enc_int(int(convert(v, TypeID.INT).value))]
+
+
+def _float_tokens(v: Val) -> list[bytes]:
+    return [_enc_float(float(convert(v, TypeID.FLOAT).value))]
+
+
+def _bool_tokens(v: Val) -> list[bytes]:
+    return [b"\x01" if convert(v, TypeID.BOOL).value else b"\x00"]
+
+
+def _dt_part(part: str):
+    def fn(v: Val) -> list[bytes]:
+        dt = convert(v, TypeID.DATETIME).value
+        out = struct.pack(">h", dt.year)
+        if part in ("month", "day", "hour"):
+            out += bytes([dt.month])
+        if part in ("day", "hour"):
+            out += bytes([dt.day])
+        if part == "hour":
+            out += bytes([dt.hour])
+        return [out]
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# String tokenizers
+# ---------------------------------------------------------------------------
+
+def _normalize(s: str) -> str:
+    import unicodedata
+
+    s = unicodedata.normalize("NFKD", s)
+    return "".join(c for c in s if not unicodedata.combining(c)).lower()
+
+
+def _term_tokens(v: Val) -> list[bytes]:
+    words = "".join(c if c.isalnum() else " " for c in _normalize(str(v.value))).split()
+    return sorted({w.encode("utf-8") for w in words})
+
+
+def _exact_tokens(v: Val) -> list[bytes]:
+    return [str(v.value).encode("utf-8")]
+
+
+def _hash_tokens(v: Val) -> list[bytes]:
+    import hashlib
+
+    return [hashlib.blake2b(str(v.value).encode("utf-8"), digest_size=8).digest()]
+
+
+def _trigram_tokens(v: Val) -> list[bytes]:
+    s = str(v.value)
+    return sorted({s[i : i + 3].encode("utf-8") for i in range(len(s) - 2)}) if len(s) >= 3 else []
+
+
+_STOPWORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such that
+    the their then there these they this to was will with""".split()
+)
+
+
+def porter_stem(w: str) -> str:
+    """Compact Porter stemmer (step 1 + common suffix strips) — enough to make
+    full-text matching insensitive to plurals/verb forms, the property the
+    reference gets from Bleve's English stemmer."""
+    if len(w) <= 3:
+        return w
+    for suf, rep in (("sses", "ss"), ("ies", "i"), ("ss", "ss"), ("s", "")):
+        if w.endswith(suf):
+            w = w[: len(w) - len(suf)] + rep
+            break
+    for suf in ("ational", "tional", "ization", "fulness", "ousness", "iveness",
+                "biliti", "entli", "ousli", "ing", "edly", "ed", "ly", "ment", "ness"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            w = w[: len(w) - len(suf)]
+            break
+    return w
+
+
+def _fulltext_tokens(v: Val) -> list[bytes]:
+    words = "".join(c if c.isalnum() else " " for c in _normalize(str(v.value))).split()
+    return sorted({porter_stem(w).encode("utf-8") for w in words if w not in _STOPWORDS})
+
+
+def _geo_tokens(v: Val) -> list[bytes]:
+    from dgraph_tpu.utils import geo as geomod
+
+    g = v.value if not isinstance(v.value, (str, bytes, dict)) else geomod.parse_geojson(v.value)
+    return [t.encode("ascii") for t in geomod.index_tokens(g)]
+
+
+# ---------------------------------------------------------------------------
+# Registry population (idents mirror the reference's 1-byte space,
+# tok/tok.go registry :76-133)
+# ---------------------------------------------------------------------------
+
+register(Tokenizer("term", 0x01, TypeID.STRING, sortable=False, lossy=True, fn=_term_tokens))
+register(Tokenizer("exact", 0x02, TypeID.STRING, sortable=True, lossy=False, fn=_exact_tokens))
+register(Tokenizer("year", 0x04, TypeID.DATETIME, sortable=True, lossy=True, fn=_dt_part("year")))
+register(Tokenizer("month", 0x41, TypeID.DATETIME, sortable=True, lossy=True, fn=_dt_part("month")))
+register(Tokenizer("day", 0x42, TypeID.DATETIME, sortable=True, lossy=True, fn=_dt_part("day")))
+register(Tokenizer("hour", 0x43, TypeID.DATETIME, sortable=True, lossy=True, fn=_dt_part("hour")))
+register(Tokenizer("geo", 0x05, TypeID.GEO, sortable=False, lossy=True, fn=_geo_tokens))
+register(Tokenizer("int", 0x06, TypeID.INT, sortable=True, lossy=False, fn=_int_tokens))
+register(Tokenizer("float", 0x07, TypeID.FLOAT, sortable=True, lossy=True, fn=_float_tokens))
+register(Tokenizer("fulltext", 0x08, TypeID.STRING, sortable=False, lossy=True, fn=_fulltext_tokens))
+register(Tokenizer("bool", 0x09, TypeID.BOOL, sortable=False, lossy=False, fn=_bool_tokens))
+register(Tokenizer("trigram", 0x0A, TypeID.STRING, sortable=False, lossy=True, fn=_trigram_tokens))
+register(Tokenizer("hash", 0x0B, TypeID.STRING, sortable=False, lossy=True, fn=_hash_tokens))
+
+
+def register_custom(name: str, fn: Callable[[Val], list[bytes]],
+                    type_id: TypeID = TypeID.STRING, sortable: bool = False,
+                    lossy: bool = True) -> None:
+    """Custom tokenizer (reference: Go plugin LoadCustomTokenizer, tok/tok.go:92).
+    Custom idents live in 0x80+ to never collide with built-ins."""
+    ident = 0x80 + (sum(name.encode()) % 0x70)
+    taken = {t.ident for t in _REGISTRY.values()}
+    while ident in taken:
+        ident = 0x80 + ((ident + 1 - 0x80) % 0x70)
+    register(Tokenizer(name, ident, type_id, sortable, lossy, fn))
+
+
+def load_custom_module(spec: str) -> None:
+    """Load custom tokenizers from 'module.path' exposing TOKENIZERS =
+    [(name, fn, type_id, sortable, lossy), ...] — the plugin mechanism."""
+    import importlib
+
+    mod = importlib.import_module(spec)
+    for name, fn, tid, sortable, lossy in getattr(mod, "TOKENIZERS", []):
+        register_custom(name, fn, tid, sortable, lossy)

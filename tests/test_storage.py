@@ -1,0 +1,296 @@
+"""Storage stack: keys, MVCC posting lists, WAL/snapshot durability, indexes.
+
+Mirrors the reference's posting/*_test.go (mutation layering, commit/abort,
+value reads) and x/keys_test.go.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage import index as idx
+from dgraph_tpu.storage.csr_build import build_snapshot
+from dgraph_tpu.storage.postings import DirectedEdge, Op, Posting, PostingList
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+from dgraph_tpu.utils.types import TypeID, Val
+
+
+def test_key_roundtrip():
+    for key in [
+        K.data_key("friend", 123),
+        K.reverse_key("friend", 9),
+        K.index_key("name", b"\x01alice"),
+        K.count_key("friend", 42),
+        K.count_key("friend", 42, reverse=True),
+        K.schema_key("name"),
+    ]:
+        assert K.parse_key(key.encode()) == key
+
+
+def test_data_keys_sort_by_uid():
+    ks = [K.data_key("p", u).encode() for u in (1, 255, 256, 70000, 2**40)]
+    assert ks == sorted(ks)
+
+
+def test_posting_list_mvcc():
+    pl = PostingList()
+    pl.add_mutation(start_ts=5, p=Posting(10))
+    pl.add_mutation(start_ts=5, p=Posting(20))
+    # invisible before commit (other readers)
+    assert pl.length(read_ts=100) == 0
+    # visible to own txn
+    np.testing.assert_array_equal(pl.uids(100, own_start_ts=5), [10, 20])
+    assert pl.commit(start_ts=5, commit_ts=7)
+    np.testing.assert_array_equal(pl.uids(7), [10, 20])
+    assert pl.length(read_ts=6) == 0  # snapshot below commit_ts
+
+    # delete one uid in a later txn
+    pl.add_mutation(start_ts=8, p=Posting(10, Op.DEL))
+    pl.commit(8, 9)
+    np.testing.assert_array_equal(pl.uids(9), [20])
+    np.testing.assert_array_equal(pl.uids(7), [10, 20])  # old snapshot intact
+
+    # wildcard delete
+    pl.add_mutation(start_ts=10, p=Posting(0, Op.DEL_ALL))
+    pl.commit(10, 11)
+    assert pl.length(11) == 0
+    np.testing.assert_array_equal(pl.uids(9), [20])
+
+    # rollup folds layers; later snapshots unchanged
+    pl.rollup(9)
+    np.testing.assert_array_equal(pl.uids(9), [20])
+    assert pl.length(11) == 0
+
+
+def test_posting_list_values_and_lang():
+    pl = PostingList()
+    pl.add_mutation(1, Posting(0, value=Val(TypeID.STRING, "hello")))
+    pl.commit(1, 2)
+    assert pl.value(2).value == "hello"
+    from dgraph_tpu.storage.postings import lang_uid
+
+    pl.add_mutation(3, Posting(lang_uid("fr"), value=Val(TypeID.STRING, "bonjour"), lang="fr"))
+    pl.commit(3, 4)
+    assert pl.value(4, lang="fr").value == "bonjour"
+    assert pl.value(4).value == "hello"
+    # abort leaves state untouched
+    pl.add_mutation(5, Posting(0, value=Val(TypeID.STRING, "bye")))
+    pl.abort(5)
+    assert pl.value(10).value == "hello"
+
+
+def test_store_wal_replay(tmp_path):
+    d = str(tmp_path / "st")
+    s = Store(d)
+    for e in parse_schema("friend: uid @reverse @count .\nname: string @index(exact) ."):
+        s.set_schema(e)
+    ts = 1
+    for sub, obj in [(1, 2), (1, 3), (2, 3)]:
+        idx.add_mutation_with_index(s, DirectedEdge(sub, "friend", object_uid=obj), ts)
+    idx.add_mutation_with_index(
+        s, DirectedEdge(1, "name", value=Val(TypeID.STRING, "alice")), ts)
+    s.commit(ts, 2, list(s.lists.keys()))
+    s.close()
+
+    # reopen: WAL replay restores everything
+    s2 = Store(d)
+    pl = s2.get(K.data_key("friend", 1))
+    np.testing.assert_array_equal(pl.uids(5), [2, 3])
+    rev = s2.get(K.reverse_key("friend", 3))
+    np.testing.assert_array_equal(rev.uids(5), [1, 2])
+    assert s2.schema.get("friend").reverse
+    assert s2.get(K.data_key("name", 1)).value(5).value == "alice"
+    s2.close()
+
+
+def test_store_checkpoint_and_tail(tmp_path):
+    d = str(tmp_path / "st")
+    s = Store(d)
+    s.add_mutation(1, K.data_key("p", 1), Posting(100))
+    s.commit(1, 2, [K.data_key("p", 1).encode()])
+    s.checkpoint(upto_ts=2)
+    # post-checkpoint commits land in the fresh WAL
+    s.add_mutation(3, K.data_key("p", 1), Posting(200))
+    s.commit(3, 4, [K.data_key("p", 1).encode()])
+    # uncommitted txn survives checkpoint+reopen via WAL
+    s.add_mutation(5, K.data_key("p", 1), Posting(300))
+    s.close()
+
+    s2 = Store(d)
+    pl = s2.get(K.data_key("p", 1))
+    np.testing.assert_array_equal(pl.uids(4), [100, 200])
+    np.testing.assert_array_equal(pl.uids(2), [100])
+    np.testing.assert_array_equal(pl.uids(10, own_start_ts=5), [100, 200, 300])
+    s2.commit(5, 6, [K.data_key("p", 1).encode()])
+    np.testing.assert_array_equal(s2.get(K.data_key("p", 1)).uids(6), [100, 200, 300])
+    s2.close()
+
+
+def test_count_index_maintenance():
+    s = Store()
+    for e in parse_schema("friend: uid @count ."):
+        s.set_schema(e)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "friend", object_uid=2), 1)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "friend", object_uid=3), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+    ck = s.get(K.count_key("friend", 2))
+    np.testing.assert_array_equal(ck.uids(3), [1])
+    # degree 1 bucket must be empty for subject 1
+    assert 1 not in s.get(K.count_key("friend", 1)).uids(3).tolist()
+
+
+def test_index_value_replacement():
+    s = Store()
+    for e in parse_schema("name: string @index(exact) ."):
+        s.set_schema(e)
+    idx.add_mutation_with_index(s, DirectedEdge(7, "name", value=Val(TypeID.STRING, "bob")), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+    idx.add_mutation_with_index(s, DirectedEdge(7, "name", value=Val(TypeID.STRING, "carol")), 3)
+    s.commit(3, 4, list(s.lists.keys()))
+    from dgraph_tpu.utils import tok
+
+    old_term = tok.get("exact").tokens(Val(TypeID.STRING, "bob"))[0]
+    new_term = tok.get("exact").tokens(Val(TypeID.STRING, "carol"))[0]
+    assert s.get(K.index_key("name", old_term)).length(5) == 0
+    np.testing.assert_array_equal(s.get(K.index_key("name", new_term)).uids(5), [7])
+
+
+def test_snapshot_build():
+    s = Store()
+    for e in parse_schema("friend: uid @reverse .\nage: int @index(int) .\nname: string ."):
+        s.set_schema(e)
+    for sub, obj in [(1, 2), (1, 3), (4, 1)]:
+        idx.add_mutation_with_index(s, DirectedEdge(sub, "friend", object_uid=obj), 1)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "age", value=Val(TypeID.INT, 30)), 1)
+    idx.add_mutation_with_index(s, DirectedEdge(2, "age", value=Val(TypeID.INT, 25)), 1)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "name", value=Val(TypeID.STRING, "x")), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+
+    snap = build_snapshot(s, read_ts=3)
+    f = snap.pred("friend")
+    np.testing.assert_array_equal(np.asarray(f.csr.subjects), [1, 4])
+    np.testing.assert_array_equal(np.asarray(f.csr.indptr), [0, 2, 3])
+    np.testing.assert_array_equal(np.asarray(f.csr.indices), [2, 3, 1])
+    np.testing.assert_array_equal(np.asarray(f.rev_csr.subjects), [1, 2, 3])
+    age = snap.pred("age")
+    np.testing.assert_array_equal(np.asarray(age.value_subjects), [1, 2])
+    np.testing.assert_array_equal(np.asarray(age.num_values), [30.0, 25.0])
+    assert age.host_values[1].value == 30
+    ti = age.indexes["int"]
+    assert len(ti.terms) == 2  # two distinct int tokens
+    assert ti.term_row(ti.terms[0]) == 0
+    # snapshot at ts before commit sees nothing
+    empty = build_snapshot(s, read_ts=1)
+    assert empty.pred("friend").csr is None
+
+
+def test_schema_parse_and_validation():
+    es = parse_schema("""
+        # comment
+        name: string @index(term, exact) @lang .
+        friend: [uid] @reverse @count .
+        age: int @index(int) .
+        loc: geo @index(geo) .
+    """)
+    m = {e.predicate: e for e in es}
+    assert m["name"].tokenizers == ["term", "exact"] and m["name"].lang
+    assert m["friend"].is_list and m["friend"].reverse and m["friend"].count
+    with pytest.raises(ValueError):
+        parse_schema("name: string @index(int) .")  # tokenizer/type mismatch
+    with pytest.raises(ValueError):
+        parse_schema("x: string @reverse .")  # reverse needs uid
+    with pytest.raises(ValueError):
+        parse_schema("x: int @upsert .")  # upsert needs index
+
+
+def test_lang_index_isolation():
+    # regression: setting a lang-tagged value must not delete the untagged
+    # value's index terms (found by review)
+    s = Store()
+    for e in parse_schema("name: string @index(exact) @lang ."):
+        s.set_schema(e)
+    idx.add_mutation_with_index(s, DirectedEdge(7, "name", value=Val(TypeID.STRING, "bob")), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+    idx.add_mutation_with_index(
+        s, DirectedEdge(7, "name", value=Val(TypeID.STRING, "robert"), lang="fr"), 3)
+    s.commit(3, 4, list(s.lists.keys()))
+    from dgraph_tpu.utils import tok
+
+    bob = tok.get("exact").tokens(Val(TypeID.STRING, "bob"))[0]
+    np.testing.assert_array_equal(s.get(K.index_key("name", bob)).uids(5), [7])
+    assert s.get(K.data_key("name", 7)).value(5).value == "bob"
+    assert s.get(K.data_key("name", 7)).value(5, lang="fr").value == "robert"
+
+
+def test_list_valued_scalar():
+    # regression: [string] predicates accumulate values (found by review)
+    s = Store()
+    for e in parse_schema("hobby: [string] @index(exact) ."):
+        s.set_schema(e)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "hobby", value=Val(TypeID.STRING, "chess")), 1)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "hobby", value=Val(TypeID.STRING, "go")), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+    vals = {v.value for v in s.get(K.data_key("hobby", 1)).all_values(3)}
+    assert vals == {"chess", "go"}
+    # delete one specific value
+    idx.add_mutation_with_index(
+        s, DirectedEdge(1, "hobby", value=Val(TypeID.STRING, "chess"), op=Op.DEL), 3)
+    s.commit(3, 4, list(s.lists.keys()))
+    vals = {v.value for v in s.get(K.data_key("hobby", 1)).all_values(5)}
+    assert vals == {"go"}
+    from dgraph_tpu.utils import tok
+
+    chess = tok.get("exact").tokens(Val(TypeID.STRING, "chess"))[0]
+    assert s.get(K.index_key("hobby", chess)).length(5) == 0
+
+
+def test_checkpoint_crash_window(tmp_path):
+    # regression: crash between snapshot replace and WAL truncation must not
+    # double-apply old commits (found by review)
+    import os
+    import shutil
+
+    d = str(tmp_path / "st")
+    s = Store(d)
+    k = K.data_key("p", 1)
+    s.add_mutation(1, k, Posting(0, Op.DEL_ALL))
+    s.commit(1, 5, [k.encode()])
+    s.add_mutation(2, k, Posting(77))
+    s.commit(2, 7, [k.encode()])
+    wal_copy = str(tmp_path / "wal.copy")
+    shutil.copy(os.path.join(d, "wal.log"), wal_copy)
+    s.checkpoint(10)
+    s.close()
+    # simulate: snapshot.bin is new, wal.log is the OLD pre-checkpoint WAL
+    shutil.copy(wal_copy, os.path.join(d, "wal.log"))
+    s2 = Store(d)
+    np.testing.assert_array_equal(s2.get(k).uids(10), [77])
+    s2.close()
+
+
+def test_rollup_watermark_guard():
+    pl = PostingList()
+    pl.add_mutation(1, Posting(5))
+    pl.commit(1, 2)
+    pl.rollup(2)
+    with pytest.raises(ValueError, match="watermark"):
+        pl.uids(1)
+
+
+def test_rebuild_survives_replay(tmp_path):
+    # regression: index rebuild drops must be WAL-logged (found by review)
+    d = str(tmp_path / "st")
+    s = Store(d)
+    for e in parse_schema("friend: uid @count ."):
+        s.set_schema(e)
+    idx.add_mutation_with_index(s, DirectedEdge(1, "friend", object_uid=2), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+    idx.add_mutation_with_index(s, DirectedEdge(1, "friend", object_uid=3), 3)
+    s.commit(3, 4, list(s.lists.keys()))
+    idx.rebuild_count(s, "friend", read_ts=5, commit_ts=6)
+    s.close()
+    s2 = Store(d)  # replay without checkpoint
+    assert 1 not in s2.get(K.count_key("friend", 1)).uids(7).tolist()
+    np.testing.assert_array_equal(s2.get(K.count_key("friend", 2)).uids(7), [1])
+    s2.close()

@@ -1,0 +1,118 @@
+"""Value types, conversion matrix, tokenizers, geo (reference: types/, tok/)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from dgraph_tpu.utils import geo, tok
+from dgraph_tpu.utils.types import (TypeID, Val, compare_vals, convert,
+                                    hash_password, marshal, unmarshal,
+                                    verify_password)
+
+
+def test_conversion_matrix():
+    assert convert(Val(TypeID.STRING, "42"), TypeID.INT).value == 42
+    assert convert(Val(TypeID.STRING, "3.5"), TypeID.FLOAT).value == 3.5
+    assert convert(Val(TypeID.STRING, "true"), TypeID.BOOL).value is True
+    assert convert(Val(TypeID.INT, 5), TypeID.FLOAT).value == 5.0
+    assert convert(Val(TypeID.FLOAT, 2.9), TypeID.INT).value == 2
+    dt = convert(Val(TypeID.STRING, "2006-01-02T15:04:05"), TypeID.DATETIME).value
+    assert dt == datetime(2006, 1, 2, 15, 4, 5, tzinfo=timezone.utc)
+    assert convert(Val(TypeID.DATETIME, dt), TypeID.STRING).value.startswith("2006-01-02")
+    assert convert(Val(TypeID.INT, 7), TypeID.STRING).value == "7"
+    with pytest.raises(ValueError):
+        convert(Val(TypeID.STRING, "xyz"), TypeID.INT)
+    with pytest.raises(ValueError):
+        convert(Val(TypeID.BOOL, True), TypeID.DATETIME)
+
+
+def test_marshal_roundtrip():
+    for v in [Val(TypeID.INT, -7), Val(TypeID.FLOAT, 1.25), Val(TypeID.BOOL, True),
+              Val(TypeID.STRING, "héllo"), Val(TypeID.BINARY, b"\x00\x01"),
+              Val(TypeID.DATETIME, datetime(2020, 5, 17, tzinfo=timezone.utc)),
+              Val(TypeID.UID, 12345)]:
+        assert unmarshal(v.tid, marshal(v)) == v
+
+
+def test_compare_vals():
+    assert compare_vals("lt", Val(TypeID.INT, 3), Val(TypeID.INT, 5))
+    assert compare_vals("ge", Val(TypeID.FLOAT, 5.0), Val(TypeID.INT, 5))
+    assert not compare_vals("eq", Val(TypeID.STRING, "a"), Val(TypeID.STRING, "b"))
+
+
+def test_password():
+    h = hash_password("secret1")
+    assert verify_password("secret1", h)
+    assert not verify_password("secret2", h)
+    with pytest.raises(ValueError):
+        hash_password("abc")  # too short
+
+
+def test_term_and_fulltext_tokens():
+    t = tok.get("term")
+    toks = t.tokens(Val(TypeID.STRING, "The Quick  brown-Fox"))
+    words = {x[1:].decode() for x in toks}
+    assert words == {"the", "quick", "brown", "fox"}
+    ft = tok.get("fulltext")
+    toks = ft.tokens(Val(TypeID.STRING, "running dogs and the cats"))
+    stems = {x[1:].decode() for x in toks}
+    assert "runn" in stems or "run" in stems  # stemmed
+    assert "the" not in stems and "and" not in stems  # stopwords dropped
+
+
+def test_int_tokens_order_preserving():
+    enc = lambda i: tok.get("int").tokens(Val(TypeID.INT, i))[0]
+    vals = [-(2**40), -5, 0, 3, 2**40]
+    encoded = [enc(v) for v in vals]
+    assert encoded == sorted(encoded)
+    fenc = lambda f: tok.get("float").tokens(Val(TypeID.FLOAT, f))[0]
+    fvals = [-1e30, -2.5, -0.0, 0.0, 1.5, 1e30]
+    fencoded = [fenc(v) for v in fvals]
+    assert fencoded == sorted(fencoded)
+
+
+def test_trigram_tokens():
+    toks = tok.get("trigram").tokens(Val(TypeID.STRING, "hello"))
+    grams = {x[1:].decode() for x in toks}
+    assert grams == {"hel", "ell", "llo"}
+    assert tok.get("trigram").tokens(Val(TypeID.STRING, "ab")) == []
+
+
+def test_datetime_bucket_tokens():
+    v = Val(TypeID.DATETIME, datetime(2019, 7, 4, 13, tzinfo=timezone.utc))
+    y = tok.get("year").tokens(v)[0]
+    m = tok.get("month").tokens(v)[0]
+    d = tok.get("day").tokens(v)[0]
+    h = tok.get("hour").tokens(v)[0]
+    assert len(y) < len(m) < len(d) < len(h)
+    v2 = Val(TypeID.DATETIME, datetime(2020, 1, 1, tzinfo=timezone.utc))
+    assert tok.get("year").tokens(v2)[0] > y  # sortable across years
+
+
+def test_custom_tokenizer_registry():
+    tok.register_custom("cidr_test", lambda v: [str(v.value).split(".")[0].encode()])
+    t = tok.get("cidr_test")
+    assert t.tokens(Val(TypeID.STRING, "10.1.2.3"))[0][1:] == b"10"
+
+
+def test_geohash_and_predicates():
+    sf = (-122.4194, 37.7749)
+    nyc = (-74.0060, 40.7128)
+    h_sf = geo.geohash(*sf, 6)
+    h_near_sf = geo.geohash(-122.4195, 37.7750, 6)
+    assert h_sf[:4] == h_near_sf[:4]
+    assert geo.haversine_m(sf, nyc) == pytest.approx(4_130_000, rel=0.02)
+
+    g = geo.parse_geojson('{"type":"Point","coordinates":[-122.4194,37.7749]}')
+    toks = geo.index_tokens(g)
+    assert any(t == h_sf[: len(t)] for t in toks)
+
+    square = geo.Geom("Polygon", ((( -1.0, -1.0), (1.0, -1.0), (1.0, 1.0),
+                                   (-1.0, 1.0), (-1.0, -1.0)),))
+    assert geo.contains(square, geo.Geom("Point", (0.0, 0.0)))
+    assert not geo.contains(square, geo.Geom("Point", (2.0, 0.0)))
+    assert geo.within(geo.Geom("Point", (0.5, 0.5)), square)
+    assert geo.near(geo.Geom("Point", sf), (-122.41, 37.77), 5000)
+    assert not geo.near(geo.Geom("Point", sf), (-74.0, 40.7), 5000)
+    roundtrip = geo.parse_geojson(geo.to_geojson(square))
+    assert roundtrip == square
