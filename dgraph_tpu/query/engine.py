@@ -1,0 +1,607 @@
+"""Query engine: SubGraph plan execution (ProcessGraph) over a snapshot.
+
+Reference semantics: query/query.go — SubGraph is both plan node and result
+holder (:165-192); ProcessGraph (:1831): run root function / frontier task →
+DestUIDs = Intersect/MergeSorted(uidMatrix) → filters as parallel sub-plans
+combined and/or/not (:1955-2013) → pagination & ordering (:2016-2031) →
+variable recording (:2035) → children with SrcUIDs = DestUIDs (:2081).
+ProcessQuery executes blocks in dependency waves driven by variable
+needs/defines (:2431-2586). Value variables, uid variables, facet variables:
+varValue / populateVarMap / recursiveFillVars. Aggregation + math:
+query/aggregator.go, query/math.go.
+
+TPU redesign: each level is ONE batched device step (process_task CSR gather)
+instead of per-uid goroutines; filters evaluate as set algebra over the
+frontier; sort uses index-ordered token buckets when available. The host
+drives the level loop (the reference's recursion) because levels are few and
+fat — the per-edge work lives on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from dgraph_tpu.query import dql
+from dgraph_tpu.query.task import (TaskError, TaskQuery, process_task,
+                                   rows_for_uids)
+from dgraph_tpu.storage.csr_build import GraphSnapshot
+from dgraph_tpu.utils.schema import SchemaState
+from dgraph_tpu.utils.types import TypeID, Val, compare_vals, convert, sort_key
+
+MAX_QUERY_EDGES = 1_000_000  # reference x/init.go:53 QueryEdgeLimit
+
+
+class QueryError(ValueError):
+    pass
+
+
+@dataclass
+class VarValue:
+    """A recorded variable (reference query.varValue)."""
+
+    uids: np.ndarray | None = None                  # uid var
+    vals: dict[int, Val] = field(default_factory=dict)  # value var (uid → Val)
+    is_uid: bool = True
+
+
+@dataclass
+class SubGraph:
+    """Plan node + result holder (reference query.SubGraph, query/query.go:165)."""
+
+    gq: dql.GraphQuery
+    attr: str = ""
+    src_uids: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    dest_uids: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    uid_matrix: list[np.ndarray] = field(default_factory=list)
+    value_matrix: list[list[Val]] = field(default_factory=list)
+    facet_matrix: list[list[tuple]] = field(default_factory=list)
+    counts: list[int] = field(default_factory=list)
+    children: list["SubGraph"] = field(default_factory=list)
+    group_result: Any = None
+    agg_value: Val | None = None
+    math_vals: dict[int, Val] = field(default_factory=dict)
+    paths: list = field(default_factory=list)  # shortest-path results
+    traversed: int = 0
+
+
+class Executor:
+    """Executes one parsed request against a snapshot.
+
+    The embedded single-process analog of the reference's server: no RPC — the
+    same code path their tests exercise via the in-process worker
+    (query/query_test.go TestMain, SURVEY.md §4).
+    """
+
+    def __init__(self, snap: GraphSnapshot, schema: SchemaState):
+        self.snap = snap
+        self.schema = schema
+        self.vars: dict[str, VarValue] = {}
+        self.traversed_edges = 0
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, req: dql.ParsedRequest) -> dict:
+        """Run all query blocks in dependency waves (query/query.go:2431)."""
+        blocks = [SubGraph(gq=q, attr=q.attr) for q in req.queries]
+        pending = list(blocks)
+        done_vars: set[str] = set()
+        for _wave in range(len(blocks) + 1):
+            if not pending:
+                break
+            runnable = [b for b in pending
+                        if all(v in done_vars for v in _block_needs(b.gq))]
+            if not runnable:
+                missing = {v for b in pending for v in _block_needs(b.gq)} - done_vars
+                raise QueryError(f"circular or missing variable dependency: {missing}")
+            for b in runnable:
+                self._process_block(b)
+                done_vars.update(_block_defines(b.gq))
+            pending = [b for b in pending if b not in runnable]
+        from dgraph_tpu.query.outputnode import encode_result
+
+        out: dict = {}
+        for b in blocks:
+            if b.gq.attr == "var":
+                continue
+            encode_result(self, b, out)
+        return out
+
+    # ---------------------------------------------------------------- blocks
+
+    def _process_block(self, sg: SubGraph) -> None:
+        gq = sg.gq
+        if gq.shortest is not None:
+            from dgraph_tpu.query.shortest import shortest_path
+
+            shortest_path(self, sg)
+            return
+        # root uids
+        sg.src_uids = self._root_uids(gq)
+        if gq.recurse is not None:
+            from dgraph_tpu.query.recurse import recurse
+
+            sg.dest_uids = sg.src_uids
+            sg.dest_uids = self._apply_filter(gq.filter, sg.dest_uids)
+            recurse(self, sg)
+            return
+        sg.dest_uids = sg.src_uids
+        self._finish_level(sg, is_root=True)
+
+    def _root_uids(self, gq: dql.GraphQuery) -> np.ndarray:
+        uids: list[np.ndarray] = []
+        if gq.uids:
+            present = _known_uids(self.snap)
+            want = np.unique(np.asarray(gq.uids, dtype=np.int64))
+            uids.append(want[np.isin(want, present)] if len(present) else want)
+        for v in gq.needs_vars:
+            vv = self.vars.get(v)
+            if vv is not None and vv.uids is not None:
+                uids.append(vv.uids)
+            elif vv is not None and not vv.is_uid:
+                uids.append(np.asarray(sorted(vv.vals.keys()), dtype=np.int64))
+        if gq.func is not None:
+            uids.append(self._run_root_func(gq.func))
+        if not uids:
+            return np.zeros(0, np.int64)
+        out = uids[0]
+        for u in uids[1:]:
+            out = np.union1d(out, u)
+        return out
+
+    def _run_root_func(self, fn: dql.Function) -> np.ndarray:
+        args = self._resolve_args(fn.args)
+        if fn.is_count:
+            # eq(count(pred), n) — compare-scalar form
+            return process_task(
+                self.snap,
+                TaskQuery(fn.attr, func=(fn.name, ["__count__", int(args[0])])),
+                self.schema).dest_uids
+        if fn.is_valvar and args and isinstance(fn.args[0], dql.VarRef):
+            # eq(val(x), v): select uids whose var value compares true
+            vv = self.vars.get(fn.args[0].name)
+            if vv is None:
+                return np.zeros(0, np.int64)
+            rhs = args[1]
+            out = [u for u, val in sorted(vv.vals.items())
+                   if _compare_any(fn.name, val, rhs)]
+            return np.asarray(out, dtype=np.int64)
+        q = TaskQuery(fn.attr, func=(fn.name, args), lang=fn.lang)
+        return process_task(self.snap, q, self.schema).dest_uids
+
+    @staticmethod
+    def _resolve_args(args: list) -> list:
+        return list(args)  # VarRefs resolve at their use sites
+
+    # ---------------------------------------------------------------- levels
+
+    def _finish_level(self, sg: SubGraph, is_root: bool) -> None:
+        """Filter → order/paginate → record vars → children (ProcessGraph tail).
+
+        Root blocks filter/order/paginate their dest set; child levels already
+        applied filter + pagination per uidMatrix row in _process_children
+        (the reference's applyPagination also works per matrix row)."""
+        gq = sg.gq
+        if is_root:
+            sg.dest_uids = self._apply_filter(gq.filter, sg.dest_uids)
+        if gq.groupby is not None:
+            from dgraph_tpu.query.groupby import process_groupby
+
+            process_groupby(self, sg)
+            self._record_uid_var(gq, sg)
+            return
+        if is_root:
+            if gq.order:
+                sg.dest_uids = self._apply_order(gq, sg.dest_uids)
+            self._paginate_ordered(sg)
+        self._record_uid_var(gq, sg)
+        self._process_children(sg)
+        if gq.cascade:
+            self._cascade(sg)
+
+    def _paginate_ordered(self, sg: SubGraph) -> None:
+        gq = sg.gq
+        first = int(gq.args.get("first", 0))
+        offset = int(gq.args.get("offset", 0))
+        after = int(gq.args.get("after", 0))
+        u = sg.dest_uids
+        if after:
+            u = u[u > after] if not gq.order else np.asarray(
+                [x for x in u if x > after], dtype=np.int64)
+        if offset:
+            u = u[offset:]
+        if first > 0:
+            u = u[:first]
+        elif first < 0:
+            u = u[first:]  # negative first = last N (x/x.go:191 PageRange)
+        sg.dest_uids = u
+
+    def _process_children(self, sg: SubGraph) -> None:
+        """Expand each child over this level's DestUIDs — one device step per
+        child (reference :2081 launches goroutines; here children batch)."""
+        gq = sg.gq
+        frontier = np.sort(sg.dest_uids)
+        for cgq in self._effective_children(gq, frontier):
+            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
+            sg.children.append(child)
+            if cgq.is_uid_node or cgq.attr in ("val", "math") or \
+               cgq.attr.startswith("__agg_"):
+                self._compute_virtual_child(sg, child, frontier)
+                continue
+            tq = TaskQuery(cgq.attr, frontier=frontier, lang=cgq.lang,
+                           facet_keys=[k for _, k in (cgq.facets.keys if cgq.facets else [])]
+                           if cgq.facets is not None else [])
+            if cgq.facets is not None:
+                tq.facet_keys = tq.facet_keys or ["__all__"]
+            res = process_task(self.snap, tq, self.schema)
+            self.traversed_edges += res.traversed_edges
+            if self.traversed_edges > MAX_QUERY_EDGES:
+                raise QueryError("query exceeded edge budget (ErrTooBig)")
+            child.uid_matrix = res.uid_matrix
+            child.value_matrix = res.value_matrix
+            child.facet_matrix = res.facet_matrix
+            child.counts = res.counts
+            child.dest_uids = res.dest_uids
+            child.traversed = res.traversed_edges
+            # facet filter prunes matrix entries
+            if cgq.facets is not None and cgq.facets.filter is not None:
+                self._apply_facet_filter(child)
+            # child-level @filter + pagination act per uidMatrix row
+            if child.uid_matrix and (cgq.filter is not None or
+                                     cgq.args.get("first") or cgq.args.get("offset")):
+                self._apply_child_row_mods(child)
+            self._record_child_vars(cgq, child, frontier)
+            if cgq.children or cgq.cascade:
+                self._finish_level(child, is_root=False)
+
+    def _apply_child_row_mods(self, child: SubGraph) -> None:
+        """Filter dest uids, then prune + paginate each uidMatrix row
+        (reference: filters :1955 then applyPagination :2114 per list)."""
+        cgq = child.gq
+        dest = self._apply_filter(cgq.filter, child.dest_uids)
+        kept = set(int(x) for x in dest)
+        first = int(cgq.args.get("first", 0))
+        offset = int(cgq.args.get("offset", 0))
+        new_matrix = []
+        for i, row in enumerate(child.uid_matrix):
+            sel = [j for j, t in enumerate(row) if int(t) in kept]
+            if offset:
+                sel = sel[offset:]
+            if first > 0:
+                sel = sel[:first]
+            elif first < 0:
+                sel = sel[first:]
+            new_matrix.append(np.asarray([int(row[j]) for j in sel], dtype=np.int64))
+            if child.facet_matrix and i < len(child.facet_matrix):
+                child.facet_matrix[i] = [child.facet_matrix[i][j] for j in sel
+                                         if j < len(child.facet_matrix[i])]
+        child.uid_matrix = new_matrix
+        child.counts = [len(m) for m in new_matrix]
+        child.dest_uids = (np.unique(np.concatenate(new_matrix))
+                           if any(len(m) for m in new_matrix)
+                           else np.zeros(0, np.int64))
+
+    def _effective_children(self, gq: dql.GraphQuery, frontier: np.ndarray):
+        """expand(_all_) → concrete children (reference expandSubgraph :1736)."""
+        out = []
+        for c in gq.children:
+            if c.expand:
+                preds = self.schema.predicates() if c.expand == "_all_" else []
+                if c.expand not in ("_all_",):
+                    vv = self.vars.get(c.expand)
+                    preds = []  # expand(var) unsupported-yet: empty
+                for p in preds:
+                    sub = dql.GraphQuery(alias=p, attr=p)
+                    sub.children = list(c.children)
+                    out.append(sub)
+            else:
+                out.append(c)
+        return out
+
+    def _compute_virtual_child(self, sg: SubGraph, child: SubGraph,
+                               frontier: np.ndarray) -> None:
+        """uid / val(x) / math / min-max-sum-avg pseudo-attributes."""
+        cgq = child.gq
+        child.dest_uids = frontier
+        if cgq.is_uid_node:
+            self._record_child_vars(cgq, child, frontier)
+            return
+        if cgq.attr == "val":
+            vv = self.vars.get(cgq.val_ref)
+            if vv is not None:
+                child.value_matrix = [
+                    [vv.vals[int(u)]] if int(u) in vv.vals else [] for u in frontier]
+            return
+        if cgq.attr == "math":
+            from dgraph_tpu.query.math import eval_math
+
+            vals = eval_math(cgq.math, self.vars, frontier)
+            child.math_vals = vals
+            child.value_matrix = [
+                [vals[int(u)]] if int(u) in vals else [] for u in frontier]
+            if cgq.var_name:
+                self.vars[cgq.var_name] = VarValue(vals=vals, is_uid=False)
+            return
+        if cgq.attr.startswith("__agg_"):
+            from dgraph_tpu.query.aggregator import aggregate
+
+            op = cgq.attr[len("__agg_"):]
+            vv = self.vars.get(cgq.val_ref)
+            vals = vv.vals if vv else {}
+            # aggregate over the enclosing block's uid space when non-empty
+            keys = [int(u) for u in frontier if int(u) in vals] or list(vals)
+            child.agg_value = aggregate(op, [vals[k] for k in keys])
+            return
+
+    # ---------------------------------------------------------------- filters
+
+    def _apply_filter(self, ft: dql.FilterTree | None,
+                      frontier: np.ndarray) -> np.ndarray:
+        if ft is None or len(frontier) == 0:
+            return frontier
+        return self._eval_filter(ft, frontier)
+
+    def _eval_filter(self, ft: dql.FilterTree, frontier: np.ndarray) -> np.ndarray:
+        if ft.func is not None:
+            return self._eval_filter_func(ft.func, frontier)
+        parts = [self._eval_filter(c, frontier) for c in ft.children]
+        if ft.op == "and":
+            out = parts[0]
+            for p in parts[1:]:
+                out = np.intersect1d(out, p)
+            return out
+        if ft.op == "or":
+            out = parts[0]
+            for p in parts[1:]:
+                out = np.union1d(out, p)
+            return out
+        if ft.op == "not":
+            return np.setdiff1d(frontier, parts[0])
+        raise QueryError(f"bad filter op {ft.op}")
+
+    def _eval_filter_func(self, fn: dql.Function, frontier: np.ndarray) -> np.ndarray:
+        name = fn.name.lower()
+        if name == "uid":
+            uids, refs = dql._split_uid_args(fn.args)
+            sel = np.asarray(uids, dtype=np.int64)
+            for r in refs:
+                vv = self.vars.get(r)
+                if vv is not None and vv.uids is not None:
+                    sel = np.union1d(sel, vv.uids)
+                elif vv is not None:
+                    sel = np.union1d(sel, np.asarray(sorted(vv.vals), dtype=np.int64))
+            return np.intersect1d(frontier, sel)
+        if fn.is_valvar and fn.args and isinstance(fn.args[0], dql.VarRef):
+            vv = self.vars.get(fn.args[0].name)
+            if vv is None:
+                return np.zeros(0, np.int64)
+            rhs = fn.args[1]
+            keep = [int(u) for u in frontier
+                    if int(u) in vv.vals and _compare_any(name, vv.vals[int(u)], rhs)]
+            return np.asarray(keep, dtype=np.int64)
+        if fn.is_count:
+            # filter-level eq(count(pred), n): degree check over frontier
+            res = process_task(
+                self.snap, TaskQuery(fn.attr, frontier=frontier), self.schema)
+            n = int(fn.args[0])
+            keep = [u for u, c in zip(frontier, res.counts)
+                    if _int_cmp(name, c, n)]
+            return np.asarray(keep, dtype=np.int64)
+        if name in ("has", "uid_in", "checkpwd") or \
+           self.schema.type_of(fn.attr) not in (TypeID.UID,):
+            tid = self.schema.type_of(fn.attr)
+            if name == "has" and tid == TypeID.UID:
+                root = process_task(self.snap, TaskQuery(fn.attr, func=("has", [])),
+                                    self.schema).dest_uids
+                return np.intersect1d(frontier, root)
+            if name in ("eq", "le", "lt", "ge", "gt") and tid not in (TypeID.UID,):
+                # value compare over the frontier (device value table / host)
+                q = TaskQuery(fn.attr, frontier=frontier,
+                              func=(name, self._resolve_args(fn.args)), lang=fn.lang)
+                return process_task(self.snap, q, self.schema).dest_uids
+            if name in ("uid_in", "checkpwd"):
+                q = TaskQuery(fn.attr, frontier=frontier,
+                              func=(name, self._resolve_args(fn.args)), lang=fn.lang)
+                return process_task(self.snap, q, self.schema).dest_uids
+        # index-backed functions: run at root, intersect with frontier
+        root = self._run_root_func(fn)
+        return np.intersect1d(frontier, root)
+
+    def _apply_facet_filter(self, child: SubGraph) -> None:
+        ft = child.gq.facets.filter
+        new_matrix = []
+        for i, (uids, facets) in enumerate(zip(child.uid_matrix, child.facet_matrix)):
+            keep_idx = [j for j, f in enumerate(facets)
+                        if _facet_filter_match(ft, dict(f))]
+            new_matrix.append(np.asarray([uids[j] for j in keep_idx], dtype=np.int64))
+            child.facet_matrix[i] = [facets[j] for j in keep_idx]
+        child.uid_matrix = new_matrix
+        child.counts = [len(m) for m in new_matrix]
+        child.dest_uids = (np.unique(np.concatenate(new_matrix))
+                           if any(len(m) for m in new_matrix) else np.zeros(0, np.int64))
+
+    # ---------------------------------------------------------------- vars
+
+    def _record_uid_var(self, gq: dql.GraphQuery, sg: SubGraph) -> None:
+        if gq.var_name:
+            self.vars[gq.var_name] = VarValue(uids=np.sort(sg.dest_uids))
+
+    def _record_child_vars(self, cgq: dql.GraphQuery, child: SubGraph,
+                           frontier: np.ndarray) -> None:
+        if cgq.var_name:
+            if cgq.is_count:
+                vals = {int(u): Val(TypeID.INT, c)
+                        for u, c in zip(frontier, child.counts)}
+                self.vars[cgq.var_name] = VarValue(vals=vals, is_uid=False)
+            elif child.value_matrix:
+                vals = {int(u): vs[0]
+                        for u, vs in zip(frontier, child.value_matrix) if vs}
+                self.vars[cgq.var_name] = VarValue(vals=vals, is_uid=False)
+            else:
+                self.vars[cgq.var_name] = VarValue(uids=child.dest_uids)
+        # facet variables: var per facet key mapped over target uids
+        if cgq.facets is not None and cgq.facets.var_map:
+            for key, vname in cgq.facets.var_map.items():
+                vals: dict[int, Val] = {}
+                for uids, facets in zip(child.uid_matrix, child.facet_matrix):
+                    for u, f in zip(uids, facets):
+                        fv = dict(f).get(key)
+                        if fv is not None:
+                            vals[int(u)] = fv
+                self.vars[vname] = VarValue(vals=vals, is_uid=False)
+
+    # ---------------------------------------------------------------- order
+
+    def _apply_order(self, gq: dql.GraphQuery, uids: np.ndarray) -> np.ndarray:
+        """Multi-key order (reference worker/sort.go; host-side over snapshot
+        values — index-bucket walk is an optimization applied when sortable).
+
+        Stable sorts applied from the last key to the first give multi-key
+        semantics; uids with a missing sort value always sink to the end,
+        regardless of direction (the reference's sort treats them the same)."""
+        ordered = [int(u) for u in uids]
+        for o in reversed(gq.order):
+            present = [(self._order_key(o, u), u) for u in ordered]
+            have = [(k, u) for k, u in present if k is not None]
+            missing = [u for k, u in present if k is None]
+            have.sort(key=lambda t: t[0], reverse=o.desc)
+            ordered = [u for _, u in have] + missing
+        return np.asarray(ordered, dtype=np.int64)
+
+    def _order_key(self, o: dql.Order, uid: int):
+        if o.is_val:
+            vv = self.vars.get(o.attr)
+            if vv is None or uid not in vv.vals:
+                return None
+            return sort_key(vv.vals[uid])
+        pd = self.snap.pred(o.attr)
+        if pd is None:
+            return None
+        if o.lang:
+            lv = pd.lang_values.get(uid, {})
+            v = lv.get(o.lang)
+        else:
+            v = pd.host_values.get(uid)
+        return sort_key(v) if v is not None else None
+
+    # ---------------------------------------------------------------- cascade
+
+    def _cascade(self, sg: SubGraph) -> None:
+        """@cascade: keep uids with a non-empty result in EVERY child."""
+        keep = set(int(u) for u in sg.dest_uids)
+        frontier = np.sort(sg.dest_uids)
+        for child in sg.children:
+            if child.gq.is_uid_node or child.gq.attr in ("val", "math") or \
+               child.gq.attr.startswith("__agg_") or child.gq.is_count:
+                continue
+            for i, u in enumerate(frontier):
+                hit = (i < len(child.uid_matrix) and len(child.uid_matrix[i])) or \
+                      (i < len(child.value_matrix) and len(child.value_matrix[i]))
+                if not hit:
+                    keep.discard(int(u))
+        if len(keep) != len(sg.dest_uids):
+            sg.dest_uids = np.asarray(sorted(keep), dtype=np.int64)
+            # re-run children on the pruned frontier for consistent output
+            sg.children = []
+            self._process_children(sg)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _block_needs(gq: dql.GraphQuery) -> list[str]:
+    out = list(gq.all_needs())
+
+    def walk(g: dql.GraphQuery):
+        for c in g.children:
+            out.extend(c.needs_vars)
+            dql.collect_filter_vars(c.filter, out)
+            walk(c)
+
+    walk(gq)
+    defines = _block_defines(gq)
+    return [v for v in out if v not in defines]
+
+
+def _block_defines(gq: dql.GraphQuery) -> set[str]:
+    out = set()
+
+    def walk(g: dql.GraphQuery):
+        if g.var_name:
+            out.add(g.var_name)
+        if g.facets is not None:
+            out.update(g.facets.var_map.values())
+        for c in g.children:
+            walk(c)
+
+    walk(gq)
+    return out
+
+
+def _known_uids(snap: GraphSnapshot) -> np.ndarray:
+    """All uids present anywhere in the snapshot (subjects or objects).
+    Computed once per snapshot and cached — uid(...) validation runs per query."""
+    cached = getattr(snap, "_known_uids_cache", None)
+    if cached is not None:
+        return cached
+    parts = []
+    for pd in snap.preds.values():
+        parts.append(pd.has_subjects().astype(np.int64))
+        if pd.csr is not None:
+            parts.append(np.asarray(pd.csr.indices).astype(np.int64))
+    out = np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
+    snap._known_uids_cache = out
+    return out
+
+
+def _compare_any(op: str, a: Val, b) -> bool:
+    rhs = b if isinstance(b, Val) else _val_from_literal(b, a.tid)
+    try:
+        return compare_vals(op, a, rhs)
+    except ValueError:
+        return False
+
+
+def _val_from_literal(x, tid: TypeID) -> Val:
+    if isinstance(x, bool):
+        return Val(TypeID.BOOL, x)
+    if isinstance(x, int):
+        v = Val(TypeID.INT, x)
+    elif isinstance(x, float):
+        v = Val(TypeID.FLOAT, x)
+    else:
+        v = Val(TypeID.STRING, str(x))
+    try:
+        return convert(v, tid) if tid not in (TypeID.DEFAULT,) else v
+    except ValueError:
+        return v
+
+
+def _facet_filter_match(ft: dql.FilterTree, facets: dict) -> bool:
+    """Evaluate a facet filter tree against one edge's facets
+    (reference: facets filter application in query/query.go facetsFilter)."""
+    if ft.func is not None:
+        fn = ft.func
+        fv = facets.get(fn.attr)
+        if fv is None:
+            return False
+        if fn.name.lower() == "has":
+            return True
+        return _compare_any(fn.name.lower(), fv, fn.args[0] if fn.args else None)
+    parts = (_facet_filter_match(c, facets) for c in ft.children)
+    if ft.op == "and":
+        return all(parts)
+    if ft.op == "or":
+        return any(parts)
+    if ft.op == "not":
+        return not _facet_filter_match(ft.children[0], facets)
+    return False
+
+
+def _int_cmp(op: str, a: int, b: int) -> bool:
+    return {"eq": a == b, "le": a <= b, "lt": a < b, "ge": a >= b, "gt": a > b}[op]
+
+

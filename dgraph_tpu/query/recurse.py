@@ -1,0 +1,84 @@
+"""@recurse: iterative frontier expansion to fixed depth or exhaustion.
+
+Reference semantics: query/recurse.go — expandRecurse (:31-177): loop per
+level, spawning copies of the original children as the new frontier's
+SubGraphs (:157-164); loop prevention via a reach-set of (attr, from, to)
+edges (:129-141) unless `loop: true`; bounded by the 1e6 edge budget (:167).
+
+TPU shape: each level is one batched CSR expand per traversed predicate; the
+reach-set is a host-side visited-edge filter between device steps (the pure
+device SpMSpV variant with visited bitmaps lives in ops/traversal.py and is
+used by the benchmarks; this path keeps full output semantics — per-level
+nested results with value children).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dgraph_tpu.query import dql
+from dgraph_tpu.query.engine import MAX_QUERY_EDGES, QueryError, SubGraph
+from dgraph_tpu.query.task import TaskQuery, process_task
+from dgraph_tpu.utils.types import TypeID
+
+
+def recurse(ex, sg: SubGraph) -> None:
+    gq = sg.gq
+    spec = gq.recurse
+    depth = spec.depth if spec.depth > 0 else 64  # "until exhaustion" cap
+    uid_children = [c for c in gq.children
+                    if ex.schema.type_of(c.attr) == TypeID.UID
+                    or (ex.snap.pred(c.attr) is not None
+                        and ex.snap.pred(c.attr).csr is not None)
+                    or c.attr.startswith("~")]
+    val_children = [c for c in gq.children if c not in uid_children]
+    seen_edges: set[tuple[str, int, int]] = set()
+    edges = 0
+
+    def build_level(frontier: np.ndarray, remaining: int) -> list[SubGraph]:
+        nonlocal edges
+        out: list[SubGraph] = []
+        frontier = np.sort(frontier)
+        # value/scalar children appear at every level
+        for cgq in val_children:
+            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
+            res = process_task(ex.snap, TaskQuery(cgq.attr, frontier=frontier,
+                                                  lang=cgq.lang), ex.schema)
+            child.value_matrix = res.value_matrix
+            child.uid_matrix = res.uid_matrix
+            child.counts = res.counts
+            child.dest_uids = res.dest_uids
+            out.append(child)
+        if remaining <= 0:
+            return out
+        for cgq in uid_children:
+            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
+            res = process_task(ex.snap, TaskQuery(cgq.attr, frontier=frontier),
+                               ex.schema)
+            edges += res.traversed_edges
+            if edges > MAX_QUERY_EDGES:
+                raise QueryError("recurse exceeded edge budget (ErrTooBig)")
+            # loop prevention: drop edges already reached
+            matrix = []
+            for u, targets in zip(frontier, res.uid_matrix):
+                kept = []
+                for t in targets:
+                    ek = (cgq.attr, int(u), int(t))
+                    if not spec.allow_loop and ek in seen_edges:
+                        continue
+                    seen_edges.add(ek)
+                    kept.append(int(t))
+                matrix.append(np.asarray(kept, dtype=np.int64))
+            child.uid_matrix = matrix
+            child.counts = [len(m) for m in matrix]
+            child.dest_uids = (np.unique(np.concatenate(matrix))
+                               if any(len(m) for m in matrix)
+                               else np.zeros(0, np.int64))
+            child.dest_uids = ex._apply_filter(cgq.filter, child.dest_uids)
+            if len(child.dest_uids):
+                child.children = build_level(child.dest_uids, remaining - 1)
+            out.append(child)
+        return out
+
+    sg.children = build_level(sg.dest_uids, depth)
+    ex._record_uid_var(gq, sg)

@@ -1,0 +1,442 @@
+"""process_task: execute one (predicate, frontier, function) task on a snapshot.
+
+Reference semantics: worker/task.go — processTask (:605) → helpProcessTask
+(:635) dispatches on posting-list kind: handleValuePostings (:319, value
+predicates: fetch/convert/compare) or handleUidPostings (:476, uid/index/
+reverse/count lists: per-uid iteration intersected with the frontier).
+Function taxonomy at :211-271: eq/le/lt/ge/gt (indexed, via
+worker/tokens.go:124 getInequalityTokens), has, uid_in, regexp (trigram index
++ automaton :768), term (anyofterms/allofterms), full-text, geo (:921),
+compare-scalar over the count index (:1498), password. Lossy tokenizers
+require post-filtering candidates against stored values (:837-919).
+
+TPU redesign: the per-uid pointer walk becomes one batched CSR gather
+(ops.csr.expand) over the predicate's HBM-resident adjacency; index functions
+select token rows host-side (the token table is tiny) and the device unions /
+intersects the token rows' uid lists. The uidMatrix result stays in CSR form
+(flat targets + per-source counts) end to end.
+
+This module is the dispatch seam the north star required: its result uid sets
+are diffable 1:1 against the reference's processTask.
+"""
+
+from __future__ import annotations
+
+import bisect
+import re as remod
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax.numpy as jnp
+
+from dgraph_tpu.ops import csr as csrops
+from dgraph_tpu.ops import uidset as us
+from dgraph_tpu.storage.csr_build import GraphSnapshot, PredCSR, PredData, TokenIndex
+from dgraph_tpu.utils import geo as geomod
+from dgraph_tpu.utils import tok as tokmod
+from dgraph_tpu.utils.schema import SchemaState
+from dgraph_tpu.utils.types import (TypeID, Val, compare_vals, convert,
+                                    verify_password)
+
+
+class TaskError(ValueError):
+    pass
+
+
+@dataclass
+class TaskQuery:
+    """One execution task (reference: intern.Query, protos/internal.proto:38)."""
+
+    attr: str
+    frontier: np.ndarray | None = None      # subject uids; None = root function
+    func: tuple[str, list] | None = None    # (name, args) root/filter function
+    reverse: bool = False                   # traverse ReverseKey space (~attr)
+    lang: str = ""
+    facet_keys: list[str] = field(default_factory=list)
+    first: int = 0                          # per-uid result truncation
+
+
+@dataclass
+class TaskResult:
+    """Reference: intern.Result (protos/internal.proto:69)."""
+
+    uid_matrix: list[np.ndarray] = field(default_factory=list)
+    value_matrix: list[list[Val]] = field(default_factory=list)
+    facet_matrix: list[list[tuple]] = field(default_factory=list)
+    counts: list[int] = field(default_factory=list)
+    dest_uids: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    traversed_edges: int = 0
+
+
+# ---------------------------------------------------------------------------
+# frontier <-> CSR row mapping
+# ---------------------------------------------------------------------------
+
+def rows_for_uids(csr: PredCSR, uids: np.ndarray) -> np.ndarray:
+    """Map subject uids to CSR rows; missing subjects → sentinel."""
+    subjects = np.asarray(csr.subjects)
+    pos = np.searchsorted(subjects, uids)
+    pos_c = np.clip(pos, 0, max(len(subjects) - 1, 0))
+    ok = len(subjects) > 0 and subjects[pos_c] == uids
+    return np.where(ok, pos_c, us.SENTINEL32).astype(np.int32)
+
+
+def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np.ndarray], int]:
+    """uidMatrix for a frontier over one adjacency; device gather + host split."""
+    if len(uids) == 0 or csr is None:
+        return [np.zeros(0, np.int64) for _ in range(len(uids))], 0
+    rows = rows_for_uids(csr, uids)
+    cap = 1 << max(int(np.ceil(np.log2(max(csr.num_edges, 1) + 1))), 4)
+    res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=cap)
+    total = int(res.total)
+    if total > cap:  # capacity-class retry (cannot happen: cap >= num_edges)
+        res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=total)
+    targets = np.asarray(res.targets)[:total].astype(np.int64)
+    counts = np.asarray(res.counts)[: len(uids)]
+    offs = np.zeros(len(uids) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offs[1:])
+    matrix = [targets[offs[i] : offs[i + 1]] for i in range(len(uids))]
+    if first > 0:
+        matrix = [m[:first] for m in matrix]
+    elif first < 0:
+        matrix = [m[first:] for m in matrix]
+    return matrix, total
+
+
+def _merge_matrix(matrix: list[np.ndarray]) -> np.ndarray:
+    if not matrix:
+        return np.zeros(0, np.int64)
+    return np.unique(np.concatenate(matrix)) if any(len(m) for m in matrix) else np.zeros(0, np.int64)
+
+
+# ---------------------------------------------------------------------------
+# index helpers
+# ---------------------------------------------------------------------------
+
+def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
+    """Union of uid lists of the chosen token rows (device merge)."""
+    if not rows:
+        return np.zeros(0, np.int64)
+    rows_arr = us.make_set(np.asarray(rows, dtype=np.int32), capacity=len(rows))
+    cap = int(np.asarray(ti.indptr)[-1]) or 1
+    dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr, out_cap=cap)
+    return us.to_numpy(dest).astype(np.int64)
+
+
+def _index_uids_intersect_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
+    """Intersection of uid lists of the chosen token rows (allofterms)."""
+    if not rows:
+        return np.zeros(0, np.int64)
+    indptr = np.asarray(ti.indptr)
+    out = None
+    for r in rows:
+        u = np.asarray(ti.uids)[indptr[r] : indptr[r + 1]].astype(np.int64)
+        out = u if out is None else np.intersect1d(out, u)
+        if len(out) == 0:
+            break
+    return out
+
+
+def _tokens_for(pd: PredData, schema: SchemaState, v: Val,
+                prefer: tuple[str, ...]) -> tuple[str, list[bytes]]:
+    """Pick a tokenizer (preference order) and produce query tokens."""
+    names = schema.tokenizer_names(pd.attr)
+    for p in prefer:
+        if p in names and p in pd.indexes:
+            tz = tokmod.get(p)
+            sv = convert(v, tz.type_id) if v.tid != tz.type_id else v
+            return p, [t[1:] for t in tz.tokens(sv)]  # strip ident byte: index rows store it stripped
+    raise TaskError(f"predicate {pd.attr} needs @index({'|'.join(prefer)})")
+
+
+def _ineq_rows(ti: TokenIndex, op: str, token: bytes) -> list[int]:
+    """Token rows satisfying an inequality against a *sortable* tokenizer
+    (reference: worker/tokens.go:124 getInequalityTokens — walks the sorted
+    index bucket space). Terms are byte-ordered == value-ordered."""
+    i = bisect.bisect_left(ti.terms, token)
+    if op == "eq":
+        return [i] if i < len(ti.terms) and ti.terms[i] == token else []
+    if op in ("lt", "le"):
+        hi = bisect.bisect_right(ti.terms, token)
+        if op == "lt" and i < len(ti.terms) and ti.terms[i] == token:
+            return list(range(0, i))
+        return list(range(0, hi))
+    if op in ("gt", "ge"):
+        if op == "ge":
+            return list(range(i, len(ti.terms)))
+        hi = bisect.bisect_right(ti.terms, token)
+        return list(range(hi, len(ti.terms)))
+    raise TaskError(f"bad inequality {op}")
+
+
+def _post_filter_compare(pd: PredData, uids: np.ndarray, op: str, v: Val) -> np.ndarray:
+    """Exact re-check for lossy tokenizers (reference worker/task.go:837-919)."""
+    keep = []
+    for u in uids.tolist():
+        sv = pd.host_values.get(int(u))
+        vals = [sv] if sv is not None else []
+        if not vals and int(u) in pd.lang_values:
+            vals = list(pd.lang_values[int(u)].values())
+        if any(compare_vals(op, x, v) for x in vals if x is not None):
+            keep.append(u)
+    return np.asarray(keep, dtype=np.int64)
+
+
+def _eq_candidates(pd: PredData, schema, v: Val) -> np.ndarray:
+    name, toks = _tokens_for(
+        pd, schema, v, ("int", "float", "bool", "exact", "hash", "term",
+                        "year", "month", "day", "hour"))
+    ti = pd.indexes[name]
+    rows = [r for t in toks if (r := ti.term_row(t)) >= 0]
+    uids = _index_uids_for_rows(ti, rows)
+    if tokmod.get(name).lossy:
+        uids = _post_filter_compare(pd, uids, "eq", v)
+    return uids
+
+
+# ---------------------------------------------------------------------------
+# main dispatch
+# ---------------------------------------------------------------------------
+
+def process_task(snap: GraphSnapshot, q: TaskQuery,
+                 schema: SchemaState) -> TaskResult:
+    """Execute one task against a snapshot (reference worker/task.go:605)."""
+    attr = q.attr
+    if attr.startswith("~"):
+        attr = attr[1:]
+        q = TaskQuery(attr, q.frontier, q.func, True, q.lang, q.facet_keys, q.first)
+    pd = snap.pred(attr) or PredData(attr, schema.type_of(attr))
+    res = TaskResult()
+
+    fname = q.func[0].lower() if q.func else None
+    args = q.func[1] if q.func else []
+
+    # ---- root functions (no frontier): produce dest_uids ------------------
+    if q.frontier is None:
+        res.dest_uids = _root_func(snap, pd, schema, fname, args, q)
+        return res
+
+    frontier = np.asarray(q.frontier, dtype=np.int64)
+
+    # ---- frontier + uid-edge predicate: expand ----------------------------
+    entry_tid = pd.type_id
+    if entry_tid == TypeID.UID or pd.csr is not None or q.reverse:
+        csr = pd.rev_csr if q.reverse else pd.csr
+        matrix, traversed = _expand_csr(csr, frontier, q.first) if csr is not None else (
+            [np.zeros(0, np.int64) for _ in frontier], 0)
+        res.uid_matrix = matrix
+        res.counts = [len(m) for m in matrix]
+        res.traversed_edges = traversed
+        if q.facet_keys:
+            res.facet_matrix = [
+                [pd.facets.get((int(s), int(o)), ()) for o in m]
+                for s, m in zip(frontier, matrix)]
+        # filter-function applied over the frontier itself (uid_in)
+        if fname == "uid_in":
+            want = int(str(args[0]), 0)  # accepts decimal and 0x-hex uid forms
+            keep = np.asarray([want in m for m in matrix], dtype=bool)
+            res.dest_uids = frontier[keep]
+        else:
+            res.dest_uids = _merge_matrix(matrix)
+        return res
+
+    # ---- frontier + value predicate: fetch values / compare filter --------
+    res.value_matrix = []
+    for u in frontier.tolist():
+        vals: list[Val] = []
+        if q.lang:
+            lv = pd.lang_values.get(int(u), {})
+            if q.lang in lv:
+                vals = [lv[q.lang]]
+        else:
+            sv = pd.host_values.get(int(u))
+            if sv is not None:
+                vals = [sv]
+        res.value_matrix.append(vals)
+    if fname in ("eq", "le", "lt", "ge", "gt"):
+        v = _parse_arg_val(pd, schema, args[0])
+        keep = np.asarray(
+            [any(compare_vals(fname, x, v) for x in vals) for vals in res.value_matrix],
+            dtype=bool)
+        res.dest_uids = frontier[keep]
+    elif fname == "has":
+        keep = np.asarray([len(vals) > 0 for vals in res.value_matrix], dtype=bool)
+        res.dest_uids = frontier[keep]
+    elif fname == "checkpwd":
+        keep = []
+        for u, vals in zip(frontier.tolist(), res.value_matrix):
+            ok = bool(vals) and verify_password(str(args[0]), str(vals[0].value))
+            keep.append(ok)
+        res.dest_uids = frontier[np.asarray(keep, dtype=bool)]
+        res.value_matrix = [[Val(TypeID.BOOL, k)] for k in keep]
+    else:
+        res.dest_uids = frontier[
+            np.asarray([len(v) > 0 for v in res.value_matrix], dtype=bool)]
+    return res
+
+
+def _parse_arg_val(pd: PredData, schema, arg) -> Val:
+    if isinstance(arg, Val):
+        return arg
+    tid = pd.type_id if pd.type_id != TypeID.DEFAULT else TypeID.STRING
+    if tid == TypeID.UID:
+        tid = TypeID.STRING
+    return convert(Val(TypeID.STRING, str(arg)), tid)
+
+
+def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
+               args: list, q: TaskQuery) -> np.ndarray:
+    if fname is None:
+        raise TaskError("root query needs a function or explicit uids")
+    if fname == "uid":
+        return np.unique(np.asarray([int(a) for a in args], dtype=np.int64))
+    if fname == "has":
+        return pd.has_subjects().astype(np.int64)
+
+    if fname in ("le", "lt", "ge", "gt", "eq"):
+        # compare-scalar over count index: eq(count(pred), N)
+        if args and isinstance(args[0], str) and args[0] == "__count__":
+            return _count_func(pd, fname, int(args[1]))
+        v = _parse_arg_val(pd, schema, args[0])
+        if fname == "eq":
+            out = [_eq_candidates(pd, schema, vv) for vv in
+                   [v] + [_parse_arg_val(pd, schema, a) for a in args[1:]]]
+            return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int64)
+        name, toks = _tokens_for(pd, schema, v, ("int", "float", "exact",
+                                                 "year", "month", "day", "hour"))
+        ti = pd.indexes[name]
+        rows = _ineq_rows(ti, fname, toks[0])
+        uids = _index_uids_for_rows(ti, rows)
+        if tokmod.get(name).lossy:
+            uids = _post_filter_compare(pd, uids, fname, v)
+        return uids
+
+    if fname in ("anyofterms", "allofterms"):
+        return _terms_func(pd, schema, fname, str(args[0]), "term")
+    if fname in ("anyoftext", "alloftext"):
+        return _terms_func(pd, schema,
+                           "anyofterms" if fname == "anyoftext" else "allofterms",
+                           str(args[0]), "fulltext")
+    if fname == "regexp":
+        return _regexp_func(pd, str(args[0]), str(args[1]) if len(args) > 1 else "")
+    if fname in ("near", "within", "contains", "intersects"):
+        return _geo_func(pd, fname, args)
+    if fname == "uid_in":
+        raise TaskError("uid_in is not a root function")
+    raise TaskError(f"unknown function {fname!r}")
+
+
+def _count_func(pd: PredData, op: str, n: int) -> np.ndarray:
+    """Compare-scalar on degree (reference countParams.evaluate :1498; the
+    count index becomes a device degree reduction over the CSR)."""
+    if pd.csr is None:
+        return np.zeros(0, np.int64)
+    indptr = np.asarray(pd.csr.indptr)
+    subjects = np.asarray(pd.csr.subjects).astype(np.int64)
+    deg = indptr[1:] - indptr[:-1]
+    mask = {"eq": deg == n, "le": deg <= n, "lt": deg < n,
+            "ge": deg >= n, "gt": deg > n}[op]
+    return subjects[mask]
+
+
+def _terms_func(pd: PredData, schema, fname: str, text: str, tokname: str) -> np.ndarray:
+    ti = pd.indexes.get(tokname)
+    if ti is None:
+        raise TaskError(f"predicate {pd.attr} needs @index({tokname})")
+    tz = tokmod.get(tokname)
+    toks = [t[1:] for t in tz.tokens(Val(TypeID.STRING, text))]
+    rows = [r for t in toks if (r := ti.term_row(t)) >= 0]
+    if fname == "allofterms":
+        if len(rows) != len(toks):
+            return np.zeros(0, np.int64)
+        return _index_uids_intersect_rows(ti, rows)
+    return _index_uids_for_rows(ti, rows)
+
+
+def _regexp_func(pd: PredData, pattern: str, flags: str) -> np.ndarray:
+    """Trigram-index candidates + exact automaton post-filter
+    (reference worker/task.go:768-835, worker/trigram.go:36)."""
+    ti = pd.indexes.get("trigram")
+    if ti is None:
+        raise TaskError(f"predicate {pd.attr} needs @index(trigram)")
+    rx = remod.compile(pattern, remod.IGNORECASE if "i" in flags else 0)
+    # candidate trigrams: any literal 3-gram required by the pattern; fall back
+    # to scanning every indexed uid when the pattern has no required literal.
+    # Case-insensitive patterns can't prune by literal trigrams (the index
+    # stores raw-case trigrams), so they take the full-scan path.
+    literals = _required_trigrams(pattern) if "i" not in flags else []
+    if literals:
+        rows = [r for t in literals if (r := ti.term_row(t.encode())) >= 0]
+        cands = _index_uids_intersect_rows(ti, rows) if rows and len(rows) == len(literals) \
+            else _index_uids_for_rows(ti, rows)
+        if not rows:
+            cands = np.zeros(0, np.int64)
+    else:
+        nrows = max(len(ti.terms), 0)
+        cands = _index_uids_for_rows(ti, list(range(nrows)))
+    keep = []
+    for u in cands.tolist():
+        sv = pd.host_values.get(int(u))
+        vals = [sv] if sv is not None else list(pd.lang_values.get(int(u), {}).values())
+        if any(v is not None and rx.search(str(v.value)) for v in vals):
+            keep.append(u)
+    return np.asarray(keep, dtype=np.int64)
+
+
+def _required_trigrams(pattern: str) -> list[str]:
+    """Literal trigrams that every match must contain (simplified codesearch
+    query planning): longest literal run outside character classes/operators."""
+    runs, cur = [], []
+    escaped = False
+    for c in pattern:
+        if escaped:
+            cur.append(c)
+            escaped = False
+        elif c == "\\":
+            escaped = True
+        elif c in ".*+?()[]{}|^$":
+            if c in "*?|":  # preceding char is optional/alternated — drop it
+                if cur:
+                    cur.pop()
+            runs.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    runs.append("".join(cur))
+    best = max(runs, key=len, default="")
+    return [best[i : i + 3] for i in range(len(best) - 2)] if len(best) >= 3 else []
+
+
+def _geo_func(pd: PredData, fname: str, args: list) -> np.ndarray:
+    ti = pd.indexes.get("geo")
+    if ti is None:
+        raise TaskError(f"predicate {pd.attr} needs @index(geo)")
+    g = args[0] if isinstance(args[0], geomod.Geom) else geomod.parse_geojson(args[0])
+    radius = float(args[1]) if fname == "near" and len(args) > 1 else None
+    qtoks = geomod.query_tokens(g, radius)
+    # probe covers and all their indexed ancestors/descendants
+    rows = set()
+    for t in qtoks:
+        for p in range(geomod.MIN_PRECISION, len(t) + 1):
+            r = ti.term_row(t[:p].encode())
+            if r >= 0:
+                rows.add(r)
+        # descendants: terms with prefix t
+        i = bisect.bisect_left(ti.terms, t.encode())
+        while i < len(ti.terms) and ti.terms[i].startswith(t.encode()):
+            rows.add(i)
+            i += 1
+    cands = _index_uids_for_rows(ti, sorted(rows))
+    keep = []
+    for u in cands.tolist():
+        sv = pd.host_values.get(int(u))
+        if sv is None:
+            continue
+        stored = sv.value
+        ok = {"near": lambda: geomod.near(stored, g.coords if g.kind == "Point" else next(iter(g.points())), radius or 0.0),
+              "within": lambda: geomod.within(stored, g),
+              "contains": lambda: geomod.contains(stored, g),
+              "intersects": lambda: geomod.intersects(stored, g)}[fname]()
+        if ok:
+            keep.append(u)
+    return np.asarray(keep, dtype=np.int64)

@@ -1,0 +1,195 @@
+"""DQL parser + RDF parser (reference: gql/parser_test.go, rdf/parse_test.go)."""
+
+import pytest
+
+from dgraph_tpu.query import dql, rdf
+from dgraph_tpu.utils.types import TypeID
+
+
+def test_basic_query():
+    req = dql.parse('{ me(func: eq(name, "alice")) { name age friend { name } } }')
+    q = req.queries[0]
+    assert q.alias == "me" and q.func.name == "eq" and q.func.attr == "name"
+    assert q.func.args == ["alice"]
+    kids = [c.attr for c in q.children]
+    assert kids == ["name", "age", "friend"]
+    assert q.children[2].children[0].attr == "name"
+
+
+def test_uid_root_and_pagination():
+    req = dql.parse("{ q(func: uid(0x1, 2, 0xff), first: 5, offset: 2) { uid } }")
+    q = req.queries[0]
+    assert q.uids == [1, 2, 255]
+    assert q.args == {"first": 5, "offset": 2}
+    assert q.children[0].is_uid_node
+
+
+def test_filters():
+    req = dql.parse('''{
+      q(func: has(friend)) @filter(eq(age, 25) and (anyofterms(name, "a b") or not uid(0x5))) {
+        name
+      }
+    }''')
+    f = req.queries[0].filter
+    assert f.op == "and"
+    assert f.children[0].func.name == "eq"
+    assert f.children[1].op == "or"
+    assert f.children[1].children[1].op == "not"
+
+
+def test_count_and_alias():
+    req = dql.parse("{ q(func: has(friend)) { total: count(friend) count(uid) n: name } }")
+    c0, c1, c2 = req.queries[0].children
+    assert c0.is_count and c0.attr == "friend" and c0.alias == "total"
+    assert c1.is_count and c1.is_uid_node
+    assert c2.alias == "n" and c2.attr == "name"
+
+
+def test_vars_and_valvars():
+    req = dql.parse("""{
+      A as var(func: has(friend)) { x as age }
+      q(func: uid(A), orderasc: val(x)) { uid age: val(x) }
+    }""")
+    v, q = req.queries
+    assert v.var_name == "A" and v.attr == "var"
+    assert v.children[0].var_name == "x"
+    assert q.needs_vars == ["A"]
+    assert q.order[0].is_val and q.order[0].attr == "x"
+    assert q.children[1].val_ref == "x"
+
+
+def test_count_func_at_root():
+    req = dql.parse("{ q(func: eq(count(friend), 2)) { uid } }")
+    fn = req.queries[0].func
+    assert fn.is_count and fn.attr == "friend" and fn.args == [2]
+
+
+def test_recurse_groupby_directives():
+    req = dql.parse("""{
+      q(func: uid(0x1)) @recurse(depth: 3, loop: true) { friend name }
+      g(func: has(friend)) @groupby(age) { count(uid) }
+    }""")
+    r, g = req.queries
+    assert r.recurse.depth == 3 and r.recurse.allow_loop
+    assert g.groupby.attrs == [("", "age", "")]
+
+
+def test_shortest_block():
+    req = dql.parse("""{
+      path as shortest(from: 0x1, to: 0x4, numpaths: 2) { friend @facets(weight) }
+      path(func: uid(path)) { name }
+    }""")
+    sp = req.queries[0]
+    assert sp.shortest.from_ == 1 and sp.shortest.to == 4 and sp.shortest.numpaths == 2
+    assert sp.children[0].facets.keys == [("weight", "weight")]
+    assert req.queries[1].needs_vars == ["path"]
+
+
+def test_facets_variants():
+    req = dql.parse("""{
+      q(func: uid(1)) {
+        friend @facets { name }
+        knows @facets(w: weight, since) { name }
+        likes @facets(eq(close, true)) { name }
+        rated @facets(orderasc: rating) { name }
+        f2 @facets(w as weight) { name }
+      }
+    }""")
+    ch = req.queries[0].children
+    assert ch[0].facets is not None and ch[0].facets.keys == []
+    assert ch[1].facets.keys == [("w", "weight"), ("since", "since")]
+    assert ch[2].facets.filter.func.name == "eq"
+    assert ch[3].facets.order == [("rating", False)]
+    assert ch[4].facets.var_map == {"weight": "w"}
+
+
+def test_lang_tags():
+    req = dql.parse("{ q(func: uid(1)) { name@en name@en:fr friend { name } } }")
+    c0, c1, _ = req.queries[0].children
+    assert c0.lang == "en" and c1.langs == ["en", "fr"]
+
+
+def test_math_and_aggs():
+    req = dql.parse("""{
+      var(func: has(friend)) { a as age b as count(friend) }
+      q(func: uid(1)) {
+        total: math(a + b * 2)
+        mn: min(val(a)) mx: max(val(a)) s: sum(val(b)) av: avg(val(a))
+      }
+    }""")
+    q = req.queries[1]
+    m = q.children[0].math
+    assert m.op == "+" and m.children[1].op == "*"
+    assert set(q.children[0].needs_vars) == {"a", "b"}
+    assert [c.attr for c in q.children[1:]] == ["__agg_min", "__agg_max", "__agg_sum", "__agg_avg"]
+
+
+def test_graphql_variables():
+    req = dql.parse(
+        'query test($name: string, $age: int = 30) { q(func: eq(name, $name)) '
+        '@filter(le(age, $age)) { uid } }',
+        gql_vars={"$name": "bob"})
+    q = req.queries[0]
+    assert q.func.args == ["bob"]
+    assert q.filter.func.args == [30]
+    with pytest.raises(dql.ParseError, match="not supplied"):
+        dql.parse("query t($x: int) { q(func: uid($x)) { uid } }")
+
+
+def test_fragments():
+    req = dql.parse("""
+      query {
+        q(func: uid(1)) { ...common friend { ...common } }
+      }
+      fragment common { name age }
+    """)
+    q = req.queries[0]
+    assert [c.attr for c in q.children] == ["name", "age", "friend"]
+    assert [c.attr for c in q.children[2].children] == ["name", "age"]
+
+
+def test_expand_all():
+    req = dql.parse("{ q(func: uid(1)) { expand(_all_) { name } } }")
+    assert req.queries[0].children[0].expand == "_all_"
+
+
+def test_regex_function():
+    req = dql.parse('{ q(func: regexp(name, /^ali.*e$/i)) { uid } }')
+    fn = req.queries[0].func
+    assert fn.name == "regexp" and fn.args == ["^ali.*e$", "i"]
+
+
+def test_mutation_block():
+    req = dql.parse('''{
+      set {
+        _:a <name> "Alice" .
+        _:a <friend> <0x2> .
+      }
+    }''')
+    assert req.mutations[0]["op"] == "set"
+    nquads = rdf.parse(req.mutations[0]["rdf"])
+    assert nquads[0].subject == "_:a" and nquads[0].object_value.value == "Alice"
+    assert nquads[1].object_id == "0x2"
+
+
+def test_rdf_typed_literals_and_facets():
+    nq = rdf.parse_line('<0x1> <age> "25"^^<xs:int> .')
+    assert nq.object_value.tid == TypeID.INT and nq.object_value.value == 25
+    nq = rdf.parse_line('<0x1> <name> "chat"@fr .')
+    assert nq.lang == "fr"
+    nq = rdf.parse_line('<0x1> <friend> <0x2> (weight=0.5, rel="close") .')
+    assert dict((k, v.value) for k, v in nq.facets) == {"weight": 0.5, "rel": "close"}
+    nq = rdf.parse_line('<0x1> <friend> * .')
+    assert nq.star
+    nq = rdf.parse_line('<0x1> * * .')
+    assert nq.predicate == "*" and nq.star
+    with pytest.raises(rdf.RDFError):
+        rdf.parse_line("<0x1> <p> .")
+    assert rdf.parse_line("# comment") is None
+
+
+def test_schema_block():
+    req = dql.parse("{ schema(pred: [name, age]) { type index } }")
+    assert req.schema_request == ["name", "age"]
+    req = dql.parse("{ schema { } }")  # all predicates
+    assert req.schema_request == []

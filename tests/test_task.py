@@ -1,0 +1,170 @@
+"""process_task function taxonomy vs hand-computed ground truth.
+
+Mirrors the reference's worker/worker_test.go (processTask cases over an
+embedded store, SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.storage import index as idx
+from dgraph_tpu.storage.csr_build import build_snapshot
+from dgraph_tpu.storage.postings import DirectedEdge
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.query.task import TaskError, TaskQuery, process_task
+from dgraph_tpu.utils.schema import parse_schema
+from dgraph_tpu.utils.types import TypeID, Val, hash_password
+
+
+@pytest.fixture(scope="module")
+def snap_env():
+    s = Store()
+    schema_text = """
+        friend: uid @reverse @count .
+        name: string @index(term, exact, trigram) .
+        age: int @index(int) .
+        bio: string @index(fulltext) .
+        loc: geo @index(geo) .
+        pass: password .
+    """
+    for e in parse_schema(schema_text):
+        s.set_schema(e)
+    people = {
+        1: ("alice jones", 25, "loves fast cars and racing"),
+        2: ("bob smith", 32, "enjoys cooking italian food"),
+        3: ("carol jones", 25, "cars are my passion"),
+        4: ("dave stone", 40, "hiking in the mountains"),
+        5: ("eve adams", 19, "food blogger and chef"),
+    }
+    for uid, (nm, age, bio) in people.items():
+        idx.add_mutation_with_index(s, DirectedEdge(uid, "name", value=Val(TypeID.STRING, nm)), 1)
+        idx.add_mutation_with_index(s, DirectedEdge(uid, "age", value=Val(TypeID.INT, age)), 1)
+        idx.add_mutation_with_index(s, DirectedEdge(uid, "bio", value=Val(TypeID.STRING, bio)), 1)
+    for sub, obj in [(1, 2), (1, 3), (2, 3), (3, 4), (4, 5), (5, 1), (1, 5)]:
+        idx.add_mutation_with_index(s, DirectedEdge(sub, "friend", object_uid=obj), 1)
+    idx.add_mutation_with_index(
+        s, DirectedEdge(1, "loc",
+                        value=Val(TypeID.GEO, __import__("dgraph_tpu.utils.geo", fromlist=["geo"]).parse_geojson(
+                            '{"type":"Point","coordinates":[-122.42,37.77]}'))), 1)
+    idx.add_mutation_with_index(
+        s, DirectedEdge(2, "loc",
+                        value=Val(TypeID.GEO, __import__("dgraph_tpu.utils.geo", fromlist=["geo"]).parse_geojson(
+                            '{"type":"Point","coordinates":[-74.0,40.71]}'))), 1)
+    idx.add_mutation_with_index(
+        s, DirectedEdge(1, "pass", value=Val(TypeID.PASSWORD, hash_password("hunter22"))), 1)
+    s.commit(1, 2, list(s.lists.keys()))
+    return s, build_snapshot(s, read_ts=3)
+
+
+def run(snap_env, q):
+    s, snap = snap_env
+    return process_task(snap, q, s.schema)
+
+
+def test_has(snap_env):
+    res = run(snap_env, TaskQuery("friend", func=("has", [])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 2, 3, 4, 5])
+    res = run(snap_env, TaskQuery("loc", func=("has", [])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 2])
+
+
+def test_eq_exact_and_int(snap_env):
+    res = run(snap_env, TaskQuery("name", func=("eq", ["alice jones"])))
+    np.testing.assert_array_equal(res.dest_uids, [1])
+    res = run(snap_env, TaskQuery("age", func=("eq", [25])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 3])
+    # multi-arg eq = union
+    res = run(snap_env, TaskQuery("age", func=("eq", [25, 40])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 3, 4])
+
+
+def test_inequalities(snap_env):
+    res = run(snap_env, TaskQuery("age", func=("lt", [25])))
+    np.testing.assert_array_equal(res.dest_uids, [5])
+    res = run(snap_env, TaskQuery("age", func=("le", [25])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 3, 5])
+    res = run(snap_env, TaskQuery("age", func=("gt", [32])))
+    np.testing.assert_array_equal(res.dest_uids, [4])
+    res = run(snap_env, TaskQuery("age", func=("ge", [32])))
+    np.testing.assert_array_equal(res.dest_uids, [2, 4])
+
+
+def test_terms_and_fulltext(snap_env):
+    res = run(snap_env, TaskQuery("name", func=("anyofterms", ["jones bob"])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 2, 3])
+    res = run(snap_env, TaskQuery("name", func=("allofterms", ["carol jones"])))
+    np.testing.assert_array_equal(res.dest_uids, [3])
+    res = run(snap_env, TaskQuery("bio", func=("anyoftext", ["car"])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 3])  # cars stems to car
+    res = run(snap_env, TaskQuery("bio", func=("alloftext", ["food cooking"])))
+    np.testing.assert_array_equal(res.dest_uids, [2])
+
+
+def test_regexp(snap_env):
+    res = run(snap_env, TaskQuery("name", func=("regexp", ["jon", ""])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 3])
+    res = run(snap_env, TaskQuery("name", func=("regexp", ["^bob.*th$", ""])))
+    np.testing.assert_array_equal(res.dest_uids, [2])
+    res = run(snap_env, TaskQuery("name", func=("regexp", ["ALICE", "i"])))
+    np.testing.assert_array_equal(res.dest_uids, [1])
+
+
+def test_geo_near(snap_env):
+    res = run(snap_env, TaskQuery(
+        "loc", func=("near", ['{"type":"Point","coordinates":[-122.4,37.78]}', 10000])))
+    np.testing.assert_array_equal(res.dest_uids, [1])
+    res = run(snap_env, TaskQuery(
+        "loc", func=("near", ['{"type":"Point","coordinates":[0.0,0.0]}', 1000])))
+    assert len(res.dest_uids) == 0
+
+
+def test_count_scalar(snap_env):
+    # friend out-degrees: 1->3, 2->1, 3->1, 4->1, 5->1
+    res = run(snap_env, TaskQuery("friend", func=("eq", ["__count__", 3])))
+    np.testing.assert_array_equal(res.dest_uids, [1])
+    res = run(snap_env, TaskQuery("friend", func=("ge", ["__count__", 1])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 2, 3, 4, 5])
+
+
+def test_expand_and_reverse(snap_env):
+    res = run(snap_env, TaskQuery("friend", frontier=np.asarray([1, 3])))
+    np.testing.assert_array_equal(res.uid_matrix[0], [2, 3, 5])
+    np.testing.assert_array_equal(res.uid_matrix[1], [4])
+    np.testing.assert_array_equal(res.dest_uids, [2, 3, 4, 5])
+    assert res.counts == [3, 1]
+    assert res.traversed_edges == 4
+    # reverse: who points at 3?
+    res = run(snap_env, TaskQuery("~friend", frontier=np.asarray([3])))
+    np.testing.assert_array_equal(res.uid_matrix[0], [1, 2])
+
+
+def test_value_fetch_and_filters(snap_env):
+    res = run(snap_env, TaskQuery("age", frontier=np.asarray([1, 2, 4])))
+    assert [v[0].value for v in res.value_matrix] == [25, 32, 40]
+    res = run(snap_env, TaskQuery("age", frontier=np.asarray([1, 2, 4]), func=("ge", [30])))
+    np.testing.assert_array_equal(res.dest_uids, [2, 4])
+
+
+def test_uid_in(snap_env):
+    res = run(snap_env, TaskQuery("friend", frontier=np.asarray([1, 2, 4]),
+                                  func=("uid_in", [3])))
+    np.testing.assert_array_equal(res.dest_uids, [1, 2])
+
+
+def test_checkpwd(snap_env):
+    res = run(snap_env, TaskQuery("pass", frontier=np.asarray([1]),
+                                  func=("checkpwd", ["hunter22"])))
+    np.testing.assert_array_equal(res.dest_uids, [1])
+    res = run(snap_env, TaskQuery("pass", frontier=np.asarray([1]),
+                                  func=("checkpwd", ["wrong"])))
+    assert len(res.dest_uids) == 0
+
+
+def test_first_truncation(snap_env):
+    res = run(snap_env, TaskQuery("friend", frontier=np.asarray([1]), first=2))
+    np.testing.assert_array_equal(res.uid_matrix[0], [2, 3])
+
+
+def test_missing_index_errors(snap_env):
+    with pytest.raises(TaskError, match="needs @index"):
+        run(snap_env, TaskQuery("bio", func=("eq", ["x"])))
