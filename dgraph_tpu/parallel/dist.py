@@ -1,0 +1,148 @@
+"""Distributed traversal: uid-range-sharded CSR + shard_map frontier steps.
+
+Reference semantics: worker/task.go ProcessTaskOverNetwork (:137) fans one
+intern.Query out to the group owning the predicate over gRPC, and
+query/query.go merges the returned uidMatrix. Here the fan-out is remapped to
+the mesh (BASELINE north star): the CSR row space is range-partitioned across
+devices, the frontier is replicated, every shard expands its local rows in
+one CSR gather, and an all_gather + merge over ICI replaces the gRPC
+scatter-gather. Edge totals combine with psum.
+
+Layout notes (How-to-Scale mental model):
+  - frontier: replicated — it's small (<= frontier_cap int32) and every shard
+    needs all of it (any uid's row can live on any shard). The all_gather of
+    per-shard dest sets is the only inter-device traffic per hop.
+  - CSR arrays: sharded on a leading [n_shards, ...] axis; rows are
+    contiguous chunks of the subject table, so each subject row lives on
+    exactly one shard (the analog of a tablet's contiguous key range,
+    x/keys.go).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from dgraph_tpu.ops.uidset import sentinel, _dedup_sorted
+from dgraph_tpu.ops.csr import expand
+
+SNT = sentinel(jnp.int32)
+
+
+class ShardedCSR(NamedTuple):
+    """One predicate's adjacency, row-partitioned across the mesh.
+
+    All arrays carry a leading shard axis and are padded to the max shard
+    size: subjects [S, R], indptr [S, R+1], indices [S, E]. Padding rows have
+    subject=SENTINEL and zero degree.
+    """
+
+    subjects: jax.Array
+    indptr: jax.Array
+    indices: jax.Array
+
+    @property
+    def n_shards(self) -> int:
+        return self.subjects.shape[0]
+
+
+def shard_csr(subjects: np.ndarray, indptr: np.ndarray, indices: np.ndarray,
+              mesh: Mesh) -> ShardedCSR:
+    """Partition host CSR into contiguous row chunks, pad, and place."""
+    n_shards = mesh.shape["shard"]
+    n_rows = len(subjects)
+    rows_per = -(-max(n_rows, 1) // n_shards)
+    sub_chunks, ptr_chunks, idx_chunks = [], [], []
+    max_edges = 1
+    for s in range(n_shards):
+        lo, hi = min(s * rows_per, n_rows), min((s + 1) * rows_per, n_rows)
+        e_lo, e_hi = int(indptr[lo]), int(indptr[hi])
+        max_edges = max(max_edges, e_hi - e_lo)
+    for s in range(n_shards):
+        lo, hi = min(s * rows_per, n_rows), min((s + 1) * rows_per, n_rows)
+        e_lo, e_hi = int(indptr[lo]), int(indptr[hi])
+        sub = np.full(rows_per, int(SNT), dtype=np.int32)
+        sub[: hi - lo] = subjects[lo:hi]
+        ptr = np.zeros(rows_per + 1, dtype=np.int32)
+        ptr[: hi - lo + 1] = indptr[lo : hi + 1] - e_lo
+        ptr[hi - lo + 1 :] = ptr[hi - lo]
+        idx = np.full(max_edges, int(SNT), dtype=np.int32)
+        idx[: e_hi - e_lo] = indices[e_lo:e_hi]
+        sub_chunks.append(sub)
+        ptr_chunks.append(ptr)
+        idx_chunks.append(idx)
+    sharding = NamedSharding(mesh, P("shard"))
+    return ShardedCSR(
+        jax.device_put(np.stack(sub_chunks), sharding),
+        jax.device_put(np.stack(ptr_chunks), sharding),
+        jax.device_put(np.stack(idx_chunks), sharding),
+    )
+
+
+def _local_rows(subjects: jax.Array, frontier: jax.Array) -> jax.Array:
+    pos = jnp.searchsorted(subjects, frontier)
+    pos_c = jnp.clip(pos, 0, subjects.shape[0] - 1)
+    ok = (jnp.take(subjects, pos_c, mode="clip") == frontier) & (frontier != SNT)
+    return jnp.where(ok, pos_c, SNT).astype(jnp.int32)
+
+
+def dist_k_hop(csr: ShardedCSR, seeds: jax.Array, mesh: Mesh, *, hops: int,
+               frontier_cap: int, num_nodes: int, edge_cap: int | None = None):
+    """Multi-device k-hop BFS. Returns (visited bool[num_nodes], frontier,
+    traversed:int32) — all replicated.
+
+    Per hop, per shard: resolve frontier against local subjects → local CSR
+    gather → local dedup; then ONE all_gather of [edge_cap]-sized dest sets
+    over ICI and a replicated merge + visited update. psum sums edge counts.
+    edge_cap must cover one shard's largest per-level edge gather (a shard's
+    total edge count, csr.indices.shape[-1], is always safe).
+    """
+    edge_cap = edge_cap or frontier_cap
+
+    def step(sub, ptr, idx, frontier, visited):
+        # sub/ptr/idx are this shard's blocks (leading axis stripped by shard_map)
+        rows = _local_rows(sub[0], frontier)
+        res = expand(ptr[0], idx[0], rows, edge_cap)
+        dest = _dedup_sorted(jnp.sort(res.targets))
+        gathered = lax.all_gather(dest, "shard")         # [S, edge_cap] on ICI
+        merged = _dedup_sorted(jnp.sort(gathered.reshape(-1)))[:frontier_cap]
+        safe = jnp.where(merged == SNT, num_nodes, merged)
+        seen = jnp.take(visited, jnp.clip(safe, 0, num_nodes - 1), mode="clip") \
+            & (merged != SNT)
+        fresh = jnp.sort(jnp.where(seen | (merged == SNT), SNT, merged))
+        visited = visited.at[jnp.where(fresh == SNT, num_nodes, fresh)].set(
+            True, mode="drop")
+        traversed = lax.psum(res.total.astype(jnp.int32), "shard")
+        return fresh, visited, traversed
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )
+    def run(sub, ptr, idx, seeds_in, visited0):
+        def body(_i, carry):
+            frontier, visited, total = carry
+            f, v, t = step(sub, ptr, idx, frontier, visited)
+            return f, v, total + t
+        return lax.fori_loop(0, hops, body,
+                             (seeds_in, visited0, jnp.int32(0)))
+
+    if seeds.shape[0] < frontier_cap:
+        seeds = jnp.concatenate(
+            [seeds, jnp.full((frontier_cap - seeds.shape[0],), SNT, jnp.int32)])
+    else:
+        seeds = jnp.sort(seeds)[:frontier_cap]
+    visited0 = jnp.zeros((num_nodes,), dtype=bool)
+    visited0 = visited0.at[jnp.where(seeds == SNT, num_nodes, seeds)].set(
+        True, mode="drop")
+    with mesh:
+        return jax.jit(run)(csr.subjects, csr.indptr, csr.indices, seeds, visited0)

@@ -8,8 +8,10 @@ TPU). Mirrors the reference's embedded single-process cluster test pattern
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process. Forced (not
+# setdefault): the host environment pins JAX_PLATFORMS to the TPU plugin, and
+# tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -20,6 +22,15 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# The TPU-plugin sitecustomize imports jax at interpreter startup, freezing
+# jax_platforms before this file runs — override through the config API too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    "tests need the 8-virtual-device CPU mesh; got "
+    f"{jax.devices()} — check XLA_FLAGS/JAX_PLATFORMS handling in conftest")
 
 
 @pytest.fixture

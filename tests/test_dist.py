@@ -1,0 +1,54 @@
+"""Sharded traversal on the virtual 8-device CPU mesh vs single-device result."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops import traversal, uidset as us
+from dgraph_tpu.parallel import dist, mesh as meshmod
+
+
+def build_host_csr(rng, n_nodes, n_edges):
+    edges = sorted({(int(a), int(b))
+                    for a, b in rng.integers(0, n_nodes, size=(n_edges, 2)) if a != b})
+    subjects = sorted({a for a, _ in edges})
+    sub_idx = {s: i for i, s in enumerate(subjects)}
+    indptr = np.zeros(len(subjects) + 1, dtype=np.int32)
+    for a, _ in edges:
+        indptr[sub_idx[a] + 1] += 1
+    np.cumsum(indptr, out=indptr)
+    indices = np.asarray([b for _, b in edges], dtype=np.int32)
+    return np.asarray(subjects, dtype=np.int32), indptr, indices
+
+
+def test_dist_k_hop_matches_single_device(rng):
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    subjects, indptr, indices, = build_host_csr(rng, 500, 4000)
+    m = meshmod.make_mesh(8)
+    sharded = dist.shard_csr(subjects, indptr, indices, m)
+    seeds = us.make_set([0, 3, 7], capacity=8)
+
+    single = traversal.k_hop(jnp.asarray(subjects), jnp.asarray(indptr),
+                             jnp.asarray(indices), seeds,
+                             hops=3, frontier_cap=2048, num_nodes=500)
+    frontier, visited, traversed = dist.dist_k_hop(
+        sharded, seeds, m, hops=3, frontier_cap=2048, num_nodes=500)
+
+    np.testing.assert_array_equal(np.asarray(visited), np.asarray(single.visited))
+    np.testing.assert_array_equal(us.to_numpy(frontier), us.to_numpy(single.frontier))
+    assert int(traversed) == int(single.traversed)
+
+
+def test_dist_mesh_sizes(rng):
+    subjects, indptr, indices = build_host_csr(rng, 100, 400)
+    for n in (2, 4):
+        m = meshmod.make_mesh(n)
+        sharded = dist.shard_csr(subjects, indptr, indices, m)
+        assert sharded.subjects.shape[0] == n
+        seeds = us.make_set([0], capacity=4)
+        frontier, visited, traversed = dist.dist_k_hop(
+            sharded, seeds, m, hops=2, frontier_cap=512, num_nodes=100)
+        single = traversal.k_hop(jnp.asarray(subjects), jnp.asarray(indptr),
+                                 jnp.asarray(indices), seeds,
+                                 hops=2, frontier_cap=512, num_nodes=100)
+        np.testing.assert_array_equal(np.asarray(visited), np.asarray(single.visited))
