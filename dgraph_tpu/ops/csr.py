@@ -62,6 +62,15 @@ def expand(indptr: jax.Array, indices: jax.Array, rows: jax.Array, out_cap: int)
     rows: sentinel-padded int32 row indices (NOT raw uids — map uids to rows with
     storage-side subjects lookup). out_cap: static output capacity.
     """
+    if indices.shape[0] == 0 or rows.shape[0] == 0:
+        # empty adjacency or empty frontier: all-sentinel result (jnp.take
+        # rejects a non-empty gather from an empty array, so guard statically)
+        return ExpandResult(
+            jnp.full((out_cap,), sentinel(indices.dtype), dtype=indices.dtype),
+            jnp.full((out_cap,), -1, dtype=jnp.int32),
+            jnp.zeros((rows.shape[0],), dtype=indptr.dtype),
+            jnp.zeros((), dtype=indptr.dtype),
+        )
     snt = sentinel(rows.dtype)
     valid = rows != snt
     r = jnp.where(valid, rows, 0).astype(jnp.int32)

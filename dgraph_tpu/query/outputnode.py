@@ -110,6 +110,13 @@ def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
             sub_frontier = np.sort(child.dest_uids)
             objs = []
             kept = set(int(x) for x in child.dest_uids)
+            # nested count(uid): emit a per-parent {"count": n} object over the
+            # kept (post-filter) targets, ALONGSIDE any sibling attributes —
+            # the reference appends it as one more list entry (query.go:472)
+            for cc in child.children:
+                if cc.gq.is_uid_node and cc.gq.is_count:
+                    n_kept = sum(1 for t in targets if int(t) in kept)
+                    objs.append({cc.gq.alias or "count": n_kept})
             for j, t in enumerate(targets):
                 if int(t) not in kept:
                     continue  # pruned by child filter/pagination

@@ -244,7 +244,16 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
     res.value_matrix = []
     for u in frontier.tolist():
         vals: list[Val] = []
-        if q.lang:
+        if q.lang == ".":
+            # any-language read: untagged first, else any tagged value
+            sv = pd.host_values.get(int(u))
+            if sv is not None:
+                vals = [sv]
+            else:
+                lv = pd.lang_values.get(int(u), {})
+                if lv:
+                    vals = [next(iter(lv.values()))]
+        elif q.lang:
             lv = pd.lang_values.get(int(u), {})
             if q.lang in lv:
                 vals = [lv[q.lang]]
@@ -254,13 +263,19 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
                 vals = [sv]
         res.value_matrix.append(vals)
     if fname in ("eq", "le", "lt", "ge", "gt"):
-        v = _parse_arg_val(pd, schema, args[0])
+        # eq(pred, v1, v2, ...) matches ANY listed value (reference parses the
+        # multi-value form on root and frontier paths alike)
+        vs = [_parse_arg_val(pd, schema, a) for a in (args if fname == "eq" else args[:1])]
         keep = np.asarray(
-            [any(compare_vals(fname, x, v) for x in vals) for vals in res.value_matrix],
+            [any(compare_vals(fname, x, v) for x in vals for v in vs)
+             for vals in res.value_matrix],
             dtype=bool)
         res.dest_uids = frontier[keep]
     elif fname == "has":
-        keep = np.asarray([len(vals) > 0 for vals in res.value_matrix], dtype=bool)
+        # has(attr) matches lang-only nodes too (the data key exists)
+        keep = np.asarray(
+            [len(vals) > 0 or int(u) in pd.lang_values
+             for u, vals in zip(frontier.tolist(), res.value_matrix)], dtype=bool)
         res.dest_uids = frontier[keep]
     elif fname == "checkpwd":
         keep = []

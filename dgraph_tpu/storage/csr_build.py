@@ -174,7 +174,9 @@ def build_snapshot(store: Store, read_ts: int,
         for kb in store.keys_of(K.KeyKind.DATA, attr):
             key = K.parse_key(kb)
             pl = store.lists[kb]
-            if tid == TypeID.UID or (tid == TypeID.DEFAULT and pl.value(read_ts) is None):
+            # type heuristic for untyped predicates probes ANY value ("." tag);
+            # host_values below still reads only the untagged slot
+            if tid == TypeID.UID or (tid == TypeID.DEFAULT and pl.value(read_ts, ".") is None):
                 u = pl.uids(read_ts)
                 if len(u):
                     fwd_rows.append((key.uid, u))
@@ -189,11 +191,18 @@ def build_snapshot(store: Store, read_ts: int,
                     s = to_device_scalar(v)
                     num_vals.append(np.nan if s is None else float(s))
                 # language-tagged values
+                had_lang = False
                 for p in pl.postings(read_ts):
                     if p.value is not None and p.lang:
                         pd.lang_values.setdefault(key.uid, {})[p.lang] = p.value
+                        had_lang = True
                     if p.facets:
                         pd.facets[(key.uid, p.uid)] = p.facets
+                if v is None and had_lang:
+                    # lang-only node: still a has(attr) subject (the reference's
+                    # data key exists), but carries no untagged value
+                    val_subjects.append(key.uid)
+                    num_vals.append(np.nan)
         if fwd_rows:
             pd.csr = _csr_from_rows(fwd_rows)
         if val_subjects:

@@ -199,14 +199,22 @@ class PostingList:
         return [live.get(int(x), Posting(int(x))) for x in u]
 
     def value(self, read_ts: int, lang: str = "", own_start_ts: int | None = None) -> Val | None:
-        """The value posting (reference Value/ValueForTag, posting/list.go)."""
+        """The value posting (reference Value/ValueForTag, posting/list.go).
+
+        lang="" reads ONLY the untagged slot (reference postingForLangs: an
+        untagged read returns ErrNoValue when only lang-tagged values exist);
+        the any-language fallback applies only to the explicit "." tag
+        (`name@.`), preferring the untagged value first."""
         _, live = self._fold(read_ts, own_start_ts)
-        p = live.get(lang_uid(lang))
-        if p is None and not lang:
-            # @lang fallback: any language value (reference ValueFor semantics)
+        if lang == ".":
+            p = live.get(lang_uid(""))
+            if p is not None and p.value is not None:
+                return p.value
             for q in live.values():
                 if q.value is not None:
                     return q.value
+            return None
+        p = live.get(lang_uid(lang))
         return p.value if p else None
 
     def value_for_slot(self, read_ts: int, slot: int,
