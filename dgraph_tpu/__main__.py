@@ -1,0 +1,62 @@
+"""CLI: `python -m dgraph_tpu <subcommand>`.
+
+Reference semantics: dgraph/cmd/root.go cobra subcommands (server, zero,
+live, bulk, version). The embedded node runs server+zero in one process
+(the reference's test topology); multi-group clusters are the mesh's job,
+not separate OS processes (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+VERSION = "dgraph-tpu 0.2.0"
+
+
+def cmd_serve(args) -> int:
+    from dgraph_tpu.api.http import make_server
+    from dgraph_tpu.api.server import Node
+
+    node = Node(dirpath=args.postings)
+    if args.schema:
+        with open(args.schema) as f:
+            node.alter(schema_text=f.read())
+    srv = make_server(node, args.host, args.port)
+    print(f"serving HTTP on {args.host}:{args.port} "
+          f"(postings={args.postings or '<memory>'})", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.close()
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(VERSION)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dgraph_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve", help="run the embedded server (HTTP API)")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("-p", "--postings", default=None,
+                    help="durable posting dir (default: in-memory)")
+    sp.add_argument("--schema", default=None, help="schema file to apply")
+    sp.set_defaults(fn=cmd_serve)
+
+    vp = sub.add_parser("version", help="print version")
+    vp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""API layer: the edgraph-analog embedded server node and its HTTP surface."""
+
+from dgraph_tpu.api.server import Node, TxnContext
+
+__all__ = ["Node", "TxnContext"]

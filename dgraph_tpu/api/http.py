@@ -1,0 +1,200 @@
+"""HTTP API: /query /mutate /commit /abort /alter /health /state.
+
+Reference semantics: dgraph/cmd/server/run.go:246-261 registers these same
+paths as HTTP mirrors of the gRPC api.Dgraph service; responses use the
+{"data": ..., "extensions": {...}} / {"errors": [...]} envelope the
+reference's queryHandler writes (dgraph/cmd/server/http.go).
+
+Built on http.server.ThreadingHTTPServer (stdlib) — the wire format, not the
+server framework, is the compatibility surface.
+
+Request formats:
+  POST /query    body = DQL text, or JSON {"query": ..., "variables": {...}}
+  POST /mutate   body = DQL mutation ({set {...}} / {delete {...}}), or JSON
+                 {"set": [...], "delete": [...]}; ?commitNow=true or the
+                 X-Dgraph-CommitNow: true header commits immediately;
+                 ?startTs=N continues an open txn.
+  POST /commit/?startTs=N   body = ignored (keys travel server-side)
+  POST /abort/?startTs=N
+  POST /alter    body = schema text, or {"drop_all": true} / {"drop_attr": p}
+  GET  /health, GET /state
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import TxnConflict
+
+
+def _envelope_ok(data: dict, extensions: dict | None = None) -> bytes:
+    out = {"data": data}
+    if extensions:
+        out["extensions"] = extensions
+    return json.dumps(out).encode()
+
+
+def _envelope_err(code: str, message: str) -> bytes:
+    return json.dumps(
+        {"errors": [{"code": code, "message": message}]}).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    node: Node = None  # set by make_server
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _read_body(self) -> str:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n).decode("utf-8") if n else ""
+
+    def _send(self, status: int, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _qs(self) -> dict:
+        return {k: v[0] for k, v in
+                parse_qs(urlparse(self.path).query).items()}
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self):
+        path = urlparse(self.path).path.rstrip("/")
+        if path == "/health":
+            self._send(200, json.dumps(self.node.health()).encode())
+        elif path == "/state":
+            self._send(200, json.dumps(self.node.state()).encode())
+        else:
+            self._send(404, _envelope_err("ErrorInvalidRequest", "no such path"))
+
+    def do_POST(self):
+        path = urlparse(self.path).path.rstrip("/")
+        try:
+            if path == "/query":
+                self._query()
+            elif path == "/mutate":
+                self._mutate()
+            elif path == "/commit":
+                self._commit()
+            elif path == "/abort":
+                self._abort()
+            elif path == "/alter":
+                self._alter()
+            else:
+                self._send(404, _envelope_err("ErrorInvalidRequest",
+                                              "no such path"))
+        except TxnConflict as e:
+            self._send(409, _envelope_err("ErrorAborted", str(e)))
+        except Exception as e:  # surface parse/exec errors in the envelope
+            self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
+
+    def _query(self):
+        body = self._read_body()
+        variables = None
+        q = body
+        if self.headers.get("Content-Type", "").startswith("application/json"):
+            j = json.loads(body)
+            q = j.get("query", "")
+            variables = j.get("variables")
+        start_ts = self._qs().get("startTs")
+        out, ctx = self.node.query(
+            q, variables, int(start_ts) if start_ts else None)
+        self._send(200, _envelope_ok(
+            out, {"txn": {"start_ts": ctx.start_ts}}))
+
+    def _mutate(self):
+        body = self._read_body()
+        qs = self._qs()
+        commit_now = (qs.get("commitNow", "").lower() == "true"
+                      or self.headers.get("X-Dgraph-CommitNow", "").lower()
+                      == "true")
+        start_ts = int(qs["startTs"]) if "startTs" in qs else None
+        if self.headers.get("Content-Type", "").startswith("application/json"):
+            j = json.loads(body)
+            res = self.node.mutate(
+                set_json=j.get("set"), delete_json=j.get("delete"),
+                commit_now=commit_now, start_ts=start_ts)
+        else:
+            sets, dels = _split_mutation_blocks(body)
+            res = self.node.mutate(set_nquads=sets, del_nquads=dels,
+                                   commit_now=commit_now, start_ts=start_ts)
+        ctx = res.context
+        self._send(200, _envelope_ok(
+            {"code": "Success", "message": "Done",
+             "uids": {k[2:]: hex(v) for k, v in res.uids.items()}},
+            {"txn": {"start_ts": ctx.start_ts,
+                     "commit_ts": ctx.commit_ts,
+                     "aborted": ctx.aborted}}))
+
+    def _commit(self):
+        start_ts = int(self._qs()["startTs"])
+        commit_ts = self.node.commit(start_ts)
+        self._send(200, _envelope_ok(
+            {"code": "Success", "message": "Done"},
+            {"txn": {"start_ts": start_ts, "commit_ts": commit_ts}}))
+
+    def _abort(self):
+        start_ts = int(self._qs()["startTs"])
+        self.node.abort(start_ts)
+        self._send(200, _envelope_ok({"code": "Success", "message": "Done"}))
+
+    def _alter(self):
+        body = self._read_body().strip()
+        if body.startswith("{"):
+            j = json.loads(body)
+            if j.get("drop_all"):
+                self.node.alter(drop_all=True)
+            elif j.get("drop_attr"):
+                self.node.alter(drop_attr=j["drop_attr"])
+            else:
+                raise ValueError("bad alter payload")
+        else:
+            self.node.alter(schema_text=body)
+        self._send(200, _envelope_ok({"code": "Success", "message": "Done"}))
+
+
+_SET_RE = re.compile(r"\bset\s*\{", re.S)
+_DEL_RE = re.compile(r"\bdelete\s*\{", re.S)
+
+
+def _split_mutation_blocks(body: str) -> tuple[str, str]:
+    """Extract `set {...}` / `delete {...}` RDF payloads from a mutation body
+    (the `{ set { <nquads> } }` HTTP format, dgraph/cmd/server/http.go)."""
+
+    def grab(m: re.Match) -> str:
+        depth, i = 1, m.end()
+        while i < len(body) and depth:
+            if body[i] == "{":
+                depth += 1
+            elif body[i] == "}":
+                depth -= 1
+            i += 1
+        return body[m.end(): i - 1]
+
+    sets = "\n".join(grab(m) for m in _SET_RE.finditer(body))
+    dels = "\n".join(grab(m) for m in _DEL_RE.finditer(body))
+    return sets, dels
+
+
+def make_server(node: Node, host: str = "127.0.0.1",
+                port: int = 8080) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"node": node})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_forever(node: Node, host: str = "127.0.0.1", port: int = 8080):
+    srv = make_server(node, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

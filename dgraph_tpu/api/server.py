@@ -1,0 +1,272 @@
+"""The embedded server node: Query / Mutate / Alter / CommitOrAbort.
+
+Reference semantics: edgraph/server.go — Query (:373), Mutate (:267), Alter
+(:213), CommitOrAbort (:462); parseMutationObject (:528). The reference runs
+this behind gRPC with a separate Zero process; here the node embeds its Zero
+(coord/zero.py) in-process — the same embedded single-process cluster mode
+the reference's own tests use (query/query_test.go TestMain, SURVEY.md §4).
+
+Read path: a query leases a read_ts from the oracle and executes against an
+immutable GraphSnapshot (storage/csr_build.py) — the TPU-first stance: the
+device only ever sees committed snapshot CSRs; MVCC stays host-side.
+Snapshots are cached per effective read_ts (bounded LRU), so repeated reads
+between commits reuse the same device arrays.
+
+Write path: Mutate buffers edges under start_ts (uncommitted posting layers
++ index/reverse/count maintenance), the oracle tracks conflict-key
+fingerprints, and commit runs the SSI check, assigns commit_ts, and promotes
+the layers — first-committer-wins snapshot isolation
+(dgraph/cmd/zero/oracle.go:71-83).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from dgraph_tpu.coord.zero import TxnConflict, Zero
+from dgraph_tpu.query import dql, rdf
+from dgraph_tpu.query import mutation as mut
+from dgraph_tpu.query.engine import Executor
+from dgraph_tpu.storage import index as idx
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage.csr_build import GraphSnapshot, build_snapshot
+from dgraph_tpu.storage.postings import Op
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+
+SNAP_CACHE = 4  # snapshots kept device-resident
+
+
+@dataclass
+class TxnContext:
+    """Reference: api.TxnContext (start/commit ts + conflict keys)."""
+
+    start_ts: int
+    commit_ts: int = 0
+    aborted: bool = False
+    keys: list[bytes] = field(default_factory=list)       # all touched
+    conflict_keys: list[bytes] = field(default_factory=list)
+    preds: set[str] = field(default_factory=set)
+
+
+@dataclass
+class MutationResult:
+    uids: dict[str, int]          # blank-node name -> assigned uid
+    context: TxnContext
+
+
+class Node:
+    """One embedded server (store + zero + snapshot cache)."""
+
+    def __init__(self, dirpath: str | None = None, n_groups: int = 1) -> None:
+        self.store = Store(dirpath)
+        self.zero = Zero(n_groups)
+        self._txns: dict[int, TxnContext] = {}
+        self._lock = threading.RLock()       # commit/read linearization
+        self._snaps: dict[int, GraphSnapshot] = {}
+        if self.store.max_seen_commit_ts:
+            # recover the ts sequence past everything the WAL replayed
+            self.zero.oracle.timestamps(self.store.max_seen_commit_ts)
+        maxuid = self._max_uid_in_store()
+        if maxuid:
+            self.zero.uids.assign(maxuid)
+
+    def _max_uid_in_store(self) -> int:
+        ts = self.store.max_seen_commit_ts
+        m = 0
+        for (kind, _attr), keys in self.store.by_pred.items():
+            if kind not in (int(K.KeyKind.DATA), int(K.KeyKind.REVERSE)):
+                continue
+            for kb in keys:
+                key = K.parse_key(kb)
+                m = max(m, key.uid)
+                pl = self.store.lists.get(kb)
+                if pl is not None and kind == int(K.KeyKind.DATA):
+                    u = pl.uids(max(ts, pl.base_ts))
+                    if len(u):
+                        m = max(m, int(u[-1]))
+        return m
+
+    # -- transactions --------------------------------------------------------
+
+    def new_txn(self) -> TxnContext:
+        st = self.zero.oracle.new_txn()
+        ctx = TxnContext(start_ts=st.start_ts)
+        with self._lock:
+            self._txns[st.start_ts] = ctx
+        return ctx
+
+    def commit(self, start_ts: int) -> int:
+        """CommitOrAbort (edgraph/server.go:462). Returns commit_ts; raises
+        TxnConflict after aborting the txn's buffered layers on conflict."""
+        with self._lock:
+            ctx = self._txns.pop(start_ts, None)
+            if ctx is None:
+                raise mut.MutationError(f"unknown txn {start_ts}")
+            try:
+                commit_ts = self.zero.oracle.commit(start_ts)
+            except TxnConflict:
+                self.store.abort(start_ts, ctx.keys)
+                ctx.aborted = True
+                raise
+            self.store.commit(start_ts, commit_ts, ctx.keys)
+            ctx.commit_ts = commit_ts
+            return commit_ts
+
+    def abort(self, start_ts: int) -> None:
+        with self._lock:
+            ctx = self._txns.pop(start_ts, None)
+            self.zero.oracle.abort(start_ts)
+            if ctx is not None:
+                self.store.abort(start_ts, ctx.keys)
+
+    # -- snapshots ----------------------------------------------------------
+
+    def snapshot(self, read_ts: int | None = None) -> GraphSnapshot:
+        with self._lock:
+            if read_ts is None:
+                read_ts = self.zero.oracle.read_ts()
+            # two read_ts above the newest commit see identical data
+            eff = min(read_ts, self.store.max_seen_commit_ts)
+            snap = self._snaps.get(eff)
+            if snap is None:
+                snap = build_snapshot(self.store, read_ts)
+                self._snaps[eff] = snap
+                while len(self._snaps) > SNAP_CACHE:
+                    self._snaps.pop(next(iter(self._snaps)))
+            return snap
+
+    def _invalidate_snapshots(self) -> None:
+        with self._lock:
+            self._snaps.clear()
+
+    # -- Query ---------------------------------------------------------------
+
+    def query(self, q: str, variables: dict | None = None,
+              start_ts: int | None = None) -> tuple[dict, TxnContext]:
+        """Parse + execute a DQL request (edgraph/server.go:373)."""
+        req = dql.parse(q, variables)
+        if req.schema_request is not None:
+            return {"schema": self._schema_json(req.schema_request)}, \
+                TxnContext(start_ts=0)
+        read_ts = start_ts if start_ts is not None else self.zero.oracle.read_ts()
+        snap = self.snapshot(read_ts)
+        out = Executor(snap, self.store.schema).execute(req)
+        return out, TxnContext(start_ts=read_ts)
+
+    def _schema_json(self, preds: list[str]) -> list[dict]:
+        out = []
+        for attr in (preds or self.store.schema.predicates()):
+            e = self.store.schema.get(attr)
+            if e is None:
+                continue
+            d: dict = {"predicate": e.predicate, "type": e.type_id.name.lower()}
+            if e.indexed:
+                d["index"] = True
+                d["tokenizer"] = list(e.tokenizers)
+            for flag in ("reverse", "count", "upsert", "lang"):
+                if getattr(e, flag, False):
+                    d[flag] = True
+            if e.is_list:
+                d["list"] = True
+            out.append(d)
+        return out
+
+    # -- Mutate --------------------------------------------------------------
+
+    def mutate(self, set_nquads: str = "", del_nquads: str = "",
+               set_json=None, delete_json=None, commit_now: bool = False,
+               start_ts: int | None = None) -> MutationResult:
+        """Buffer (and optionally commit) one mutation (server.go:267)."""
+        nquads_set = rdf.parse(set_nquads) if set_nquads else []
+        nquads_del = rdf.parse(del_nquads) if del_nquads else []
+        if set_json is not None:
+            nquads_set += mut.nquads_from_json(set_json, Op.SET)
+        if delete_json is not None:
+            nquads_del += mut.nquads_from_json(delete_json, Op.DEL)
+        if not nquads_set and not nquads_del:
+            raise mut.MutationError("empty mutation")
+
+        if start_ts is None:
+            ctx = self.new_txn()
+        else:
+            with self._lock:
+                ctx = self._txns.get(start_ts)
+            if ctx is None:
+                raise mut.MutationError(f"unknown txn {start_ts}")
+
+        uid_map = mut.assign_uids(nquads_set + nquads_del, self.zero.uids)
+        edges = mut.to_edges(nquads_set, uid_map, Op.SET) + \
+            mut.to_edges(nquads_del, uid_map, Op.DEL)
+        with self._lock:
+            touched, conflict, preds = mut.apply_mutations(
+                self.store, edges, ctx.start_ts)
+            ctx.keys += touched
+            ctx.conflict_keys += conflict
+            ctx.preds |= preds
+            self.zero.oracle.track(ctx.start_ts, conflict, sorted(preds))
+            for p in preds:
+                self.zero.should_serve(p)
+        res = MutationResult(uids=uid_map, context=ctx)
+        if commit_now:
+            self.commit(ctx.start_ts)
+        return res
+
+    def run_request(self, q: str, variables: dict | None = None,
+                    commit_now: bool = True) -> tuple[dict, MutationResult | None]:
+        """One combined DQL request: query blocks and/or mutation blocks
+        through the same entry (the `{set {...}}` surface)."""
+        req = dql.parse(q, variables)
+        mres = None
+        if req.mutations:
+            sets, dels = [], []
+            for m in req.mutations:
+                (sets if m["op"] == "set" else dels).append(m["rdf"])
+            mres = self.mutate(set_nquads="\n".join(sets),
+                               del_nquads="\n".join(dels),
+                               commit_now=commit_now)
+        out = {}
+        if req.queries:
+            out, _ = self.query(q, variables)
+        return out, mres
+
+    # -- Alter ---------------------------------------------------------------
+
+    def alter(self, schema_text: str = "", drop_attr: str = "",
+              drop_all: bool = False) -> None:
+        """Schema mutations + drops (server.go:213), with the reindex
+        pipeline (worker/mutation.go:97 runSchemaMutation)."""
+        with self._lock:
+            if drop_all:
+                for attr in set(self.store.predicates()) | \
+                        set(self.store.schema.predicates()):
+                    self.store.delete_predicate(attr)
+                self._invalidate_snapshots()
+                return
+            if drop_attr:
+                self.store.delete_predicate(drop_attr)
+                self._invalidate_snapshots()
+                return
+            for e in parse_schema(schema_text):
+                old = self.store.schema.get(e.predicate)
+                self.store.set_schema(e)
+                if idx.needs_reindex(old, e):
+                    read_ts = self.zero.oracle.read_ts()
+                    commit_ts = self.zero.oracle.timestamps(1)
+                    idx.rebuild_index(self.store, e.predicate, read_ts, commit_ts)
+                    idx.rebuild_reverse(self.store, e.predicate, read_ts, commit_ts)
+                    idx.rebuild_count(self.store, e.predicate, read_ts, commit_ts)
+            self._invalidate_snapshots()
+
+    # -- ops -----------------------------------------------------------------
+
+    def health(self) -> dict:
+        return {"status": "healthy", "version": "dgraph-tpu",
+                "maxAssigned": self.zero.oracle.max_assigned}
+
+    def state(self) -> dict:
+        return self.zero.state()
+
+    def close(self) -> None:
+        self.store.close()

@@ -1,0 +1,6 @@
+"""Control plane: the Zero-analog coordinator (timestamps, UID leases,
+SSI transaction oracle, tablet map). Device-independent host logic."""
+
+from dgraph_tpu.coord.zero import Oracle, TxnConflict, TxnNotFound, Zero
+
+__all__ = ["Oracle", "TxnConflict", "TxnNotFound", "Zero"]

@@ -1,0 +1,219 @@
+"""Zero-analog cluster coordinator: timestamps, UID leases, txn oracle, tablets.
+
+Reference semantics (dgraph/cmd/zero/):
+  - oracle.go:71-83 hasConflict — SSI write-conflict detection: a txn aborts
+    if any of its conflict-key fingerprints was committed by a txn with
+    commit_ts > this txn's start_ts.
+  - oracle.go:276-320 commit — assign commitTs, update per-key max-commit-ts,
+    stream the decision to groups.
+  - assign.go:65-125 — UID and timestamp block leases (10k chunks), handed to
+    servers/loaders on demand.
+  - zero.go:436 ShouldServe / tablet.go — predicate → group ("tablet")
+    assignment.
+
+Redesign: the reference runs this as a separate Raft-replicated process
+reached over gRPC. Here it is an in-process object (the embedded
+single-process cluster mode the reference's own tests use, SURVEY.md §4);
+the distribution layer (parallel/) consults the same tablet map to place
+predicates on mesh device groups. All logic is host-side and device-free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+
+
+class TxnConflict(Exception):
+    """SSI write-conflict: another txn committed one of our keys after our
+    start_ts (reference oracle.go:71 hasConflict → Code aborted)."""
+
+
+class TxnNotFound(Exception):
+    pass
+
+
+def fingerprint(key_bytes: bytes) -> int:
+    """Conflict-key fingerprint (reference x.Fingerprint / farmhash)."""
+    return int.from_bytes(
+        hashlib.blake2b(key_bytes, digest_size=8).digest(), "big")
+
+
+@dataclass
+class TxnState:
+    start_ts: int
+    keys: set[int] = field(default_factory=set)   # conflict fingerprints
+    preds: set[str] = field(default_factory=set)  # touched predicates
+
+
+LEASE_BLOCK = 10_000  # reference assign.go leaseBankSize
+
+
+class Oracle:
+    """SSI transaction oracle (reference dgraph/cmd/zero/oracle.go).
+
+    Timestamps are a single monotonic sequence shared by reads and commits;
+    max_commit_ts per conflict key implements first-committer-wins snapshot
+    isolation. `max_applied` tracks the highest ts whose commit decision has
+    been applied to the store — reads wait below it (the WaitForTs analog;
+    in-process application is synchronous so it equals max_assigned here).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next_ts = 1
+        self._key_commit: dict[int, int] = {}     # fingerprint -> max commit_ts
+        self._pending: dict[int, TxnState] = {}   # start_ts -> state
+        self._aborted: set[int] = set()
+        self.max_assigned = 0
+
+    # -- timestamps ----------------------------------------------------------
+
+    def timestamps(self, n: int = 1) -> int:
+        """Lease n timestamps; returns the first (reference assign.go:127)."""
+        with self._lock:
+            ts = self._next_ts
+            self._next_ts += n
+            self.max_assigned = self._next_ts - 1
+            return ts
+
+    def new_txn(self) -> TxnState:
+        with self._lock:
+            ts = self._next_ts
+            self._next_ts += 1
+            self.max_assigned = self._next_ts - 1
+            st = TxnState(ts)
+            self._pending[ts] = st
+            return st
+
+    def read_ts(self) -> int:
+        """Snapshot ts for a fresh read-only query: everything committed so
+        far is visible (max assigned; application is synchronous here)."""
+        with self._lock:
+            return self.max_assigned
+
+    # -- conflict tracking ---------------------------------------------------
+
+    def track(self, start_ts: int, key_bytes_list: list[bytes],
+              preds: list[str] = ()) -> None:
+        """Record conflict keys touched by a txn (TxnContext.Keys, mvcc.go:222)."""
+        with self._lock:
+            st = self._pending.get(start_ts)
+            if st is None:
+                if start_ts in self._aborted:
+                    raise TxnNotFound(f"txn {start_ts} was aborted")
+                st = TxnState(start_ts)
+                self._pending[start_ts] = st
+            st.keys.update(fingerprint(kb) for kb in key_bytes_list)
+            st.preds.update(preds)
+
+    def _has_conflict(self, st: TxnState) -> bool:
+        return any(self._key_commit.get(fp, 0) > st.start_ts for fp in st.keys)
+
+    # -- commit / abort ------------------------------------------------------
+
+    def commit(self, start_ts: int) -> int:
+        """Assign a commit ts if conflict-free, else abort (oracle.go:276).
+
+        Returns commit_ts. Raises TxnConflict (txn is aborted server-side,
+        like the reference's ABORTED TxnContext) on an SSI conflict.
+        """
+        with self._lock:
+            st = self._pending.get(start_ts)
+            if st is None:
+                if start_ts in self._aborted:
+                    raise TxnConflict(f"txn {start_ts} already aborted")
+                raise TxnNotFound(f"unknown txn {start_ts}")
+            if self._has_conflict(st):
+                del self._pending[start_ts]
+                self._aborted.add(start_ts)
+                raise TxnConflict(
+                    f"txn {start_ts} conflicts on a key committed after it")
+            commit_ts = self._next_ts
+            self._next_ts += 1
+            self.max_assigned = self._next_ts - 1
+            for fp in st.keys:
+                prev = self._key_commit.get(fp, 0)
+                if commit_ts > prev:
+                    self._key_commit[fp] = commit_ts
+            del self._pending[start_ts]
+            return commit_ts
+
+    def abort(self, start_ts: int) -> None:
+        with self._lock:
+            self._pending.pop(start_ts, None)
+            self._aborted.add(start_ts)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class UidLease:
+    """Monotonic UID allocator handing out blocks (reference assign.go:65)."""
+
+    def __init__(self, start: int = 1) -> None:
+        self._lock = threading.Lock()
+        self._next = start
+
+    def assign(self, n: int) -> tuple[int, int]:
+        """Lease n uids; returns [start, end] inclusive."""
+        if n <= 0:
+            raise ValueError("need n >= 1")
+        with self._lock:
+            s = self._next
+            self._next += n
+            return s, self._next - 1
+
+    @property
+    def max_leased(self) -> int:
+        with self._lock:
+            return self._next - 1
+
+
+class Zero:
+    """The coordinator facade: oracle + uid lease + tablet map.
+
+    Reference: the `dgraph zero` process. Tablets map predicates to groups
+    (zero.go:436 ShouldServe); in the TPU design a "group" is a set of mesh
+    devices serving that predicate's sharded CSR (parallel/mesh.py).
+    """
+
+    def __init__(self, n_groups: int = 1) -> None:
+        self.oracle = Oracle()
+        self.uids = UidLease()
+        self.n_groups = max(1, n_groups)
+        self._tablets: dict[str, int] = {}
+        self._tlock = threading.Lock()
+
+    def should_serve(self, attr: str) -> int:
+        """Group owning a predicate; first-asker claims it, balanced by
+        tablet count (reference zero.go:436 + tablet.go chooseTablet)."""
+        with self._tlock:
+            g = self._tablets.get(attr)
+            if g is None:
+                loads = [0] * self.n_groups
+                for gg in self._tablets.values():
+                    loads[gg] += 1
+                g = loads.index(min(loads))
+                self._tablets[attr] = g
+            return g
+
+    def tablets(self) -> dict[str, int]:
+        with self._tlock:
+            return dict(self._tablets)
+
+    def move_tablet(self, attr: str, group: int) -> None:
+        with self._tlock:
+            self._tablets[attr] = group
+
+    def state(self) -> dict:
+        """Membership dump (reference /state, dgraph/cmd/zero/http.go:130)."""
+        return {
+            "maxTxnTs": self.oracle.max_assigned,
+            "maxLeaseId": self.uids.max_leased,
+            "groups": {str(g): {"tablets": sorted(
+                a for a, gg in self.tablets().items() if gg == g)}
+                for g in range(self.n_groups)},
+        }

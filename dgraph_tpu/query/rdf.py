@@ -137,11 +137,50 @@ def _split_facets(s: str) -> list[str]:
     return out
 
 
+def _split_statements(line: str) -> list[str]:
+    """Split one physical line into N-Quad statements at unquoted ' . '
+    terminators (the HTTP mutation body often carries several quads on one
+    line; the reference's chunker is newline-based but its lexer terminates
+    statements at the dot, so accept both)."""
+    out, cur, in_str, in_iri, esc = [], [], False, False, False
+    i, n = 0, len(line)
+    while i < n:
+        c = line[i]
+        if c == "#" and not in_str and not in_iri:
+            # trailing comment: the rest of the line belongs to the current
+            # statement (parse_line's grammar accepts `. # comment`)
+            cur.extend(line[i:])
+            break
+        cur.append(c)
+        if esc:
+            esc = False
+        elif c == "\\" and in_str:
+            esc = True
+        elif c == '"' and not in_iri:
+            in_str = not in_str
+        elif c == "<" and not in_str:
+            in_iri = True
+        elif c == ">" and not in_str:
+            in_iri = False
+        elif c == "." and not in_str and not in_iri:
+            nxt = line[i + 1: i + 2]
+            if nxt in ("", " ", "\t"):
+                out.append("".join(cur))
+                cur = []
+        i += 1
+    if "".join(cur).strip():
+        out.append("".join(cur))
+    return out
+
+
 def parse(text: str) -> list[NQuad]:
     """Parse a block of N-Quad lines."""
     out = []
     for line in text.splitlines():
-        nq = parse_line(line)
-        if nq is not None:
-            out.append(nq)
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        for stmt in _split_statements(line):
+            nq = parse_line(stmt)
+            if nq is not None:
+                out.append(nq)
     return out

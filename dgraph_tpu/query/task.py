@@ -139,10 +139,15 @@ def _index_uids_intersect_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
 
 def _tokens_for(pd: PredData, schema: SchemaState, v: Val,
                 prefer: tuple[str, ...]) -> tuple[str, list[bytes]]:
-    """Pick a tokenizer (preference order) and produce query tokens."""
+    """Pick a tokenizer (preference order) and produce query tokens.
+
+    A predicate indexed per schema but with no index rows yet (no data at
+    this read_ts) matches zero uids instead of erroring."""
     names = schema.tokenizer_names(pd.attr)
     for p in prefer:
-        if p in names and p in pd.indexes:
+        if p in names:
+            if p not in pd.indexes:
+                return p, []  # indexed, but empty at this snapshot
             tz = tokmod.get(p)
             sv = convert(v, tz.type_id) if v.tid != tz.type_id else v
             return p, [t[1:] for t in tz.tokens(sv)]  # strip ident byte: index rows store it stripped
@@ -186,7 +191,9 @@ def _eq_candidates(pd: PredData, schema, v: Val) -> np.ndarray:
     name, toks = _tokens_for(
         pd, schema, v, ("int", "float", "bool", "exact", "hash", "term",
                         "year", "month", "day", "hour"))
-    ti = pd.indexes[name]
+    ti = pd.indexes.get(name)
+    if ti is None:
+        return np.zeros(0, np.int64)
     rows = [r for t in toks if (r := ti.term_row(t)) >= 0]
     uids = _index_uids_for_rows(ti, rows)
     if tokmod.get(name).lossy:
@@ -319,7 +326,9 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
             return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int64)
         name, toks = _tokens_for(pd, schema, v, ("int", "float", "exact",
                                                  "year", "month", "day", "hour"))
-        ti = pd.indexes[name]
+        ti = pd.indexes.get(name)
+        if ti is None or not toks:
+            return np.zeros(0, np.int64)
         rows = _ineq_rows(ti, fname, toks[0])
         uids = _index_uids_for_rows(ti, rows)
         if tokmod.get(name).lossy:
@@ -333,9 +342,10 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
                            "anyofterms" if fname == "anyoftext" else "allofterms",
                            str(args[0]), "fulltext")
     if fname == "regexp":
-        return _regexp_func(pd, str(args[0]), str(args[1]) if len(args) > 1 else "")
+        return _regexp_func(pd, schema, str(args[0]),
+                            str(args[1]) if len(args) > 1 else "")
     if fname in ("near", "within", "contains", "intersects"):
-        return _geo_func(pd, fname, args)
+        return _geo_func(pd, schema, fname, args)
     if fname == "uid_in":
         raise TaskError("uid_in is not a root function")
     raise TaskError(f"unknown function {fname!r}")
@@ -354,9 +364,20 @@ def _count_func(pd: PredData, op: str, n: int) -> np.ndarray:
     return subjects[mask]
 
 
+def _empty_or_missing_index(pd: PredData, schema, tokname: str) -> np.ndarray | None:
+    """Indexed per schema but no rows at this snapshot → zero matches;
+    not indexed at all → None (caller raises TaskError)."""
+    if tokname in schema.tokenizer_names(pd.attr):
+        return np.zeros(0, np.int64)
+    return None
+
+
 def _terms_func(pd: PredData, schema, fname: str, text: str, tokname: str) -> np.ndarray:
     ti = pd.indexes.get(tokname)
     if ti is None:
+        empty = _empty_or_missing_index(pd, schema, tokname)
+        if empty is not None:
+            return empty
         raise TaskError(f"predicate {pd.attr} needs @index({tokname})")
     tz = tokmod.get(tokname)
     toks = [t[1:] for t in tz.tokens(Val(TypeID.STRING, text))]
@@ -368,11 +389,14 @@ def _terms_func(pd: PredData, schema, fname: str, text: str, tokname: str) -> np
     return _index_uids_for_rows(ti, rows)
 
 
-def _regexp_func(pd: PredData, pattern: str, flags: str) -> np.ndarray:
+def _regexp_func(pd: PredData, schema, pattern: str, flags: str) -> np.ndarray:
     """Trigram-index candidates + exact automaton post-filter
     (reference worker/task.go:768-835, worker/trigram.go:36)."""
     ti = pd.indexes.get("trigram")
     if ti is None:
+        empty = _empty_or_missing_index(pd, schema, "trigram")
+        if empty is not None:
+            return empty
         raise TaskError(f"predicate {pd.attr} needs @index(trigram)")
     rx = remod.compile(pattern, remod.IGNORECASE if "i" in flags else 0)
     # candidate trigrams: any literal 3-gram required by the pattern; fall back
@@ -422,9 +446,12 @@ def _required_trigrams(pattern: str) -> list[str]:
     return [best[i : i + 3] for i in range(len(best) - 2)] if len(best) >= 3 else []
 
 
-def _geo_func(pd: PredData, fname: str, args: list) -> np.ndarray:
+def _geo_func(pd: PredData, schema, fname: str, args: list) -> np.ndarray:
     ti = pd.indexes.get("geo")
     if ti is None:
+        empty = _empty_or_missing_index(pd, schema, "geo")
+        if empty is not None:
+            return empty
         raise TaskError(f"predicate {pd.attr} needs @index(geo)")
     g = args[0] if isinstance(args[0], geomod.Geom) else geomod.parse_geojson(args[0])
     radius = float(args[1]) if fname == "near" and len(args) > 1 else None

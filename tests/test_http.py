@@ -1,0 +1,125 @@
+"""HTTP API round-trip: alter → mutate → query → txn commit/abort over real
+sockets against a temp-dir store.
+
+Reference: dgraph/cmd/server/run.go:246-261 endpoint registration + the
+{"data": ...}/{"errors": ...} envelope of http.go.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.api.http import serve_forever
+from dgraph_tpu.api.server import Node
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    node = Node(dirpath=str(tmp_path_factory.mktemp("pdir")))
+    srv = serve_forever(node, port=0)           # ephemeral port
+    port = srv.server_address[1]
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    node.close()
+
+
+def _post(base, path, body, ctype="application/rdf", headers=None):
+    req = urllib.request.Request(
+        base + path, data=body.encode(), method="POST",
+        headers={"Content-Type": ctype, **(headers or {})})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_full_round_trip(server):
+    st, out = _post(server, "/alter",
+                    "name: string @index(exact) .\nfriend: uid @reverse .")
+    assert st == 200 and out["data"]["code"] == "Success"
+
+    st, out = _post(server, "/mutate?commitNow=true", '''
+    {
+      set {
+        _:a <name> "Ada" .
+        _:b <name> "Byron" .
+        _:a <friend> _:b .
+      }
+    }''')
+    assert st == 200
+    uids = out["data"]["uids"]
+    assert set(uids) == {"a", "b"}
+    assert out["extensions"]["txn"]["commit_ts"] > 0
+
+    st, out = _post(server, "/query",
+                    '{ q(func: eq(name, "Ada")) { name friend { name } } }')
+    assert st == 200
+    assert out["data"]["q"][0]["friend"][0]["name"] == "Byron"
+
+    # JSON query body with variables
+    st, out = _post(server, "/query", json.dumps({
+        "query": 'query me($n: string) { q(func: eq(name, $n)) { name } }',
+        "variables": {"$n": "Byron"}}), ctype="application/json")
+    assert st == 200 and out["data"]["q"][0]["name"] == "Byron"
+
+
+def test_txn_commit_and_abort(server):
+    # open txn, mutate, commit via /commit
+    st, out = _post(server, "/mutate", '{ set { <0x50> <name> "T1" . } }')
+    assert st == 200
+    start_ts = out["extensions"]["txn"]["start_ts"]
+    st, out = _post(server, f"/commit/?startTs={start_ts}", "")
+    assert st == 200 and out["extensions"]["txn"]["commit_ts"] > start_ts
+
+    st, out = _post(server, "/query", '{ q(func: uid(0x50)) { name } }')
+    assert out["data"]["q"][0]["name"] == "T1"
+
+    # abort path: buffered write never becomes visible
+    st, out = _post(server, "/mutate", '{ set { <0x51> <name> "T2" . } }')
+    start_ts = out["extensions"]["txn"]["start_ts"]
+    st, out = _post(server, f"/abort/?startTs={start_ts}", "")
+    assert st == 200
+    st, out = _post(server, "/query", '{ q(func: uid(0x51)) { name } }')
+    assert out["data"].get("q", []) == []
+
+
+def test_json_mutation_over_http(server):
+    st, out = _post(server, "/mutate?commitNow=true",
+                    json.dumps({"set": [{"name": "Judy", "score": 7}]}),
+                    ctype="application/json")
+    assert st == 200
+    st, out = _post(server, "/query", '{ q(func: eq(name, "Judy")) { score } }')
+    assert out["data"]["q"][0]["score"] == 7
+
+
+def test_conflict_maps_to_409(server):
+    _post(server, "/alter", "bal: int .")
+    _post(server, "/mutate?commitNow=true",
+          '{ set { <0x60> <bal> "1"^^<xs:int> . } }')
+    st, o1 = _post(server, "/mutate", '{ set { <0x60> <bal> "2"^^<xs:int> . } }')
+    st, o2 = _post(server, "/mutate", '{ set { <0x60> <bal> "3"^^<xs:int> . } }')
+    ts1 = o1["extensions"]["txn"]["start_ts"]
+    ts2 = o2["extensions"]["txn"]["start_ts"]
+    st, _ = _post(server, f"/commit/?startTs={ts1}", "")
+    assert st == 200
+    st, out = _post(server, f"/commit/?startTs={ts2}", "")
+    assert st == 409 and out["errors"][0]["code"] == "ErrorAborted"
+
+
+def test_health_and_state(server):
+    st, h = _get(server, "/health")
+    assert st == 200 and h["status"] == "healthy"
+    st, s = _get(server, "/state")
+    assert st == 200 and "groups" in s
+
+
+def test_error_envelope(server):
+    st, out = _post(server, "/query", "{ bad query ")
+    assert st == 400 and out["errors"][0]["code"] == "ErrorInvalidRequest"
