@@ -1,0 +1,318 @@
+"""Pallas pull-BFS: the locality-blocked frontier-bit gather kernel.
+
+This is the native kernel the reference implements as 146k lines of
+generated SSE2 (bp128/unpack_amd64.s + worker/task.go:476-602 per-uid
+posting iteration). PERF.md (round 1) measured XLA's element-granularity
+gather at ~1000x below HBM bandwidth — every BFS formulation pays one
+E-sized random gather per hop (frontier[in_src[e]]), so the pull kernel
+topped out at ~36M edges/s. Here that gather runs inside a Pallas kernel
+where it can't miss:
+
+  - the frontier is a bit-packed bitmap: num_nodes bits = num_nodes/8
+    bytes, VMEM-resident for the whole kernel (1M nodes = 128 KB). Zero
+    HBM traffic for masks.
+  - the bitmap is laid out as (CHUNKS, 1024) int32 words; 1024 words =
+    one 8x128 int32 vreg, the unit Mosaic can gather from in one op. The
+    kernel loops over chunks, gathering each edge's frontier word from
+    the chunk that owns it (chunks = ceil(num_nodes / 32768); a scale-20
+    graph needs 33 — ~5 VPU ops per edge per chunk).
+  - the edge stream (in_src, sorted by destination) is the ONLY O(E) HBM
+    traffic: 4 bytes in + 4 bytes out per edge, at streaming rate.
+  - the kernel fuses the inclusive prefix-sum of the per-edge active
+    flags (two-level lane/sublane scan + a sequential-grid carry in
+    SMEM), so the XLA side needs no E-sized cumsum: per-node reachability
+    is diff-of-prefix at the dense in-CSR row boundaries — node-sized.
+
+Per hop:   active[e] = frontier_bit[in_src[e]]          (Pallas, streaming)
+           prefix    = cumsum(active)                   (fused in kernel)
+           reached_v = prefix[iptr[v+1]] - prefix[iptr[v]] > 0   (node-sized)
+           frontier' = reached & ~visited               (node-sized)
+
+Reference semantics preserved: `traversed` counts every out-edge of every
+frontier node per hop (== active in-edges), and `visited` matches
+traversal.k_hop_pull / the host BFS exactly (bench.py's equality gate).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+WORDS_PER_CHUNK = 1024          # one 8x128 int32 vreg
+NODES_PER_CHUNK = WORDS_PER_CHUNK * 32
+EDGE_BLOCK = 8192               # edges per grid step (64 x 128)
+_LANES = 128
+
+
+def _block_prefix(active: jax.Array) -> jax.Array:
+    """Inclusive prefix sum of a (R, 128) int block in row-major order,
+    computed as two triangular matmuls on the MXU (f32 is exact here:
+    block totals are <= EDGE_BLOCK << 2^24). Mosaic lowers matmuls far
+    better than narrow pad/concat scans."""
+    R, L = active.shape
+    af = active.astype(jnp.float32)
+    kk = lax.broadcasted_iota(jnp.int32, (L, L), 0)
+    jj = lax.broadcasted_iota(jnp.int32, (L, L), 1)
+    upper = (kk <= jj).astype(jnp.float32)             # inclusive lane scan
+    lane = lax.dot_general(af, upper, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+    rr = lax.broadcasted_iota(jnp.int32, (R, R), 0)
+    cc = lax.broadcasted_iota(jnp.int32, (R, R), 1)
+    lower = (cc < rr).astype(jnp.float32)              # strictly-lower: rows before
+    row_sums = jnp.sum(af, axis=1, keepdims=True)      # (R, 1)
+    row_off = lax.dot_general(lower, row_sums, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return (lane + row_off).astype(jnp.int32)
+
+
+def _prefix_kernel(words_ref, src_ref, out_ref, carry_ref, *, chunks: int):
+    """One grid step: EDGE_BLOCK edges -> inclusive active-prefix values.
+
+    The frontier-word lookup runs as a chunk loop: each chunk is 1024 words
+    laid out (8, 128); Mosaic's dynamic_gather handles the lane dimension
+    (take_along_axis along axis=1, single-vreg form) and an 8-way masked
+    select handles the sublane row (masks hoisted out of the chunk loop) —
+    zero HBM traffic for the bitmap (VMEM-resident throughout)."""
+    blk = pl.program_id(0)
+
+    @pl.when(blk == 0)
+    def _():
+        carry_ref[0] = 0
+
+    src = src_ref[:]                                   # (R, 128) int32
+    w = lax.shift_right_logical(src, 5)
+    bit = jnp.bitwise_and(src, 31)
+    cidx = lax.shift_right_logical(w, 10)              # owning chunk
+    widx = jnp.bitwise_and(w, WORDS_PER_CHUNK - 1)     # word within chunk
+    col = jnp.bitwise_and(widx, _LANES - 1)
+    row = lax.shift_right_logical(widx, 7)             # 0..7
+    row_masks = [row == r for r in range(8)]           # hoisted: 8 ops total
+
+    def body(c, acc):
+        cw = words_ref[pl.ds(c * 8, 8), :]             # (8,128): 1024 words
+        cmask = cidx == c
+        for r in range(8):
+            row_r = jnp.broadcast_to(cw[r : r + 1, :], src.shape)
+            g = jnp.take_along_axis(row_r, col, axis=1)    # in-vreg gather
+            acc = jnp.where(row_masks[r] & cmask, g, acc)
+        return acc
+
+    wordv = lax.fori_loop(0, chunks, body, jnp.zeros_like(src))
+    active = jnp.bitwise_and(lax.shift_right_logical(wordv, bit), 1)
+
+    # inclusive scan in row-major (flattened-edge) order + sequential carry
+    prefix = _block_prefix(active) + carry_ref[0]
+    out_ref[:] = prefix
+    carry_ref[0] = prefix[prefix.shape[0] - 1, _LANES - 1]
+
+
+FRONTIER_CAP = 4096    # sparse-path capacity: 128 buckets x 32 entries
+
+
+def _prefix_kernel_sparse(ftab_ref, src_ref, out_ref, carry_ref):
+    """Sparse-frontier variant: membership test against a sorted frontier
+    list (<= FRONTIER_CAP uids) in a 2-level 128-ary layout instead of the
+    full-bitmap chunk loop — ~5x fewer VPU ops per edge, the win for the
+    early BFS hops where the frontier is small.
+
+    ftab layout (33, 128): row 0 = per-bucket max (bucket g = sorted
+    frontier[32g:32g+32]); rows 1+j = element j of every bucket. Padding
+    slots hold INT32_MAX (never equal to a real uid)."""
+    blk = pl.program_id(0)
+
+    @pl.when(blk == 0)
+    def _():
+        carry_ref[0] = 0
+
+    src = src_ref[:]                                   # (R, 128) int32
+    seps = jnp.broadcast_to(ftab_ref[0:1, :], src.shape)
+
+    # branchless lower-bound over the 128 bucket separators:
+    # first bucket g with max(bucket g) >= src
+    b = jnp.zeros_like(src)
+    for k in (64, 32, 16, 8, 4, 2, 1):
+        cand = b + k
+        sep = jnp.take_along_axis(seps, jnp.minimum(cand - 1, _LANES - 1),
+                                  axis=1)
+        b = jnp.where(sep < src, cand, b)
+    b = jnp.minimum(b, _LANES - 1)
+
+    # equality scan of the 32 entries of the selected bucket
+    active = jnp.zeros_like(src)
+    for j in range(32):
+        lane = jnp.broadcast_to(ftab_ref[1 + j : 2 + j, :], src.shape)
+        v = jnp.take_along_axis(lane, b, axis=1)
+        active = jnp.bitwise_or(active, (v == src).astype(jnp.int32))
+
+    prefix = _block_prefix(active) + carry_ref[0]
+    out_ref[:] = prefix
+    carry_ref[0] = prefix[prefix.shape[0] - 1, _LANES - 1]
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@partial(jax.jit, static_argnames=("chunks",))
+def active_prefix(words: jax.Array, src_pad: jax.Array, *,
+                  chunks: int) -> jax.Array:
+    """Inclusive prefix-count of frontier-active edges.
+
+    words: (chunks*8, 128) int32 frontier bitmap (word w at [w>>7, w&127]).
+    src_pad: int32[E_pad] (E_pad % EDGE_BLOCK == 0; padding points at an
+    always-zero word). Returns int32[E_pad]; prefix[-1] is the active total.
+    """
+    e_pad = src_pad.shape[0]
+    assert e_pad % EDGE_BLOCK == 0
+    rows = e_pad // _LANES
+    rblk = EDGE_BLOCK // _LANES
+    src2 = src_pad.reshape(rows, _LANES)
+    out = pl.pallas_call(
+        partial(_prefix_kernel, chunks=chunks),
+        grid=(rows // rblk,),
+        in_specs=[
+            pl.BlockSpec((chunks * 8, _LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rblk, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rblk, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=_use_interpret(),
+    )(words, src2)
+    return out.reshape(e_pad)
+
+
+@jax.jit
+def active_prefix_sparse(ftab: jax.Array, src_pad: jax.Array) -> jax.Array:
+    """Sparse-frontier inclusive prefix (ftab: (33,128) 2-level layout)."""
+    e_pad = src_pad.shape[0]
+    rows = e_pad // _LANES
+    rblk = EDGE_BLOCK // _LANES
+    src2 = src_pad.reshape(rows, _LANES)
+    out = pl.pallas_call(
+        _prefix_kernel_sparse,
+        grid=(rows // rblk,),
+        in_specs=[
+            pl.BlockSpec((33, _LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rblk, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rblk, _LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=_use_interpret(),
+    )(ftab, src2)
+    return out.reshape(e_pad)
+
+
+def _frontier_table(frontier: jax.Array) -> jax.Array:
+    """bool[num_nodes] (popcount <= FRONTIER_CAP) -> (33,128) search table."""
+    imax = jnp.int32(np.iinfo(np.int32).max)
+    flist = jnp.nonzero(frontier, size=FRONTIER_CAP, fill_value=imax)[0]
+    flist = flist.astype(jnp.int32)        # sorted ascending, pads at end
+    buckets = flist.reshape(_LANES, 32)
+    seps = buckets[:, 31]                  # per-bucket max
+    return jnp.concatenate([seps[None, :], buckets.T], axis=0)
+
+
+class PullGraph(NamedTuple):
+    """Device-resident pull-BFS layout of one predicate CSR."""
+
+    in_src_pad: jax.Array       # int32[E_pad], sorted by destination
+    in_indptr_dense: jax.Array  # int32[num_nodes+1] over ALL node ids
+    num_nodes: int
+    num_edges: int
+    chunks: int
+
+
+def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
+              indices: np.ndarray, num_nodes: int) -> PullGraph:
+    """Host-side once-per-snapshot prep: transpose to dst-sorted in-edges
+    with a DENSE per-node indptr (rows == node ids), pad the edge stream to
+    the kernel block size pointing at an always-zero bitmap word."""
+    E = len(indices)
+    src = np.repeat(subjects, np.diff(indptr)).astype(np.int64)
+    order = np.argsort(indices, kind="stable")
+    dst_sorted = np.asarray(indices)[order]
+    src_sorted = src[order].astype(np.int32)
+    counts = np.bincount(dst_sorted, minlength=num_nodes)
+    iptr = np.zeros(num_nodes + 1, dtype=np.int32)
+    np.cumsum(counts, out=iptr[1:])
+
+    chunks = max(1, (num_nodes + NODES_PER_CHUNK - 1) // NODES_PER_CHUNK)
+    if chunks * NODES_PER_CHUNK <= num_nodes:
+        chunks += 1                  # pad node must be outside real uid space
+    cap_nodes = chunks * NODES_PER_CHUNK
+    pad_src = cap_nodes - 1          # beyond num_nodes: bit always 0
+    e_pad = max(EDGE_BLOCK, -(-E // EDGE_BLOCK) * EDGE_BLOCK)
+    src_pad = np.full(e_pad, pad_src, dtype=np.int32)
+    src_pad[:E] = src_sorted
+    return PullGraph(jnp.asarray(src_pad), jnp.asarray(iptr),
+                     int(num_nodes), int(E), int(chunks))
+
+
+def pack_words(mask: jax.Array, chunks: int) -> jax.Array:
+    """bool[num_nodes] -> (chunks*8, 128) int32 bitmap (word w = nodes
+    [32w, 32w+32), laid out row-major for the kernel's chunk windows)."""
+    cap = chunks * NODES_PER_CHUNK
+    m = jnp.zeros((cap,), jnp.int32).at[: mask.shape[0]].set(
+        mask.astype(jnp.int32))
+    m = m.reshape(chunks * WORDS_PER_CHUNK, 32)
+    weights = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    return jnp.sum(m * weights, axis=1, dtype=jnp.int32).reshape(
+        chunks * 8, _LANES)
+
+
+class PullBFSResult(NamedTuple):
+    visited: jax.Array       # bool[num_nodes]
+    frontier: jax.Array      # bool[num_nodes]
+    traversed: jax.Array     # int32
+
+
+@partial(jax.jit, static_argnames=("hops", "chunks"))
+def _k_hop_impl(in_src_pad: jax.Array, in_indptr_dense: jax.Array,
+                seeds_mask: jax.Array, *, hops: int,
+                chunks: int) -> PullBFSResult:
+    def body(_i, carry):
+        frontier, visited, traversed = carry
+        fcount = jnp.sum(frontier, dtype=jnp.int32)
+
+        def sparse_hop(f):
+            return active_prefix_sparse(_frontier_table(f), in_src_pad)
+
+        def dense_hop(f):
+            return active_prefix(pack_words(f, chunks), in_src_pad,
+                                 chunks=chunks)
+
+        prefix = lax.cond(fcount <= FRONTIER_CAP, sparse_hop, dense_hop,
+                          frontier)
+        traversed = traversed + prefix[-1]
+        bounds = jnp.take(prefix, in_indptr_dense - 1,
+                          mode="clip")               # prefix[iptr-1], iptr>=0
+        bounds = jnp.where(in_indptr_dense == 0, 0, bounds)
+        reached = (bounds[1:] - bounds[:-1]) > 0     # [num_nodes]
+        fresh = reached & ~visited
+        return fresh, visited | fresh, traversed
+
+    frontier, visited, traversed = lax.fori_loop(
+        0, hops, body, (seeds_mask, seeds_mask, jnp.int32(0)))
+    return PullBFSResult(visited, frontier, traversed)
+
+
+def k_hop_pull_pallas(g: PullGraph, seeds_mask: jax.Array, *,
+                      hops: int) -> PullBFSResult:
+    """k-hop BFS with the Pallas active-prefix kernel per hop."""
+    return _k_hop_impl(g.in_src_pad, g.in_indptr_dense, seeds_mask,
+                       hops=hops, chunks=g.chunks)
