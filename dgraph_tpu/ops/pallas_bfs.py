@@ -243,6 +243,14 @@ def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
     with a DENSE per-node indptr (rows == node ids), pad the edge stream to
     the kernel block size pointing at an always-zero bitmap word."""
     E = len(indices)
+    if E and int(np.max(indices)) >= num_nodes:
+        raise ValueError(
+            f"prep_pull: destination uid {int(np.max(indices))} >= "
+            f"num_nodes={num_nodes}; pass num_nodes > max uid")
+    if len(subjects) and int(np.max(subjects)) >= num_nodes:
+        raise ValueError(
+            f"prep_pull: subject uid {int(np.max(subjects))} >= "
+            f"num_nodes={num_nodes}; pass num_nodes > max uid")
     src = np.repeat(subjects, np.diff(indptr)).astype(np.int64)
     order = np.argsort(indices, kind="stable")
     dst_sorted = np.asarray(indices)[order]
