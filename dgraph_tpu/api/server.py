@@ -30,7 +30,8 @@ from dgraph_tpu.query import mutation as mut
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage import index as idx
 from dgraph_tpu.storage import keys as K
-from dgraph_tpu.storage.csr_build import GraphSnapshot, build_snapshot
+from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData, build_pred,
+                                          build_snapshot)
 from dgraph_tpu.storage.postings import Op
 from dgraph_tpu.storage.store import Store
 from dgraph_tpu.utils.schema import parse_schema
@@ -48,6 +49,8 @@ class TxnContext:
     keys: list[bytes] = field(default_factory=list)       # all touched
     conflict_keys: list[bytes] = field(default_factory=list)
     preds: set[str] = field(default_factory=set)
+    version: int = 0                       # bumped per mutate (overlay cache)
+    overlay: tuple[int, dict] | None = None  # (version, {attr: PredData})
 
 
 @dataclass
@@ -65,6 +68,10 @@ class Node:
         self._txns: dict[int, TxnContext] = {}
         self._lock = threading.RLock()       # commit/read linearization
         self._snaps: dict[int, GraphSnapshot] = {}
+        # incremental-build cache: attr -> (eff_ts it was built at, PredData).
+        # Reused when no commit touched the predicate since (pred_commit_ts),
+        # so a commit touching one predicate rebuilds one predicate.
+        self._pred_cache: dict[str, tuple[int, PredData]] = {}
         if self.store.max_seen_commit_ts:
             # recover the ts sequence past everything the WAL replayed
             self.zero.oracle.timestamps(self.store.max_seen_commit_ts)
@@ -131,15 +138,35 @@ class Node:
             eff = min(read_ts, self.store.max_seen_commit_ts)
             snap = self._snaps.get(eff)
             if snap is None:
-                snap = build_snapshot(self.store, read_ts)
+                snap = self._assemble_snapshot(eff)
                 self._snaps[eff] = snap
                 while len(self._snaps) > SNAP_CACHE:
                     self._snaps.pop(next(iter(self._snaps)))
             return snap
 
+    def _assemble_snapshot(self, eff: int) -> GraphSnapshot:
+        """Incremental snapshot build: a predicate untouched since its cached
+        build keeps its device arrays (PredData identity); only predicates
+        with commits after the cached eff are re-folded. Reference contract:
+        posting/lists.go:243 read-through — the world is never rebuilt."""
+        snap = GraphSnapshot(eff)
+        for attr in self.store.predicates():
+            pct = self.store.pred_commit_ts.get(attr, 0)
+            cached = self._pred_cache.get(attr)
+            if cached is not None and cached[0] >= pct and eff >= pct:
+                # both views contain every commit to attr (all <= pct)
+                snap.preds[attr] = cached[1]
+                continue
+            pd = build_pred(self.store, attr, eff)
+            if eff >= pct:
+                self._pred_cache[attr] = (eff, pd)
+            snap.preds[attr] = pd
+        return snap
+
     def _invalidate_snapshots(self) -> None:
         with self._lock:
             self._snaps.clear()
+            self._pred_cache.clear()
 
     # -- Query ---------------------------------------------------------------
 
@@ -151,7 +178,29 @@ class Node:
             return {"schema": self._schema_json(req.schema_request)}, \
                 TxnContext(start_ts=0)
         read_ts = start_ts if start_ts is not None else self.zero.oracle.read_ts()
-        snap = self.snapshot(read_ts)
+        with self._lock:
+            # only an EXPLICIT startTs continues an open txn: a fresh read's
+            # ts may numerically equal a pending txn's start_ts and must not
+            # see its uncommitted writes
+            ctx = self._txns.get(start_ts) if start_ts is not None else None
+            if ctx is not None and ctx.preds:
+                # open txn reading at its own start_ts: overlay its
+                # uncommitted layers on the committed base so upsert-style
+                # query-then-mutate flows see their own writes
+                # (posting/list.go:528 — StartTs == readTs visibility)
+                base = self.snapshot(read_ts)
+                snap = GraphSnapshot(read_ts)
+                snap.preds = dict(base.preds)
+                if ctx.overlay is not None and ctx.overlay[0] == ctx.version:
+                    snap.preds.update(ctx.overlay[1])
+                else:
+                    built = {attr: build_pred(self.store, attr, read_ts,
+                                              own_start_ts=read_ts)
+                             for attr in sorted(ctx.preds)}
+                    ctx.overlay = (ctx.version, built)
+                    snap.preds.update(built)
+            else:
+                snap = self.snapshot(read_ts)
         out = Executor(snap, self.store.schema).execute(req)
         return out, TxnContext(start_ts=read_ts)
 
@@ -188,23 +237,25 @@ class Node:
         if not nquads_set and not nquads_del:
             raise mut.MutationError("empty mutation")
 
-        if start_ts is None:
-            ctx = self.new_txn()
-        else:
-            with self._lock:
-                ctx = self._txns.get(start_ts)
-            if ctx is None:
-                raise mut.MutationError(f"unknown txn {start_ts}")
-
-        uid_map = mut.assign_uids(nquads_set + nquads_del, self.zero.uids)
-        edges = mut.to_edges(nquads_set, uid_map, Op.SET) + \
-            mut.to_edges(nquads_del, uid_map, Op.DEL)
+        # one critical section from txn lookup through apply+track: a
+        # concurrent commit/abort of the same start_ts can no longer
+        # interleave and orphan uncommitted layers (advisor r2 finding)
         with self._lock:
+            if start_ts is None:
+                ctx = self.new_txn()
+            else:
+                ctx = self._txns.get(start_ts)
+                if ctx is None:
+                    raise mut.MutationError(f"unknown txn {start_ts}")
+            uid_map = mut.assign_uids(nquads_set + nquads_del, self.zero.uids)
+            edges = mut.to_edges(nquads_set, uid_map, Op.SET) + \
+                mut.to_edges(nquads_del, uid_map, Op.DEL)
             touched, conflict, preds = mut.apply_mutations(
                 self.store, edges, ctx.start_ts)
             ctx.keys += touched
             ctx.conflict_keys += conflict
             ctx.preds |= preds
+            ctx.version += 1
             self.zero.oracle.track(ctx.start_ts, conflict, sorted(preds))
             for p in preds:
                 self.zero.should_serve(p)

@@ -60,6 +60,8 @@ class Oracle:
     in-process application is synchronous so it equals max_assigned here).
     """
 
+    PURGE_EVERY = 256  # commit/abort decisions between watermark purges
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._next_ts = 1
@@ -67,6 +69,19 @@ class Oracle:
         self._pending: dict[int, TxnState] = {}   # start_ts -> state
         self._aborted: set[int] = set()
         self.max_assigned = 0
+        self._decisions = 0                       # purge cadence counter
+
+    def _purge_below_locked(self) -> None:
+        """Drop conflict/abort state no live or future txn can observe
+        (reference oracle.go purgeBelow at the MinTs watermark :112-160).
+
+        A _key_commit entry with ts <= every pending txn's start_ts can never
+        trigger _has_conflict (future txns get start_ts > max_assigned >= ts).
+        """
+        watermark = min(self._pending, default=self.max_assigned + 1)
+        self._key_commit = {fp: ts for fp, ts in self._key_commit.items()
+                            if ts > watermark}
+        self._aborted = {ts for ts in self._aborted if ts >= watermark}
 
     # -- timestamps ----------------------------------------------------------
 
@@ -101,10 +116,12 @@ class Oracle:
         with self._lock:
             st = self._pending.get(start_ts)
             if st is None:
+                # decided (committed/aborted/purged) or never-issued ts:
+                # recreating it would resurrect a finished txn, or register
+                # one whose start_ts the sequence hasn't reached
                 if start_ts in self._aborted:
                     raise TxnNotFound(f"txn {start_ts} was aborted")
-                st = TxnState(start_ts)
-                self._pending[start_ts] = st
+                raise TxnNotFound(f"txn {start_ts} is not pending")
             st.keys.update(fingerprint(kb) for kb in key_bytes_list)
             st.preds.update(preds)
 
@@ -138,12 +155,18 @@ class Oracle:
                 if commit_ts > prev:
                     self._key_commit[fp] = commit_ts
             del self._pending[start_ts]
+            self._decisions += 1
+            if self._decisions % self.PURGE_EVERY == 0:
+                self._purge_below_locked()
             return commit_ts
 
     def abort(self, start_ts: int) -> None:
         with self._lock:
             self._pending.pop(start_ts, None)
             self._aborted.add(start_ts)
+            self._decisions += 1
+            if self._decisions % self.PURGE_EVERY == 0:
+                self._purge_below_locked()
 
     def pending_count(self) -> int:
         with self._lock:
@@ -165,6 +188,11 @@ class UidLease:
             s = self._next
             self._next += n
             return s, self._next - 1
+
+    def bump_to(self, uid: int) -> None:
+        """Advance the lease past an externally-seen uid (xidmap/restart)."""
+        with self._lock:
+            self._next = max(self._next, uid + 1)
 
     @property
     def max_leased(self) -> int:

@@ -45,14 +45,25 @@ def parse_uid(s: str) -> int:
 
 
 def assign_uids(nquads: Iterable[rdf.NQuad], zero_uids) -> dict[str, int]:
-    """Lease uids for blank nodes (reference AssignUids, query/mutation.go:111)."""
+    """Lease uids for blank nodes (reference AssignUids, query/mutation.go:111).
+
+    Explicit uids in the same mutation advance the lease first, so a leased
+    blank-node uid can never collide with a client-chosen `<0x..>` uid."""
     blanks: list[str] = []
     seen: set[str] = set()
+    max_explicit = 0
     for nq in nquads:
         for name in (nq.subject, nq.object_id):
-            if name.startswith("_:") and name not in seen:
-                seen.add(name)
-                blanks.append(name)
+            if not name:
+                continue
+            if name.startswith("_:"):
+                if name not in seen:
+                    seen.add(name)
+                    blanks.append(name)
+            else:
+                max_explicit = max(max_explicit, parse_uid(name))
+    if max_explicit:
+        zero_uids.bump_to(max_explicit)
     if not blanks:
         return {}
     start, _end = zero_uids.assign(len(blanks))

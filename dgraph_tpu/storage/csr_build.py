@@ -158,92 +158,105 @@ class GraphSnapshot:
         return total
 
 
+def build_pred(store: Store, attr: str, read_ts: int,
+               own_start_ts: int | None = None) -> PredData:
+    """Fold one predicate's tablets at read_ts into a PredData.
+
+    own_start_ts: when set, the caller's open txn's uncommitted layers are
+    visible too (posting/list.go:528 — postings with StartTs == readTs are
+    visible to their own txn). Such views must not be cached.
+    """
+    entry = store.schema.get(attr)
+    tid = entry.type_id if entry else TypeID.DEFAULT
+    pd = PredData(attr, tid)
+
+    fwd_rows: list[tuple[int, np.ndarray]] = []
+    val_subjects: list[int] = []
+    num_vals: list[float] = []
+    own = own_start_ts
+    for kb in store.keys_of(K.KeyKind.DATA, attr):
+        key = K.parse_key(kb)
+        pl = store.lists[kb]
+        # type heuristic for untyped predicates probes ANY value ("." tag);
+        # host_values below still reads only the untagged slot
+        if tid == TypeID.UID or (tid == TypeID.DEFAULT and
+                                 pl.value(read_ts, ".", own_start_ts=own) is None):
+            u = pl.uids(read_ts, own_start_ts=own)
+            if len(u):
+                fwd_rows.append((key.uid, u))
+            for p in pl.postings(read_ts, own_start_ts=own):
+                if p.facets:
+                    pd.facets[(key.uid, p.uid)] = p.facets
+        else:
+            v = pl.value(read_ts, own_start_ts=own)
+            if v is not None:
+                pd.host_values[key.uid] = v
+                val_subjects.append(key.uid)
+                s = to_device_scalar(v)
+                num_vals.append(np.nan if s is None else float(s))
+            # language-tagged values
+            had_lang = False
+            for p in pl.postings(read_ts, own_start_ts=own):
+                if p.value is not None and p.lang:
+                    pd.lang_values.setdefault(key.uid, {})[p.lang] = p.value
+                    had_lang = True
+                if p.facets:
+                    pd.facets[(key.uid, p.uid)] = p.facets
+            if v is None and had_lang:
+                # lang-only node: still a has(attr) subject (the reference's
+                # data key exists), but carries no untagged value
+                val_subjects.append(key.uid)
+                num_vals.append(np.nan)
+    if fwd_rows:
+        pd.csr = _csr_from_rows(fwd_rows)
+    if val_subjects:
+        order = np.argsort(np.asarray(val_subjects, dtype=np.int64))
+        vs = np.asarray(val_subjects, dtype=np.int64)[order]
+        if vs[-1] > MAX_DEVICE_UID:
+            raise ValueError("value subject uid exceeds device uid space")
+        pd.value_subjects = jnp.asarray(vs.astype(np.int32))
+        pd.num_values = jnp.asarray(
+            np.asarray(num_vals, dtype=np.float32)[order])
+
+    # reverse CSR
+    if entry is not None and entry.reverse:
+        rev_rows = []
+        for kb in store.keys_of(K.KeyKind.REVERSE, attr):
+            key = K.parse_key(kb)
+            u = store.lists[kb].uids(read_ts, own_start_ts=own)
+            if len(u):
+                rev_rows.append((key.uid, u))
+        if rev_rows:
+            pd.rev_csr = _csr_from_rows(rev_rows)
+
+    # token indexes, split per tokenizer by the 1-byte term prefix
+    if entry is not None and entry.indexed:
+        from dgraph_tpu.utils import tok as tokmod
+
+        by_tok: dict[str, list[tuple[bytes, np.ndarray]]] = {
+            name: [] for name in entry.tokenizers}
+        ident_to_name = {tokmod.get(n).ident: n for n in entry.tokenizers}
+        for kb in store.keys_of(K.KeyKind.INDEX, attr):
+            key = K.parse_key(kb)
+            if not key.term:
+                continue
+            name = ident_to_name.get(key.term[0])
+            if name is None:
+                continue
+            u = store.lists[kb].uids(read_ts, own_start_ts=own)
+            if len(u):
+                by_tok[name].append((key.term[1:], u))
+        for name, rows in by_tok.items():
+            pd.indexes[name] = _token_index(rows)
+    return pd
+
+
 def build_snapshot(store: Store, read_ts: int,
-                   attrs: Iterable[str] | None = None) -> GraphSnapshot:
+                   attrs: Iterable[str] | None = None,
+                   own_start_ts: int | None = None) -> GraphSnapshot:
     """Fold the store at read_ts into a GraphSnapshot (upload to device)."""
     snap = GraphSnapshot(read_ts)
     todo = sorted(attrs) if attrs is not None else store.predicates()
     for attr in todo:
-        entry = store.schema.get(attr)
-        tid = entry.type_id if entry else TypeID.DEFAULT
-        pd = PredData(attr, tid)
-
-        fwd_rows: list[tuple[int, np.ndarray]] = []
-        val_subjects: list[int] = []
-        num_vals: list[float] = []
-        for kb in store.keys_of(K.KeyKind.DATA, attr):
-            key = K.parse_key(kb)
-            pl = store.lists[kb]
-            # type heuristic for untyped predicates probes ANY value ("." tag);
-            # host_values below still reads only the untagged slot
-            if tid == TypeID.UID or (tid == TypeID.DEFAULT and pl.value(read_ts, ".") is None):
-                u = pl.uids(read_ts)
-                if len(u):
-                    fwd_rows.append((key.uid, u))
-                for p in pl.postings(read_ts):
-                    if p.facets:
-                        pd.facets[(key.uid, p.uid)] = p.facets
-            else:
-                v = pl.value(read_ts)
-                if v is not None:
-                    pd.host_values[key.uid] = v
-                    val_subjects.append(key.uid)
-                    s = to_device_scalar(v)
-                    num_vals.append(np.nan if s is None else float(s))
-                # language-tagged values
-                had_lang = False
-                for p in pl.postings(read_ts):
-                    if p.value is not None and p.lang:
-                        pd.lang_values.setdefault(key.uid, {})[p.lang] = p.value
-                        had_lang = True
-                    if p.facets:
-                        pd.facets[(key.uid, p.uid)] = p.facets
-                if v is None and had_lang:
-                    # lang-only node: still a has(attr) subject (the reference's
-                    # data key exists), but carries no untagged value
-                    val_subjects.append(key.uid)
-                    num_vals.append(np.nan)
-        if fwd_rows:
-            pd.csr = _csr_from_rows(fwd_rows)
-        if val_subjects:
-            order = np.argsort(np.asarray(val_subjects, dtype=np.int64))
-            vs = np.asarray(val_subjects, dtype=np.int64)[order]
-            if vs[-1] > MAX_DEVICE_UID:
-                raise ValueError("value subject uid exceeds device uid space")
-            pd.value_subjects = jnp.asarray(vs.astype(np.int32))
-            pd.num_values = jnp.asarray(
-                np.asarray(num_vals, dtype=np.float32)[order])
-
-        # reverse CSR
-        if entry is not None and entry.reverse:
-            rev_rows = []
-            for kb in store.keys_of(K.KeyKind.REVERSE, attr):
-                key = K.parse_key(kb)
-                u = store.lists[kb].uids(read_ts)
-                if len(u):
-                    rev_rows.append((key.uid, u))
-            if rev_rows:
-                pd.rev_csr = _csr_from_rows(rev_rows)
-
-        # token indexes, split per tokenizer by the 1-byte term prefix
-        if entry is not None and entry.indexed:
-            from dgraph_tpu.utils import tok as tokmod
-
-            by_tok: dict[str, list[tuple[bytes, np.ndarray]]] = {
-                name: [] for name in entry.tokenizers}
-            ident_to_name = {tokmod.get(n).ident: n for n in entry.tokenizers}
-            for kb in store.keys_of(K.KeyKind.INDEX, attr):
-                key = K.parse_key(kb)
-                if not key.term:
-                    continue
-                name = ident_to_name.get(key.term[0])
-                if name is None:
-                    continue
-                u = store.lists[kb].uids(read_ts)
-                if len(u):
-                    by_tok[name].append((key.term[1:], u))
-            for name, rows in by_tok.items():
-                pd.indexes[name] = _token_index(rows)
-
-        snap.preds[attr] = pd
+        snap.preds[attr] = build_pred(store, attr, read_ts, own_start_ts)
     return snap

@@ -87,6 +87,11 @@ class Store:
         self._lock = threading.RLock()
         self._wal: io.BufferedWriter | None = None
         self.max_seen_commit_ts = 0
+        # attr -> highest commit_ts of any commit touching it: the dirty
+        # watermark incremental snapshot builds compare against (the
+        # reference never rebuilds the world — posting/lists.go:243
+        # read-through; here clean predicates reuse device arrays)
+        self.pred_commit_ts: dict[str, int] = {}
         self.snapshot_ts = 0  # commits at/below this are folded into bases
         if dirpath:
             os.makedirs(dirpath, exist_ok=True)
@@ -135,7 +140,13 @@ class Store:
                 pl = self.lists.get(kb)
                 if pl is not None:
                     pl.commit(start_ts, commit_ts)
+                self._bump_pred_ts(kb, commit_ts)
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, commit_ts)
+
+    def _bump_pred_ts(self, kb: bytes, commit_ts: int) -> None:
+        attr = K.parse_key(kb).attr
+        if commit_ts > self.pred_commit_ts.get(attr, 0):
+            self.pred_commit_ts[attr] = commit_ts
 
     def abort(self, start_ts: int, key_bytes: list[bytes]) -> None:
         self._wal_write({"t": "a", "s": start_ts,
@@ -209,6 +220,7 @@ class Store:
             elif t == "c":
                 for kb64 in rec["k"]:
                     kb = base64.b64decode(kb64)
+                    self._bump_pred_ts(kb, rec["ts"])
                     pl = self.lists.get(kb)
                     if pl is None:
                         continue
