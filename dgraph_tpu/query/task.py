@@ -85,17 +85,22 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
     """uidMatrix for a frontier over one adjacency; device gather + host split."""
     if len(uids) == 0 or csr is None:
         return [np.zeros(0, np.int64) for _ in range(len(uids))], 0
-    rows = rows_for_uids(csr, uids)
-    cap = 1 << max(int(np.ceil(np.log2(max(csr.num_edges, 1) + 1))), 4)
-    res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=cap)
-    total = int(res.total)
-    if total > cap:  # capacity-class retry (cannot happen: cap >= num_edges)
-        res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=total)
-    targets = np.asarray(res.targets)[:total].astype(np.int64)
-    counts = np.asarray(res.counts)[: len(uids)]
-    offs = np.zeros(len(uids) + 1, dtype=np.int64)
-    np.cumsum(counts, out=offs[1:])
-    matrix = [targets[offs[i] : offs[i + 1]] for i in range(len(uids))]
+    if getattr(csr, "is_dist", False):
+        # mesh-sharded tablet: SPMD expand over the owning group's submesh
+        # (ProcessTaskOverNetwork remapped to ICI, parallel/dist.DistPredCSR)
+        matrix, total = csr.expand_matrix(uids)
+    else:
+        rows = rows_for_uids(csr, uids)
+        cap = 1 << max(int(np.ceil(np.log2(max(csr.num_edges, 1) + 1))), 4)
+        res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=cap)
+        total = int(res.total)
+        if total > cap:  # capacity-class retry (cannot happen: cap >= num_edges)
+            res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=total)
+        targets = np.asarray(res.targets)[:total].astype(np.int64)
+        counts = np.asarray(res.counts)[: len(uids)]
+        offs = np.zeros(len(uids) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        matrix = [targets[offs[i] : offs[i + 1]] for i in range(len(uids))]
     if first > 0:
         matrix = [m[:first] for m in matrix]
     elif first < 0:

@@ -1,0 +1,101 @@
+"""Distributed execution of REAL queries: the full DQL path over the mesh.
+
+Round-2 verdict item 3: process_task runs single-device and nothing consults
+the tablet map at query time. Here every uid-predicate expand runs SPMD over
+a virtual 2/4/8-device mesh (parallel/worker.distribute_snapshot +
+dist.DistPredCSR), routed by the Zero tablet map, and the JSON output is
+diffed against the single-device Executor. Reference: worker/task.go:137
+ProcessTaskOverNetwork + worker/groups.go:292 BelongsTo.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.parallel.mesh import make_mesh
+from dgraph_tpu.parallel.worker import distribute_snapshot, group_submesh
+from dgraph_tpu.query import dql
+from dgraph_tpu.query.engine import Executor
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node()
+    n.alter(schema_text="""
+        name: string @index(exact, term) .
+        age: int @index(int) .
+        follows: [uid] @reverse @count .
+        likes: [uid] .
+    """)
+    rng = np.random.default_rng(7)
+    people = [f'_:p{i} <name> "person{i}" .\n'
+              f'_:p{i} <age> "{20 + i % 40}"^^<xs:int> .'
+              for i in range(60)]
+    edges = []
+    for i in range(60):
+        for j in sorted(rng.choice(60, size=4, replace=False)):
+            if i != j:
+                edges.append(f"_:p{i} <follows> _:p{j} .")
+        if i % 3 == 0:
+            edges.append(f"_:p{i} <likes> _:p{(i * 7 + 1) % 60} .")
+    n.mutate(set_nquads="\n".join(people + edges), commit_now=True)
+    return n
+
+
+QUERIES = [
+    # 2-hop expansion with a filter — the verdict's named target
+    '{ q(func: eq(name, "person3")) { name follows @filter(ge(age, 25)) '
+    '{ name follows { name age } } } }',
+    # root index function + has-filter + count
+    '{ q(func: ge(age, 55)) @filter(has(likes)) { name count(follows) } }',
+    # reverse edges
+    '{ q(func: eq(name, "person5")) { name ~follows { name } } }',
+    # sort + pagination over an indexed predicate
+    '{ q(func: has(follows), orderasc: age, first: 7, offset: 3) { name age } }',
+    # recurse directive
+    '{ q(func: eq(name, "person1")) @recurse(depth: 3) { name follows } }',
+    # var propagation across blocks
+    '{ a as var(func: eq(name, "person2")) { f as follows }\n'
+    '  q(func: uid(f)) @filter(NOT uid(a)) { name } }',
+]
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_dist_query_matches_single_device(node, n_devices, qi):
+    q = QUERIES[qi]
+    single, _ = node.query(q)
+    mesh = make_mesh(n_devices)
+    dsnap = distribute_snapshot(node.snapshot(), mesh, node.zero)
+    dist_out = Executor(dsnap, node.store.schema).execute(dql.parse(q))
+    assert dist_out == single
+
+
+def test_tablet_routing_to_group_submeshes(node):
+    """With n_groups=2 on an 8-device mesh, predicates land on disjoint
+    4-device submeshes per the Zero tablet map, and results still match."""
+    mesh = make_mesh(8)
+    zero2 = type(node.zero)(n_groups=2)
+    dsnap = distribute_snapshot(node.snapshot(), mesh, zero2)
+    tablets = zero2.tablets()
+    assert set(tablets.values()) == {0, 1}, tablets
+    meshes = {attr: dsnap.preds[attr].csr.mesh
+              for attr in tablets if dsnap.preds[attr].csr is not None}
+    seen_devsets = {frozenset(d.id for d in m.devices.ravel())
+                    for m in meshes.values()}
+    assert len(seen_devsets) == 2
+    assert all(len(s) == 4 for s in seen_devsets)
+    q = QUERIES[0]
+    single, _ = node.query(q)
+    dist_out = Executor(dsnap, node.store.schema).execute(dql.parse(q))
+    assert dist_out == single
+
+
+def test_group_submesh_layout():
+    mesh = make_mesh(8)
+    subs = [group_submesh(mesh, 2, g) for g in range(2)]
+    ids = [sorted(d.id for d in m.devices.ravel()) for m in subs]
+    assert ids[0] + ids[1] == sorted(d.id for d in mesh.devices.ravel())
+    # degenerate: too few devices per group -> whole-mesh passthrough identity
+    m2 = make_mesh(2)
+    assert group_submesh(m2, 2, 0) is m2
