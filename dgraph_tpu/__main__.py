@@ -39,6 +39,53 @@ def cmd_version(_args) -> int:
     return 0
 
 
+def cmd_bulk(args) -> int:
+    from dgraph_tpu.loader.bulk import bulk_load
+
+    schema = ""
+    if args.schema:
+        with open(args.schema) as f:
+            schema = f.read()
+    stats = bulk_load(args.files, schema, args.out, workers=args.workers,
+                      progress=lambda n: print(f"  parsed {n} quads...",
+                                               flush=True))
+    print(f"bulk: {stats.edges} postings ({stats.uid_edges} uid edges, "
+          f"{stats.values} values) over {stats.nodes} nodes / "
+          f"{stats.predicates} predicates in {stats.seconds:.1f}s -> {args.out}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from dgraph_tpu.loader.export import export_rdf
+    from dgraph_tpu.storage.store import Store
+
+    store = Store(args.postings)
+    stats = export_rdf(store, args.out, schema_path=args.out_schema)
+    store.close()
+    print(f"export: {stats.quads} quads / {stats.predicates} predicates "
+          f"-> {args.out}")
+    return 0
+
+
+def cmd_live(args) -> int:
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.loader.live import live_load
+
+    node = Node(dirpath=args.postings)
+    if args.schema:
+        with open(args.schema) as f:
+            node.alter(schema_text=f.read())
+    try:
+        stats = live_load(node, args.files, batch=args.batch,
+                          progress=lambda n: print(f"  {n} quads...",
+                                                   flush=True))
+    finally:
+        node.close()
+    print(f"live: {stats.quads} quads in {stats.txns} txns "
+          f"({stats.aborts} retried aborts) -> {args.postings}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dgraph_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -53,6 +100,28 @@ def main(argv=None) -> int:
 
     vp = sub.add_parser("version", help="print version")
     vp.set_defaults(fn=cmd_version)
+
+    bp = sub.add_parser("bulk", help="offline bulk load RDF(.gz) -> snapshot")
+    bp.add_argument("-f", "--files", nargs="+", required=True)
+    bp.add_argument("-s", "--schema", default=None)
+    bp.add_argument("-o", "--out", required=True, help="output posting dir")
+    bp.add_argument("-j", "--workers", type=int, default=None)
+    bp.set_defaults(fn=cmd_bulk)
+
+    ep = sub.add_parser("export", help="export a posting dir to RDF(.gz)")
+    ep.add_argument("-p", "--postings", required=True)
+    ep.add_argument("-o", "--out", required=True)
+    ep.add_argument("--out-schema", default=None)
+    ep.set_defaults(fn=cmd_export)
+
+    lp = sub.add_parser("live", help="online load RDF through transactions")
+    lp.add_argument("-f", "--files", nargs="+", required=True)
+    lp.add_argument("-s", "--schema", default=None)
+    lp.add_argument("-p", "--postings", required=True,
+                    help="durable posting dir (an in-memory load would be "
+                         "discarded at exit)")
+    lp.add_argument("--batch", type=int, default=1000)
+    lp.set_defaults(fn=cmd_live)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -79,18 +79,27 @@ class Node:
         if maxuid:
             self.zero.uids.assign(maxuid)
 
+    # value-posting slots (lang/value fingerprints) carry the 1<<60 / 1<<61
+    # tag bits (storage/postings.py lang_uid/value_fingerprint) and must never
+    # be mistaken for uids when recovering the lease
+    _SLOT_BITS = 1 << 60
+
     def _max_uid_in_store(self) -> int:
         ts = self.store.max_seen_commit_ts
         m = 0
-        for (kind, _attr), keys in self.store.by_pred.items():
+        for (kind, attr), keys in self.store.by_pred.items():
             if kind not in (int(K.KeyKind.DATA), int(K.KeyKind.REVERSE)):
                 continue
+            entry = self.store.schema.get(attr)
+            uid_typed = entry is None or entry.type_id.name == "UID" or \
+                entry.type_id.name == "DEFAULT"
             for kb in keys:
                 key = K.parse_key(kb)
                 m = max(m, key.uid)
                 pl = self.store.lists.get(kb)
-                if pl is not None and kind == int(K.KeyKind.DATA):
+                if pl is not None and kind == int(K.KeyKind.DATA) and uid_typed:
                     u = pl.uids(max(ts, pl.base_ts))
+                    u = u[u < self._SLOT_BITS]
                     if len(u):
                         m = max(m, int(u[-1]))
         return m
@@ -234,6 +243,16 @@ class Node:
             nquads_set += mut.nquads_from_json(set_json, Op.SET)
         if delete_json is not None:
             nquads_del += mut.nquads_from_json(delete_json, Op.DEL)
+        return self.mutate_quads(nquads_set, nquads_del,
+                                 commit_now=commit_now, start_ts=start_ts)
+
+    def mutate_quads(self, nquads_set, nquads_del=(), *,
+                     commit_now: bool = False,
+                     start_ts: int | None = None) -> MutationResult:
+        """Mutate with pre-parsed NQuads (the loaders' entry — skips text
+        parsing; dgraph/cmd/live/batch.go feeds api.Mutation.Set directly)."""
+        nquads_set = list(nquads_set)
+        nquads_del = list(nquads_del)
         if not nquads_set and not nquads_del:
             raise mut.MutationError("empty mutation")
 

@@ -1,0 +1,11 @@
+"""Loaders & export: bulk (offline map/reduce), live (txn batches), xidmap,
+RDF export. Reference: dgraph/cmd/bulk, dgraph/cmd/live, xidmap/,
+worker/export.go."""
+
+from dgraph_tpu.loader.bulk import BulkStats, bulk_load
+from dgraph_tpu.loader.export import ExportStats, export_rdf
+from dgraph_tpu.loader.live import LiveStats, live_load
+from dgraph_tpu.loader.xidmap import XidMap
+
+__all__ = ["BulkStats", "bulk_load", "ExportStats", "export_rdf",
+           "LiveStats", "live_load", "XidMap"]

@@ -1,0 +1,310 @@
+"""Offline bulk loader: RDF(.gz) → packed posting snapshot, WAL bypassed.
+
+Reference semantics: dgraph/cmd/bulk — a local map/shuffle/reduce:
+  map    (mapper.go:121)  parallel RDF chunk parse → (key, posting) entries
+  shuffle (shuffle.go)    group by predicate
+  reduce (reduce.go:36)   k-way merge per key → bp128-packed PostingList
+                          written straight to badger SSTs (no Raft/WAL)
+plus xidmap for node names and a schema file.
+
+TPU redesign: the reduce target is this package's packed SoA posting format
+(storage/packed.py) installed as PostingList bases at one commit_ts, with
+token/reverse/count indexes built directly from numpy-grouped edge arrays —
+then one `Store.checkpoint` makes the snapshot durable. A `Node` opened on
+the output dir serves queries immediately (uid lease + ts recovery are the
+normal restart path, api/server.py Node.__init__).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import time
+from concurrent.futures import ProcessPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from dgraph_tpu.coord.zero import UidLease
+from dgraph_tpu.loader.xidmap import XidMap
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage import packed
+from dgraph_tpu.storage.index import index_tokens
+from dgraph_tpu.storage.postings import (Op, Posting, PostingList, lang_uid,
+                                         value_fingerprint)
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+from dgraph_tpu.utils.types import TypeID, Val, convert
+
+
+class BulkError(ValueError):
+    pass
+
+
+@dataclass
+class BulkStats:
+    edges: int = 0            # total postings written (uid + value)
+    uid_edges: int = 0
+    values: int = 0
+    nodes: int = 0            # distinct subjects
+    predicates: int = 0
+    xids: int = 0             # mapped external ids
+    seconds: float = 0.0
+
+
+CHUNK_LINES = 65536
+
+
+def _parse_chunk(payload: bytes) -> bytes:
+    """Worker: parse one text chunk → pickled column lists (spawn-safe:
+    imports stay inside so workers never touch jax/TPU state).
+
+    Columns instead of NQuad objects: unpickling a million dataclasses in
+    the parent dominated load time (~40s/M); flat str/None lists unpickle
+    ~8x faster (the map/reduce handoff of mapper.go is also a flat
+    MapEntry stream, not parsed structs)."""
+    from dgraph_tpu.query import rdf
+
+    subs, preds, objs, vals, langs, facets, stars = [], [], [], [], [], [], []
+    for line in payload.decode("utf-8").splitlines():
+        # fast path for the dominant bulk shape `<s> <p> <o> .` / blank nodes
+        # with no literals/facets — 3-4x the full-grammar regex
+        if '"' not in line and "(" not in line:
+            parts = line.split()
+            if (len(parts) == 4 and parts[3] == "."
+                    and parts[0][0] in "<_" and parts[1][0] == "<"
+                    and parts[2][0] in "<_"):
+                subs.append(parts[0][1:-1] if parts[0][0] == "<" else parts[0])
+                preds.append(parts[1][1:-1])
+                objs.append(parts[2][1:-1] if parts[2][0] == "<" else parts[2])
+                vals.append(None)
+                langs.append("")
+                facets.append(None)
+                stars.append(False)
+                continue
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+        for q in rdf.parse(line):
+            subs.append(q.subject)
+            preds.append(q.predicate)
+            objs.append(q.object_id)
+            vals.append(q.object_value)
+            langs.append(q.lang)
+            facets.append(tuple(sorted(q.facets)) if q.facets else None)
+            stars.append(q.star)
+    return pickle.dumps((subs, preds, objs, vals, langs, facets, stars),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _read_chunks(path: str):
+    op = gzip.open if path.endswith(".gz") else open
+    buf: list[str] = []
+    with op(path, "rt", encoding="utf-8") as f:
+        for line in f:
+            buf.append(line)
+            if len(buf) >= CHUNK_LINES:
+                yield "".join(buf).encode("utf-8")
+                buf = []
+    if buf:
+        yield "".join(buf).encode("utf-8")
+
+
+def _map_stage(paths: list[str], workers: int):
+    """Parallel parse (the reference's map goroutines, mapper.go:121).
+
+    Yields (subject, predicate, object_id, object_value, lang, facets, star)
+    column tuples per chunk."""
+    chunks = (c for p in paths for c in _read_chunks(p))
+    if workers <= 1:
+        for c in chunks:
+            yield pickle.loads(_parse_chunk(c))
+        return
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")   # never fork a process holding TPU state
+    # strip TPU-plugin site dirs (exact dir name match, not substring) from
+    # the workers' env: their sitecustomize imports jax at interpreter
+    # startup (seconds per worker, and pointless — parse workers are
+    # pure-CPU string work). Restored in finally; the window where another
+    # thread could spawn a subprocess with the reduced path is accepted.
+    old_pp = os.environ.get("PYTHONPATH")
+    if old_pp is not None:
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            p for p in old_pp.split(os.pathsep)
+            if os.path.basename(p.rstrip("/")) != ".axon_site")
+    try:
+        with ProcessPoolExecutor(max_workers=workers, mp_context=ctx) as ex:
+            for blob in ex.map(_parse_chunk, chunks):
+                yield pickle.loads(blob)
+    finally:
+        if old_pp is not None:
+            os.environ["PYTHONPATH"] = old_pp
+
+
+def iter_quads(paths: list[str], workers: int):
+    """Row iterator over _map_stage for consumers that want NQuad-shaped
+    tuples: (subject, predicate, object_id, object_value, lang, facets, star)."""
+    for cols in _map_stage(paths, workers):
+        yield from zip(*cols)
+
+
+def _group_rows(subs: np.ndarray, objs: np.ndarray):
+    """Sort (subject, object) edge arrays and yield (subject, sorted unique
+    object array) per subject — the reduce step's k-way merge, vectorized."""
+    order = np.lexsort((objs, subs))
+    subs, objs = subs[order], objs[order]
+    uq, starts = np.unique(subs, return_index=True)
+    bounds = np.append(starts, len(subs))
+    for i, s in enumerate(uq):
+        row = objs[bounds[i]:bounds[i + 1]]
+        yield int(s), np.unique(row)
+
+
+def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
+              workers: int | None = None, commit_ts: int = 1,
+              progress=None) -> BulkStats:
+    """Load RDF file(s) into a fresh posting snapshot at out_dir."""
+    t0 = time.perf_counter()
+    paths = [rdf_paths] if isinstance(rdf_paths, str) else list(rdf_paths)
+    for p in paths:
+        if not os.path.exists(p):
+            raise BulkError(f"no such file: {p}")
+    store = Store(out_dir)
+    if store.lists:
+        store.close()
+        raise BulkError(f"{out_dir} already contains a posting store")
+    workers = workers if workers is not None else min(8, os.cpu_count() or 1)
+
+    lease = UidLease()
+    xm = XidMap(lease)
+    stats = BulkStats()
+
+    # -- map + shuffle: group parsed quads by predicate ----------------------
+    uid_sub: dict[str, list[int]] = {}
+    uid_obj: dict[str, list[int]] = {}
+    uid_facets: dict[str, dict[tuple[int, int], tuple]] = {}
+    val_rows: dict[str, dict[int, list]] = {}   # attr -> subj -> [(lang, Val, facets)]
+    n = 0
+    xid = xm.uid
+    for subs_c, preds_c, objs_c, vals_c, langs_c, facets_c, stars_c in \
+            _map_stage(paths, workers):
+        for subj, pred, obj, val, lang, facets, star in \
+                zip(subs_c, preds_c, objs_c, vals_c, langs_c, facets_c, stars_c):
+            if star or pred == "*":
+                raise BulkError("deletes are not valid in a bulk load")
+            s = xid(subj)
+            if obj:
+                uid_sub.setdefault(pred, []).append(s)
+                uid_obj.setdefault(pred, []).append(xid(obj))
+                if facets:
+                    uid_facets.setdefault(pred, {})[(s, uid_obj[pred][-1])] = facets
+            else:
+                val_rows.setdefault(pred, {}).setdefault(s, []).append(
+                    (lang, val, facets or ()))
+        n += len(subs_c)
+        if progress and n % 500000 < len(subs_c):
+            progress(n)
+
+    with store.suspend_wal():
+        for e in parse_schema(schema_text or ""):
+            store.set_schema(e)
+        lists: dict[bytes, PostingList] = {}
+        subjects_seen: set[int] = set()
+        batch_keys: list[bytes] = []        # packed in one pack_many pass
+        batch_rows: list[np.ndarray] = []
+        batch_postings: dict[bytes, dict[int, Posting]] = {}
+
+        def emit(kb: bytes, row: np.ndarray,
+                 postings: dict[int, Posting] | None = None) -> None:
+            batch_keys.append(kb)
+            batch_rows.append(row)
+            if postings:
+                batch_postings[kb] = postings
+
+        # -- reduce: uid predicates → packed CSR-style bases -----------------
+        for attr in sorted(uid_sub):
+            entry = store.schema.ensure(attr, TypeID.UID)
+            subs = np.asarray(uid_sub[attr], dtype=np.int64)
+            objs = np.asarray(uid_obj[attr], dtype=np.int64)
+            facets = uid_facets.get(attr, {})
+            rev_sub: dict[int, list[int]] = {}
+            deg_pairs: list[tuple[int, int]] = []
+            for s, row in _group_rows(subs, objs):
+                postings = None
+                if facets:
+                    postings = {o: Posting(o, Op.SET, facets=facets[(s, o)])
+                                for o in row.tolist() if (s, o) in facets}
+                emit(K.data_key(attr, s).encode(), row, postings)
+                subjects_seen.add(s)
+                stats.uid_edges += len(row)
+                if entry.reverse:
+                    for o in row.tolist():
+                        rev_sub.setdefault(int(o), []).append(s)
+                if entry.count:
+                    deg_pairs.append((len(row), s))
+            for o, srcs in rev_sub.items():
+                emit(K.reverse_key(attr, o).encode(),
+                     np.unique(np.asarray(srcs, dtype=np.int64)))
+            if entry.count:
+                by_deg: dict[int, list[int]] = {}
+                for d, s in deg_pairs:
+                    by_deg.setdefault(d, []).append(s)
+                for d, ss in by_deg.items():
+                    emit(K.count_key(attr, d).encode(),
+                         np.unique(np.asarray(ss, dtype=np.int64)))
+
+        # -- reduce: value predicates → value bases + token indexes ----------
+        for attr in sorted(val_rows):
+            if attr in uid_sub:
+                raise BulkError(
+                    f"predicate <{attr}> carries both uid edges and literal "
+                    f"values in the input — pick one representation")
+            first_val = next(iter(val_rows[attr].values()))[0][1]
+            entry = store.schema.ensure(attr, first_val.tid)
+            tokens: dict[bytes, list[int]] = {}
+            for s, triples in val_rows[attr].items():
+                slots, postings = [], {}
+                for lang, v, fa in triples:
+                    if entry.type_id not in (TypeID.DEFAULT, v.tid):
+                        try:
+                            v = convert(v, entry.type_id)
+                        except ValueError as e:
+                            raise BulkError(
+                                f"predicate <{attr}>, subject 0x{s:x}: "
+                                f"{e}") from e
+                    slot = value_fingerprint(v) if entry.is_list \
+                        else lang_uid(lang)
+                    slots.append(slot)
+                    postings[slot] = Posting(slot, Op.SET, v, lang, fa)
+                    if entry.indexed:
+                        for tk in index_tokens(entry, v):
+                            tokens.setdefault(tk, []).append(s)
+                    stats.values += 1
+                emit(K.data_key(attr, s).encode(),
+                     np.unique(np.asarray(slots, dtype=np.uint64)), postings)
+                subjects_seen.add(s)
+            for tk, ss in tokens.items():
+                emit(K.index_key(attr, tk).encode(),
+                     np.unique(np.asarray(ss, dtype=np.int64)))
+
+        # one vectorized pack across every list (reduce.go's per-key pack,
+        # batched for numpy)
+        for kb, pu in zip(batch_keys, packed.pack_many(batch_rows)):
+            pl = PostingList()
+            pl.base_ts = commit_ts
+            pl.base_packed = pu
+            pl.base_postings = batch_postings.get(kb, {})
+            lists[kb] = pl
+
+        store.bulk_install(lists, commit_ts)
+        stats.nodes = len(subjects_seen)
+        stats.predicates = len(uid_sub) + len(val_rows)
+        stats.xids = len(xm)
+        stats.edges = stats.uid_edges + stats.values
+    store.checkpoint(commit_ts)
+    if out_dir:
+        xm.save(os.path.join(out_dir, "xidmap.json"))
+    store.close()
+    stats.seconds = time.perf_counter() - t0
+    return stats

@@ -1,0 +1,66 @@
+"""Online (live) loader: batched mutations through a running node.
+
+Reference semantics: dgraph/cmd/live/run.go + batch.go — parse RDF, batch N
+quads per txn, M concurrent in-flight txns with retry on ABORTED, xidmap for
+blank nodes/IRIs shared across batches so identities stay stable. Here the
+loader drives an embedded Node (the in-process analog of the gRPC client);
+batches run through the normal Mutate/Commit path, so indexes, conflict
+detection, and the WAL all apply — the durable-but-slower sibling of
+loader/bulk.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dgraph_tpu.coord.zero import TxnConflict
+from dgraph_tpu.loader.bulk import iter_quads
+from dgraph_tpu.loader.xidmap import XidMap
+from dgraph_tpu.query.rdf import NQuad
+
+
+@dataclass
+class LiveStats:
+    quads: int = 0
+    txns: int = 0
+    aborts: int = 0
+
+
+def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
+              retries: int = 3, workers: int = 1,
+              xm: XidMap | None = None, progress=None) -> LiveStats:
+    """Stream RDF file(s) into a node as committed transactions."""
+    paths = [rdf_paths] if isinstance(rdf_paths, str) else list(rdf_paths)
+    xm = xm or XidMap(node.zero.uids)
+    stats = LiveStats()
+    pending: list = []
+
+    def flush():
+        if not pending:
+            return
+        for attempt in range(retries + 1):
+            try:
+                node.mutate_quads(pending, commit_now=True)
+                stats.txns += 1
+                break
+            except TxnConflict:
+                stats.aborts += 1
+                if attempt == retries:
+                    raise
+        pending.clear()
+
+    for subj, pred, obj, val, lang, facets, star in iter_quads(paths, workers):
+        # pin identities through the shared xidmap: same name in different
+        # batches must hit the same uid (live/batch.go uid lookups)
+        pending.append(NQuad(
+            subject=f"0x{xm.uid(subj):x}", predicate=pred,
+            object_id=f"0x{xm.uid(obj):x}" if obj else "",
+            object_value=val, lang=lang,
+            facets=list(facets) if facets else [], star=star))
+        stats.quads += 1
+        if len(pending) >= batch:
+            flush()
+            if progress and stats.quads % 100000 < batch:
+                progress(stats.quads)
+    flush()
+    return stats

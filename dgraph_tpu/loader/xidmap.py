@@ -1,0 +1,74 @@
+"""External-id → uid assignment for loaders.
+
+Reference semantics: xidmap/xidmap.go:30 — loaders map RDF node names
+(blank nodes, IRIs) to uids, leasing uid ranges from Zero; names that parse
+as uids ("0x2a", "123") pass through and advance the lease so later leased
+blocks can never collide. The reference shards an LRU over badger; here the
+map is an in-memory dict with JSON save/load (bulk outputs persist it next
+to the posting snapshot so a follow-up live load keeps identities).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from dgraph_tpu.coord.zero import LEASE_BLOCK, UidLease
+
+
+def parse_uid_literal(xid: str) -> int | None:
+    """'0x2a' / '123' → uid, else None (a name to map)."""
+    try:
+        u = int(xid, 0)
+    except ValueError:
+        return None
+    return u if u > 0 else None
+
+
+class XidMap:
+    def __init__(self, lease: UidLease, block: int = LEASE_BLOCK) -> None:
+        self._lease = lease
+        self._block = block
+        self._map: dict[str, int] = {}
+        self._taken: set[int] = set()   # explicit uids seen (never hand out)
+        self._next = 0
+        self._end = -1   # exhausted
+
+    def uid(self, xid: str) -> int:
+        u = self._map.get(xid)
+        if u is not None:
+            return u
+        explicit = parse_uid_literal(xid)
+        if explicit is not None:
+            # reserve: the uid may fall inside an already-leased block
+            self._taken.add(explicit)
+            self._lease.bump_to(explicit)
+            return explicit
+        while True:
+            if self._next > self._end:
+                self._next, self._end = self._lease.assign(self._block)
+            u = self._next
+            self._next += 1
+            if u not in self._taken:
+                break
+        self._map[xid] = u
+        return u
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._map, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str, lease: UidLease,
+             block: int = LEASE_BLOCK) -> "XidMap":
+        xm = cls(lease, block)
+        with open(path) as f:
+            xm._map = {k: int(v) for k, v in json.load(f).items()}
+        if xm._map:
+            lease.bump_to(max(xm._map.values()))
+        return xm

@@ -52,7 +52,7 @@ _LINE_RE = re.compile(
     (?P<subj><[^>]+>|_:[A-Za-z0-9_.\-]+)\s+
     (?P<pred><[^>]+>|\*|[^\s<>]+)\s+
     (?P<obj><[^>]+>|_:[A-Za-z0-9_.\-]+|\*|"(?:\\.|[^"\\])*"(?:@[A-Za-z\-:]+|\^\^<[^>]+>)?)
-    \s*(?P<facets>\([^)]*\))?\s*
+    \s*(?P<facets>\((?:"(?:\\.|[^"\\])*"|[^)"])*\))?\s*
     (?:<[^>]*>\s*)?      # optional label/graph — ignored
     \.\s*(?:\#.*)?$""",
     re.VERBOSE,
@@ -72,7 +72,11 @@ def _parse_facet_val(raw: str) -> Val:
     if raw in ("true", "false"):
         return Val(TypeID.BOOL, raw == "true")
     if raw.startswith('"') and raw.endswith('"'):
-        return Val(TypeID.STRING, raw[1:-1])
+        # quoted facet string: unescape \" \\ \n \t (export round-trip)
+        body = re.sub(r"\\(.)",
+                      lambda m: {"n": "\n", "t": "\t"}.get(m.group(1), m.group(1)),
+                      raw[1:-1])
+        return Val(TypeID.STRING, body)
     try:
         return Val(TypeID.DATETIME, parse_datetime(raw))
     except ValueError:
@@ -104,7 +108,11 @@ def parse_line(line: str) -> NQuad | None:
         text = re.sub(r"\\(.)", lambda mm: {"n": "\n", "t": "\t"}.get(mm.group(1), mm.group(1)),
                       body_m.group(1))
         lang, typ = body_m.group(2), body_m.group(3)
-        if typ:
+        if typ == "pwd:hashed":
+            # already-hashed password (export round-trip: converting through
+            # STRING->PASSWORD would bcrypt the hash again)
+            nq.object_value = Val(TypeID.PASSWORD, text)
+        elif typ:
             tid = _XSD_TYPES.get(typ)
             if tid is None:
                 raise RDFError(f"unknown literal type <{typ}>")
@@ -123,11 +131,15 @@ def parse_line(line: str) -> NQuad | None:
 
 
 def _split_facets(s: str) -> list[str]:
-    out, cur, depth, in_str = [], [], 0, False
+    out, cur, in_str, esc = [], [], False, False
     for c in s:
-        if c == '"':
+        if esc:
+            esc = False
+        elif c == "\\" and in_str:
+            esc = True
+        elif c == '"':
             in_str = not in_str
-        if c == "," and not in_str and depth == 0:
+        if c == "," and not in_str:
             out.append("".join(cur))
             cur = []
         else:

@@ -124,6 +124,86 @@ def pack(uids) -> PackedUidList:
     return PackedUidList(n, block_first, block_last, counts, widths, offs, words[:-1])
 
 
+def pack_many(rows: list[np.ndarray]) -> list[PackedUidList]:
+    """Pack many sorted uid arrays in one vectorized pass.
+
+    Semantically identical to [pack(r) for r in rows] but amortizes numpy
+    call overhead across rows — the bulk loader packs hundreds of thousands
+    of small per-subject lists (reduce.go:36 packs per key too, but in Go a
+    call is cheap; in numpy the per-call fixed cost dominates tiny lists).
+    Metadata and word arrays of each result are zero-copy slices of shared
+    buffers."""
+    R = len(rows)
+    lens = np.fromiter((len(r) for r in rows), dtype=np.int64, count=R)
+    nbs = -(-lens // BLOCK)                       # blocks per row (0 for empty)
+    nonempty = lens > 0
+    if not nonempty.any():
+        return [pack(np.zeros(0, dtype=np.uint64)) for _ in rows]
+    concat = np.concatenate([np.asarray(r, dtype=np.uint64)
+                             for r, ne in zip(rows, nonempty) if ne])
+    row_start = np.zeros(R, dtype=np.int64)
+    np.cumsum(lens[:-1], out=row_start[1:])
+    row_block_start = np.zeros(R, dtype=np.int64)
+    np.cumsum(nbs[:-1], out=row_block_start[1:])
+    NB = int(nbs.sum())
+
+    block_row = np.repeat(np.arange(R, dtype=np.int64), nbs)          # [NB]
+    block_pos = np.arange(NB, dtype=np.int64) - row_block_start[block_row]
+    lane = np.arange(BLOCK, dtype=np.int64)
+    elem = (row_start[block_row, None] + block_pos[:, None] * BLOCK
+            + lane[None, :])
+    row_end = row_start[block_row] + lens[block_row]                  # [NB]
+    blocks = concat[np.minimum(elem, (row_end - 1)[:, None])]         # pad=last
+
+    deltas = np.zeros_like(blocks)
+    deltas[:, 1:] = blocks[:, 1:] - blocks[:, :-1]
+    block_first = np.ascontiguousarray(blocks[:, 0])
+    counts = np.minimum(BLOCK, lens[block_row] - block_pos * BLOCK).astype(np.int32)
+    block_last = blocks[np.arange(NB), counts - 1].copy()
+    widths = _width_for(deltas.max(axis=1))
+
+    words_per_block = np.where(widths == 64, 2 * BLOCK,
+                               -(-(BLOCK * widths) // 32)).astype(np.int64)
+    offs = np.zeros(NB, dtype=np.int64)
+    offs[1:] = np.cumsum(words_per_block)[:-1]
+    total_words = int(words_per_block.sum())
+    words = np.zeros(total_words + 1, dtype=np.uint32)
+
+    raw = widths == 64
+    if raw.any():
+        for b in np.nonzero(raw)[0]:
+            d, o = deltas[b], offs[b]
+            words[o : o + 2 * BLOCK : 2] = (d & _MASK32).astype(np.uint32)
+            words[o + 1 : o + 1 + 2 * BLOCK : 2] = (d >> np.uint64(32)).astype(np.uint32)
+    bp = np.nonzero(~raw & (widths > 0))[0]
+    if len(bp) > 0:
+        w = widths[bp][:, None].astype(np.int64)
+        bitpos = lane[None, :] * w
+        widx = offs[bp][:, None] + (bitpos >> 5)
+        shift = (bitpos & 31).astype(np.uint64)
+        v = deltas[bp]
+        lo = ((v << shift) & _MASK32).astype(np.uint32)
+        hi = (v >> (np.uint64(32) - shift)).astype(np.uint32)
+        np.bitwise_or.at(words, widx.ravel(), lo.ravel())
+        np.bitwise_or.at(words, (widx + 1).ravel(), hi.ravel())
+
+    out: list[PackedUidList] = []
+    word_ends = offs + words_per_block
+    for r in range(R):
+        n = int(lens[r])
+        if n == 0:
+            out.append(pack(np.zeros(0, dtype=np.uint64)))
+            continue
+        b0 = int(row_block_start[r])
+        b1 = b0 + int(nbs[r])
+        wbase = int(offs[b0])
+        wend = int(word_ends[b1 - 1])
+        out.append(PackedUidList(
+            n, block_first[b0:b1], block_last[b0:b1], counts[b0:b1],
+            widths[b0:b1], offs[b0:b1] - wbase, words[wbase:wend]))
+    return out
+
+
 def unpack(pl: PackedUidList) -> np.ndarray:
     """Decode every uid (numpy mirror of the device kernel in ops/packed_decode.py)."""
     nb = pl.nblocks
@@ -147,6 +227,57 @@ def unpack(pl: PackedUidList) -> np.ndarray:
     lane = np.tile(np.arange(BLOCK), nb)
     keep = lane < np.repeat(pl.block_count, BLOCK)
     return out.ravel()[keep]
+
+
+def unpack_many(pls: list[PackedUidList]) -> list[np.ndarray]:
+    """Decode many packed lists in one vectorized pass (mirror of pack_many:
+    snapshot builds decode every list of a tablet; per-call numpy overhead
+    dominates small lists)."""
+    R = len(pls)
+    nbs = np.fromiter((p.nblocks for p in pls), dtype=np.int64, count=R)
+    NB = int(nbs.sum())
+    if NB == 0:
+        return [np.zeros(0, dtype=np.uint64) for _ in pls]
+    nz = [p for p in pls if p.nblocks]
+    word_lens = np.fromiter((len(p.words) for p in nz), dtype=np.int64,
+                            count=len(nz))
+    word_base = np.zeros(len(nz), dtype=np.int64)
+    np.cumsum(word_lens[:-1], out=word_base[1:])
+    words = np.concatenate([p.words for p in nz] + [np.zeros(2, np.uint32)])
+    block_first = np.concatenate([p.block_first for p in nz])
+    block_count = np.concatenate([p.block_count for p in nz])
+    block_width = np.concatenate([p.block_width for p in nz])
+    block_off = np.concatenate(
+        [p.block_off + b for p, b in zip(nz, word_base)])
+
+    w = block_width[:, None].astype(np.int64)
+    raw = block_width == 64
+    bitpos = np.arange(BLOCK, dtype=np.int64)[None, :] * np.where(w == 64, 0, w)
+    widx = block_off[:, None] + (bitpos >> 5)
+    shift = (bitpos & 31).astype(np.uint64)
+    pair = words[widx].astype(np.uint64) | (words[widx + 1].astype(np.uint64) << np.uint64(32))
+    mask = np.where(w >= 32, _MASK32, (np.uint64(1) << w.astype(np.uint64)) - np.uint64(1))
+    deltas = (pair >> shift) & mask
+    deltas = np.where(w == 0, np.uint64(0), deltas)
+    if raw.any():
+        ro = block_off[raw][:, None] + 2 * np.arange(BLOCK, dtype=np.int64)[None, :]
+        deltas[raw] = words[ro].astype(np.uint64) | (words[ro + 1].astype(np.uint64) << np.uint64(32))
+    deltas[:, 0] = 0
+    all_vals = block_first[:, None] + np.cumsum(deltas, axis=1)   # [NB, 128]
+
+    out: list[np.ndarray] = []
+    b0 = 0
+    for p, nb in zip(pls, nbs):
+        if nb == 0:
+            out.append(np.zeros(0, dtype=np.uint64))
+            continue
+        rows = all_vals[b0 : b0 + nb]
+        cnts = block_count[b0 : b0 + nb]
+        lane = np.tile(np.arange(BLOCK), int(nb))
+        keep = lane < np.repeat(cnts, BLOCK)
+        out.append(rows.ravel()[keep])
+        b0 += int(nb)
+    return out
 
 
 def seek_block(pl: PackedUidList, after_uid: int) -> int:

@@ -23,6 +23,7 @@ x/keys.go; here they're an explicit set).
 from __future__ import annotations
 
 import base64
+import contextlib
 import io
 import json
 import os
@@ -186,6 +187,35 @@ class Store:
                     self.lists.pop(kb, None)
                     self.dirty.discard(kb)
             self.schema.delete(attr)
+
+    # -- bulk ingest ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def suspend_wal(self):
+        """Run with the WAL off (bulk loads write packed bases directly and
+        then checkpoint — reference bulk loader writes SSTs, not the Raft
+        WAL, dgraph/cmd/bulk/reduce.go:36)."""
+        wal, self._wal = self._wal, None
+        try:
+            yield self
+        finally:
+            self._wal = wal
+
+    def bulk_install(self, lists: dict[bytes, "PostingList"],
+                     commit_ts: int) -> None:
+        """Register fully-built posting lists (packed bases at commit_ts).
+
+        The caller is expected to run under suspend_wal() and checkpoint()
+        afterwards so durability comes from the snapshot, not per-posting
+        WAL records."""
+        with self._lock:
+            for kb, pl in lists.items():
+                key = K.parse_key(kb)
+                self.lists[kb] = pl
+                self.by_pred.setdefault((int(key.kind), key.attr), set()).add(kb)
+                if commit_ts > self.pred_commit_ts.get(key.attr, 0):
+                    self.pred_commit_ts[key.attr] = commit_ts
+            self.max_seen_commit_ts = max(self.max_seen_commit_ts, commit_ts)
 
     # -- WAL ----------------------------------------------------------------
 

@@ -71,3 +71,29 @@ def test_device_rejects_wide_uids(rng):
     pl = packed.pack(np.array([1, 2**33], dtype=np.uint64))
     with pytest.raises(ValueError):
         packed_decode.to_device(pl)
+
+
+def test_pack_many_matches_pack(rng):
+    from dgraph_tpu.storage import packed
+
+    rows = [
+        np.zeros(0, dtype=np.uint64),
+        np.array([5], dtype=np.uint64),
+        np.unique(rng.integers(0, 10**6, size=20).astype(np.uint64)),
+        np.unique(rng.integers(0, 10**9, size=300).astype(np.uint64)),
+        np.arange(128, dtype=np.uint64) * 7 + 3,          # exactly one block
+        np.arange(129, dtype=np.uint64),                  # block boundary + 1
+        np.array([1, 2**33, 2**40], dtype=np.uint64),     # raw64 escape
+        np.unique(rng.integers(0, 50, size=10).astype(np.uint64)),
+    ]
+    many = packed.pack_many(rows)
+    assert len(many) == len(rows)
+    for row, pm in zip(rows, many):
+        one = packed.pack(row)
+        np.testing.assert_array_equal(packed.unpack(pm), row)
+        np.testing.assert_array_equal(packed.unpack(pm), packed.unpack(one))
+        assert pm.count == one.count
+        np.testing.assert_array_equal(pm.block_first, one.block_first)
+        np.testing.assert_array_equal(pm.block_last, one.block_last)
+        np.testing.assert_array_equal(pm.block_count, one.block_count)
+        np.testing.assert_array_equal(pm.block_width, one.block_width)
