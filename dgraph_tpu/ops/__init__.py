@@ -30,3 +30,7 @@ from dgraph_tpu.ops.csr import (  # noqa: F401
     expand_dest,
     degrees,
 )
+from dgraph_tpu.ops.segments import (  # noqa: F401
+    group_reduce,
+    segment_reduce,
+)

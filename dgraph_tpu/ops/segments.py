@@ -1,0 +1,71 @@
+"""Segmented reductions — @groupby / aggregation on device.
+
+Reference semantics: query/groupby.go:43-75,142-165 aggregates (count / min /
+max / sum / avg) per group by iterating each group's uid list;
+query/aggregator.go applies the op pairwise. TPU redesign: groups become
+segment ids and every group's aggregate computes in ONE
+jax.ops.segment_* call over the flat member array — the canonical
+segment-reduction mapping of SURVEY.md §7 step 5.
+
+Host-facing entry: `group_reduce(op, seg_ids, values, num_groups)` takes
+numpy arrays (the engine's group assembly is host work), runs the fused
+device reduction, and returns a numpy vector of per-group results with NaN
+for empty groups (the caller drops them, matching the reference's
+"aggregate of no values is absent" behavior).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_OPS = ("sum", "min", "max", "avg", "count")
+
+
+@partial(jax.jit, static_argnames=("op", "num_segments"))
+def segment_reduce(values: jax.Array, seg_ids: jax.Array, *, op: str,
+                   num_segments: int) -> jax.Array:
+    """One fused reduction over all segments.
+
+    values: float32[N] (NaN = missing — excluded from every op)
+    seg_ids: int32[N] in [0, num_segments)
+    Returns float32[num_segments]; empty segments yield NaN (count yields 0).
+    """
+    valid = ~jnp.isnan(values)
+    ns = num_segments
+    cnt = jax.ops.segment_sum(valid.astype(jnp.float32), seg_ids, ns)
+    if op == "count":
+        return cnt
+    empty = cnt == 0
+    if op == "sum" or op == "avg":
+        s = jax.ops.segment_sum(jnp.where(valid, values, 0.0), seg_ids, ns)
+        out = s / jnp.maximum(cnt, 1.0) if op == "avg" else s
+    elif op == "min":
+        out = jax.ops.segment_min(jnp.where(valid, values, jnp.inf), seg_ids, ns)
+    elif op == "max":
+        out = jax.ops.segment_max(jnp.where(valid, values, -jnp.inf), seg_ids, ns)
+    else:
+        raise ValueError(f"unknown segment op {op!r}")
+    return jnp.where(empty, jnp.nan, out)
+
+
+def group_reduce(op: str, seg_ids: np.ndarray, values: np.ndarray,
+                 num_groups: int) -> np.ndarray:
+    """numpy → device → numpy wrapper (empty input → all-NaN/0 vector)."""
+    if op not in _OPS:
+        raise ValueError(f"unknown segment op {op!r}")
+    if num_groups == 0:
+        return np.zeros(0, dtype=np.float32)
+    if len(seg_ids) == 0:
+        out = np.full(num_groups, np.nan, dtype=np.float32)
+        if op == "count":
+            out[:] = 0.0
+        return out
+    res = segment_reduce(
+        jnp.asarray(np.asarray(values, dtype=np.float32)),
+        jnp.asarray(np.asarray(seg_ids, dtype=np.int32)),
+        op=op, num_segments=int(num_groups))
+    return np.asarray(res)

@@ -202,3 +202,48 @@ def paginate(a: jax.Array, offset, count) -> jax.Array:
     end = jnp.where(count < 0, total, off + count)
     keep = (ranks >= off) & (ranks < end)
     return compact(apply_filter(a, keep))
+
+
+# ---------------------------------------------------------------------------
+# Host-facing dispatchers (the engine's DestUIDs/filter combine seam)
+# ---------------------------------------------------------------------------
+
+# below this size numpy's C set ops beat a device round-trip; above it the
+# device path wins and keeps the shape-class count small (pow2 capacities)
+HOST_CUTOVER = 8192
+
+
+def _pow2_cap(n: int) -> int:
+    return 1 << max(int(np.ceil(np.log2(max(n, 1)))), 4)
+
+
+def intersect_host(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sorted-unique int64 intersection; device algebra above HOST_CUTOVER.
+
+    Reference: query/query.go:1924 DestUIDs = IntersectSorted(uidMatrix) —
+    the per-level combine the engine runs constantly."""
+    if min(len(a), len(b)) < HOST_CUTOVER:
+        return np.intersect1d(a, b)
+    small, big = (a, b) if len(a) <= len(b) else (b, a)
+    sa = make_set(small, capacity=_pow2_cap(len(small)))
+    sb = make_set(big, capacity=_pow2_cap(len(big)))
+    return to_numpy(intersect(sa, sb)).astype(np.int64)
+
+
+def union_host(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sorted-unique int64 union; device merge above HOST_CUTOVER."""
+    if min(len(a), len(b)) < HOST_CUTOVER:
+        return np.union1d(a, b)
+    cap = _pow2_cap(len(a) + len(b))
+    sa = make_set(a, capacity=_pow2_cap(len(a)))
+    sb = make_set(b, capacity=_pow2_cap(len(b)))
+    return to_numpy(merge(sa, sb, out_size=cap)).astype(np.int64)
+
+
+def difference_host(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sorted-unique int64 a \\ b; device path above HOST_CUTOVER."""
+    if min(len(a), len(b)) < HOST_CUTOVER:
+        return np.setdiff1d(a, b)
+    sa = make_set(a, capacity=_pow2_cap(len(a)))
+    sb = make_set(b, capacity=_pow2_cap(len(b)))
+    return to_numpy(difference(sa, sb)).astype(np.int64)

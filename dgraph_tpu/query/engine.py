@@ -24,6 +24,7 @@ from typing import Any
 
 import numpy as np
 
+from dgraph_tpu.ops import uidset as us
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.task import (TaskError, TaskQuery, process_task,
                                    rows_for_uids)
@@ -148,11 +149,11 @@ class Executor:
             return np.zeros(0, np.int64)
         out = uids[0]
         for u in uids[1:]:
-            out = np.union1d(out, u)
+            out = us.union_host(out, u)
         return out
 
     def _run_root_func(self, fn: dql.Function) -> np.ndarray:
-        args = self._resolve_args(fn.args)
+        args = list(fn.args)
         if fn.is_count:
             # eq(count(pred), n) — compare-scalar form
             return process_task(
@@ -170,10 +171,6 @@ class Executor:
             return np.asarray(out, dtype=np.int64)
         q = TaskQuery(fn.attr, func=(fn.name, args), lang=fn.lang)
         return process_task(self.snap, q, self.schema).dest_uids
-
-    @staticmethod
-    def _resolve_args(args: list) -> list:
-        return list(args)  # VarRefs resolve at their use sites
 
     # ---------------------------------------------------------------- levels
 
@@ -350,15 +347,15 @@ class Executor:
         if ft.op == "and":
             out = parts[0]
             for p in parts[1:]:
-                out = np.intersect1d(out, p)
+                out = us.intersect_host(out, p)
             return out
         if ft.op == "or":
             out = parts[0]
             for p in parts[1:]:
-                out = np.union1d(out, p)
+                out = us.union_host(out, p)
             return out
         if ft.op == "not":
-            return np.setdiff1d(frontier, parts[0])
+            return us.difference_host(frontier, parts[0])
         raise QueryError(f"bad filter op {ft.op}")
 
     def _eval_filter_func(self, fn: dql.Function, frontier: np.ndarray) -> np.ndarray:
@@ -369,10 +366,10 @@ class Executor:
             for r in refs:
                 vv = self.vars.get(r)
                 if vv is not None and vv.uids is not None:
-                    sel = np.union1d(sel, vv.uids)
+                    sel = us.union_host(sel, vv.uids)
                 elif vv is not None:
-                    sel = np.union1d(sel, np.asarray(sorted(vv.vals), dtype=np.int64))
-            return np.intersect1d(frontier, sel)
+                    sel = us.union_host(sel, np.asarray(sorted(vv.vals), dtype=np.int64))
+            return us.intersect_host(frontier, sel)
         if fn.is_valvar and fn.args and isinstance(fn.args[0], dql.VarRef):
             vv = self.vars.get(fn.args[0].name)
             if vv is None:
@@ -395,19 +392,26 @@ class Executor:
             if name == "has" and tid == TypeID.UID:
                 root = process_task(self.snap, TaskQuery(fn.attr, func=("has", [])),
                                     self.schema).dest_uids
-                return np.intersect1d(frontier, root)
+                return us.intersect_host(frontier, root)
+            if name == "has":
+                # value predicate: vectorized presence over the frontier
+                # (task.py's value_subjects fast path) instead of a full
+                # tablet scan + intersect
+                q = TaskQuery(fn.attr, frontier=frontier,
+                              func=("has", []), lang=fn.lang)
+                return process_task(self.snap, q, self.schema).dest_uids
             if name in ("eq", "le", "lt", "ge", "gt") and tid not in (TypeID.UID,):
                 # value compare over the frontier (device value table / host)
                 q = TaskQuery(fn.attr, frontier=frontier,
-                              func=(name, self._resolve_args(fn.args)), lang=fn.lang)
+                              func=(name, list(fn.args)), lang=fn.lang)
                 return process_task(self.snap, q, self.schema).dest_uids
             if name in ("uid_in", "checkpwd"):
                 q = TaskQuery(fn.attr, frontier=frontier,
-                              func=(name, self._resolve_args(fn.args)), lang=fn.lang)
+                              func=(name, list(fn.args)), lang=fn.lang)
                 return process_task(self.snap, q, self.schema).dest_uids
         # index-backed functions: run at root, intersect with frontier
         root = self._run_root_func(fn)
-        return np.intersect1d(frontier, root)
+        return us.intersect_host(frontier, root)
 
     def _apply_facet_filter(self, child: SubGraph) -> None:
         ft = child.gq.facets.filter

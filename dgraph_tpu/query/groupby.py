@@ -69,17 +69,109 @@ def process_groupby(ex, sg) -> None:
             key = tuple(_group_key(x) for x in combo)
             groups.setdefault(key, []).append(int(u))
 
-    # aggregates from the block's children
+    # aggregates from the block's children — numeric ops run as ONE
+    # segmented reduction across every group (ops/segments.py); count and
+    # non-numeric min/max fall back per group
     result = []
-    for key in sorted(groups.keys(), key=repr):
-        members = np.unique(np.asarray(groups[key], dtype=np.int64))
+    keys_sorted = sorted(groups.keys(), key=repr)
+    members_per = [np.unique(np.asarray(groups[k], dtype=np.int64))
+                   for k in keys_sorted]
+    batched = _batch_aggregates(ex, gq.children, members_per)
+    for gi, key in enumerate(keys_sorted):
         row: dict = {}
         for (alias, _col), kv in zip(columns, key):
             row[alias] = kv if not isinstance(kv, tuple) else kv[1]
         for cgq in gq.children:
-            row.update(_group_agg(ex, cgq, members))
+            got = batched.get(id(cgq))
+            row.update(got[gi] if got is not None
+                       else _group_agg(ex, cgq, members_per[gi]))
         result.append(row)
     sg.group_result = result
+
+
+def _host_segment_reduce(op: str, seg: np.ndarray, vals: np.ndarray,
+                         ng: int) -> np.ndarray:
+    """float64 segmented reduction via ufunc.at (inputs pre-filtered to
+    valid entries); empty groups yield NaN."""
+    cnt = np.zeros(ng, dtype=np.int64)
+    np.add.at(cnt, seg, 1)
+    if op in ("sum", "avg"):
+        out = np.zeros(ng, dtype=np.float64)
+        np.add.at(out, seg, vals)
+        if op == "avg":
+            out = out / np.maximum(cnt, 1)
+    elif op == "min":
+        out = np.full(ng, np.inf)
+        np.minimum.at(out, seg, vals)
+    else:
+        out = np.full(ng, -np.inf)
+        np.maximum.at(out, seg, vals)
+    return np.where(cnt == 0, np.nan, out)
+
+
+def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
+    """Per-child batched aggregation: {id(child): [row_dict per group]}.
+
+    Children whose op/type can't run on the float64 lattice are omitted —
+    the caller falls back to the per-group path for those."""
+    from dgraph_tpu.ops import segments as segs
+    from dgraph_tpu.query.outputnode import _val_json
+    from dgraph_tpu.utils.types import to_device_scalar
+
+    ng = len(members_per)
+    if ng == 0:
+        return {}
+    seg_ids = np.repeat(np.arange(ng, dtype=np.int32),
+                        [len(m) for m in members_per])
+    flat = np.concatenate(members_per) if ng else np.zeros(0, np.int64)
+    out: dict = {}
+    for cgq in children:
+        if not (cgq.attr.startswith("__agg_") and cgq.val_ref):
+            continue
+        op = cgq.attr[len("__agg_"):]
+        if op not in ("sum", "avg", "min", "max"):
+            continue
+        vv = ex.vars.get(cgq.val_ref)
+        if vv is None or not vv.vals:
+            continue
+        vuids = np.asarray(sorted(vv.vals), dtype=np.int64)
+        raw = [vv.vals[int(u)] for u in vuids]
+        scalars = [to_device_scalar(v) if isinstance(v, Val) else float(v)
+                   for v in raw]
+        if any(s is None for s in scalars):
+            continue   # string/geo values: host path handles them
+        tids = {v.tid for v in raw if isinstance(v, Val)}
+        if op in ("min", "max") and not tids <= {TypeID.INT, TypeID.FLOAT}:
+            continue   # min/max must return the original Val (datetime etc.)
+        vals64 = np.asarray(scalars, dtype=np.float64)
+        pos = np.searchsorted(vuids, flat)
+        posc = np.clip(pos, 0, max(len(vuids) - 1, 0))
+        hit = (len(vuids) > 0) & (vuids[posc] == flat)
+        all_int = tids <= {TypeID.INT}
+        if all_int and np.abs(vals64).sum() < 2 ** 24:
+            # exact in f32: one fused device reduction
+            x = np.where(hit, vals64[posc], np.nan).astype(np.float32)
+            res = segs.group_reduce(op, seg_ids, x, ng)
+        else:
+            # float64 exactness the device lattice can't give (x64 off):
+            # vectorized host segmented reduction, same semantics
+            res = _host_segment_reduce(op, seg_ids[hit], vals64[posc[hit]], ng)
+        name = cgq.alias or f"{op}(val({cgq.val_ref}))"
+        rows = []
+        for g in range(ng):
+            r = float(res[g])
+            if np.isnan(r):
+                rows.append({})
+                continue
+            if op == "avg":
+                v = Val(TypeID.FLOAT, r)
+            elif all_int:
+                v = Val(TypeID.INT, int(round(r)))
+            else:
+                v = Val(TypeID.FLOAT, r)
+            rows.append({name: _val_json(v)})
+        out[id(cgq)] = rows
+    return out
 
 
 def _group_key(x):

@@ -135,11 +135,13 @@ def _dijkstra(adj, src: int, dst: int):
 
 def _k_shortest(adj, src: int, dst: int, k: int):
     """Loopless k-shortest via best-first path enumeration (the reference
-    carries whole paths per heap item too, query/shortest.go:274)."""
+    carries whole paths per heap item too, query/shortest.go:274). The pop
+    budget is the query edge limit (x/init.go:53 QueryEdgeLimit) — each pop
+    relaxes at most one path-edge extension."""
     out = []
     pq = [(0.0, [src], [])]
     pops = 0
-    while pq and len(out) < k and pops < 200_000:
+    while pq and len(out) < k and pops < MAX_QUERY_EDGES:
         d, path, attrs = heapq.heappop(pq)
         pops += 1
         u = path[-1]

@@ -36,7 +36,7 @@ from dgraph_tpu.utils import geo as geomod
 from dgraph_tpu.utils import tok as tokmod
 from dgraph_tpu.utils.schema import SchemaState
 from dgraph_tpu.utils.types import (TypeID, Val, compare_vals, convert,
-                                    verify_password)
+                                    to_device_scalar, verify_password)
 
 
 class TaskError(ValueError):
@@ -136,7 +136,7 @@ def _index_uids_intersect_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
     out = None
     for r in rows:
         u = np.asarray(ti.uids)[indptr[r] : indptr[r + 1]].astype(np.int64)
-        out = u if out is None else np.intersect1d(out, u)
+        out = u if out is None else us.intersect_host(out, u)
         if len(out) == 0:
             break
     return out
@@ -243,18 +243,77 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
             res.facet_matrix = [
                 [pd.facets.get((int(s), int(o)), ()) for o in m]
                 for s, m in zip(frontier, matrix)]
-        # filter-function applied over the frontier itself (uid_in)
+        # filter-function applied over the frontier itself (uid_in / has)
         if fname == "uid_in":
             want = int(str(args[0]), 0)  # accepts decimal and 0x-hex uid forms
             keep = np.asarray([want in m for m in matrix], dtype=bool)
+            res.dest_uids = frontier[keep]
+        elif fname == "has":
+            # has(attr) over a frontier: subjects with >= 1 edge (or a value,
+            # for mixed untyped predicates)
+            keep = np.asarray([len(m) > 0 for m in matrix], dtype=bool)
+            if pd.value_subjects_host is not None:
+                vsub = pd.value_subjects_host
+                posv = np.clip(np.searchsorted(vsub, frontier), 0,
+                               max(len(vsub) - 1, 0))
+                keep |= (len(vsub) > 0) & (vsub[posv] == frontier)
             res.dest_uids = frontier[keep]
         else:
             res.dest_uids = _merge_matrix(matrix)
         return res
 
     # ---- frontier + value predicate: fetch values / compare filter --------
+    # vectorized presence over the device-aligned value table: one
+    # searchsorted instead of a dict probe per frontier uid
+    # (handleValuePostings' per-uid posting fetch, worker/task.go:319)
+    if pd.value_subjects_host is not None:
+        vsub = pd.value_subjects_host
+        pos = np.searchsorted(vsub, frontier)
+        posc = np.clip(pos, 0, max(len(vsub) - 1, 0))
+        present = (len(vsub) > 0) & (vsub[posc] == frontier)
+    else:
+        present = np.zeros(len(frontier), dtype=bool)
+
+    if fname == "has" and not q.lang:
+        # value_subjects includes lang-only nodes (csr_build appends them),
+        # so presence alone decides has() — no per-uid Python loop
+        res.dest_uids = frontier[present]
+        res.value_matrix = [[] for _ in frontier]
+        return res
+
+    if (fname in ("eq", "le", "lt", "ge", "gt") and not q.lang
+            and pd.num_values_host is not None
+            and pd.type_id in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
+                               TypeID.DATETIME)):
+        # numeric compare on the exact float64 mirror: gather + compare per
+        # frontier slot (the indexed-ineq fast path of tokens.go, but as one
+        # vector op over the frontier). Exact for INT < 2^53, DATETIME
+        # (epoch seconds), FLOAT, BOOL — the same lattice the host compares.
+        vs = [_parse_arg_val(pd, schema, a)
+              for a in (args if fname == "eq" else args[:1])]
+        rhs = [to_device_scalar(v) for v in vs]
+        nv = pd.num_values_host
+        x = np.where(present, nv[posc], np.nan)
+        keep = np.zeros(len(frontier), dtype=bool)
+        for r in (r for r in rhs if r is not None):
+            if fname == "eq":
+                keep |= x == r
+            elif fname == "le":
+                keep |= x <= r
+            elif fname == "lt":
+                keep |= x < r
+            elif fname == "ge":
+                keep |= x >= r
+            elif fname == "gt":
+                keep |= x > r
+        res.dest_uids = frontier[keep]
+        res.value_matrix = [
+            [pd.host_values[int(u)]] if k and int(u) in pd.host_values else []
+            for u, k in zip(frontier, keep)]
+        return res
+
     res.value_matrix = []
-    for u in frontier.tolist():
+    for u, pres in zip(frontier.tolist(), present):
         vals: list[Val] = []
         if q.lang == ".":
             # any-language read: untagged first, else any tagged value
@@ -269,7 +328,7 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
             lv = pd.lang_values.get(int(u), {})
             if q.lang in lv:
                 vals = [lv[q.lang]]
-        else:
+        elif pres:
             sv = pd.host_values.get(int(u))
             if sv is not None:
                 vals = [sv]

@@ -81,7 +81,9 @@ class PredData:
     csr: PredCSR | None = None
     rev_csr: PredCSR | None = None
     value_subjects: jnp.ndarray | None = None    # int32[N] sorted uids with a value
+    value_subjects_host: np.ndarray | None = None  # int64[N] host mirror (searches)
     num_values: jnp.ndarray | None = None        # float32[N] numeric mirror (NaN=non-numeric)
+    num_values_host: np.ndarray | None = None    # float64[N] exact mirror (compares)
     host_values: dict[int, Val] = field(default_factory=dict)
     lang_values: dict[int, dict[str, Val]] = field(default_factory=dict)
     facets: dict[tuple[int, int], tuple] = field(default_factory=dict)  # (subj,obj/slot)->facets
@@ -244,9 +246,10 @@ def build_pred(store: Store, attr: str, read_ts: int,
         vs = np.asarray(val_subjects, dtype=np.int64)[order]
         if vs[-1] > MAX_DEVICE_UID:
             raise ValueError("value subject uid exceeds device uid space")
+        pd.value_subjects_host = vs
         pd.value_subjects = jnp.asarray(vs.astype(np.int32))
-        pd.num_values = jnp.asarray(
-            np.asarray(num_vals, dtype=np.float32)[order])
+        pd.num_values_host = np.asarray(num_vals, dtype=np.float64)[order]
+        pd.num_values = jnp.asarray(pd.num_values_host.astype(np.float32))
 
     # reverse CSR
     if entry is not None and entry.reverse:

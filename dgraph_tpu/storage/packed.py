@@ -21,9 +21,9 @@ TPU redesign — NOT a translation:
 - Blocks whose deltas need >32 bits use a word-aligned raw64 escape
   (width=64, two words per value).
 
-The host codec here is vectorized numpy; `native/` provides the same format in
-C++ for ingest (see storage/native.py); `ops/packed_decode.py` decodes on
-device so packed lists can live in HBM.
+The host codec here is vectorized numpy (pack/unpack plus pack_many/
+unpack_many batched forms for whole-tablet work); `ops/packed_decode.py`
+decodes the same format on device so packed lists can live in HBM.
 """
 
 from __future__ import annotations

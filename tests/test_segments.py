@@ -1,0 +1,102 @@
+"""ops/segments + uidset host dispatchers + vectorized value-compare path.
+
+Round-2 verdict item 6: no O(frontier) Python loop in the hot query path;
+groupby aggregation as real segment reductions.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.ops import segments as segs
+from dgraph_tpu.ops import uidset as us
+
+
+def test_segment_reduce_ops():
+    vals = np.array([1, 2, 3, 10, np.nan, 5], dtype=np.float32)
+    seg = np.array([0, 0, 1, 1, 2, 2], dtype=np.int32)
+    assert segs.group_reduce("sum", seg, vals, 4).tolist()[:3] == [3, 13, 5]
+    assert segs.group_reduce("min", seg, vals, 4).tolist()[:3] == [1, 3, 5]
+    assert segs.group_reduce("max", seg, vals, 4).tolist()[:3] == [2, 10, 5]
+    assert segs.group_reduce("avg", seg, vals, 4).tolist()[:3] == [1.5, 6.5, 5]
+    assert segs.group_reduce("count", seg, vals, 4).tolist() == [2, 2, 1, 0]
+    # group 3 has no members: NaN for value ops
+    assert np.isnan(segs.group_reduce("sum", seg, vals, 4)[3])
+
+
+def test_segment_reduce_empty():
+    assert len(segs.group_reduce("sum", np.zeros(0, np.int32),
+                                 np.zeros(0, np.float32), 0)) == 0
+    out = segs.group_reduce("count", np.zeros(0, np.int32),
+                            np.zeros(0, np.float32), 3)
+    assert out.tolist() == [0, 0, 0]
+
+
+def test_segment_reduce_rejects_bad_op():
+    with pytest.raises(ValueError):
+        segs.group_reduce("median", np.zeros(1, np.int32),
+                          np.zeros(1, np.float32), 1)
+
+
+@pytest.mark.parametrize("n", [10, 9000])
+def test_host_dispatchers_match_numpy(rng, n):
+    """Both the numpy and device branches agree with numpy set semantics
+    (n=9000 crosses HOST_CUTOVER into the device path)."""
+    a = np.unique(rng.integers(0, n * 4, size=n).astype(np.int64))
+    b = np.unique(rng.integers(0, n * 4, size=n).astype(np.int64))
+    np.testing.assert_array_equal(us.intersect_host(a, b), np.intersect1d(a, b))
+    np.testing.assert_array_equal(us.union_host(a, b), np.union1d(a, b))
+    np.testing.assert_array_equal(us.difference_host(a, b), np.setdiff1d(a, b))
+
+
+def _value_node():
+    node = Node()
+    node.alter(schema_text="age: int @index(int) .\n"
+               "score: float .\nborn: dateTime .\nname: string .")
+    quads = []
+    for i in range(1, 41):
+        quads.append(f'<0x{i:x}> <name> "n{i}" .')
+        if i % 3:
+            quads.append(f'<0x{i:x}> <age> "{i}"^^<xs:int> .')
+        if i % 2:
+            quads.append(f'<0x{i:x}> <score> "{i}.5"^^<xs:float> .')
+        quads.append(f'<0x{i:x}> <born> "20{i % 30 + 10}-01-02T03:04:05"^^<xs:dateTime> .')
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+    return node
+
+
+def test_vectorized_value_filters_match_semantics():
+    node = _value_node()
+    # numeric ineq filter over a frontier (vectorized num_values_host path)
+    out, _ = node.query('{ q(func: has(name)) @filter(ge(age, 30)) { age } }')
+    ages = sorted(r["age"] for r in out["q"])
+    assert ages == [i for i in range(30, 41) if i % 3]
+    # float compare
+    out, _ = node.query('{ q(func: has(name)) @filter(eq(score, 7.5)) { score } }')
+    assert [r["score"] for r in out["q"]] == [7.5]
+    # datetime compare must be exact (f32 would round to ~128s)
+    out, _ = node.query(
+        '{ q(func: has(name)) @filter(eq(born, "2015-01-02T03:04:05")) { uid } }')
+    assert len(out["q"]) == 2  # i=5 and i=35 -> i%30+10 == 15
+    # has() via vectorized presence
+    out, _ = node.query('{ q(func: has(name)) @filter(has(age)) { uid } }')
+    assert len(out["q"]) == len([i for i in range(1, 41) if i % 3])
+
+
+def test_groupby_segment_aggregation():
+    node = _value_node()
+    out, _ = node.query('''
+    { var(func: has(name)) { a as age }
+      q(func: has(age)) @groupby(g: born) {
+        count(uid)
+        s: sum(val(a))
+        m: max(val(a))
+        v: avg(val(a))
+      } }''')
+    rows = out["q"][0]["@groupby"]
+    # every group's sum/max/avg must be consistent with its count
+    total = sum(r["count"] for r in rows)
+    assert total == len([i for i in range(1, 41) if i % 3])
+    for r in rows:
+        assert r["m"] <= 40 and r["s"] >= r["m"]
+        assert abs(r["v"] - r["s"] / r["count"]) < 1e-4
