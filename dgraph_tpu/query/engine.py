@@ -81,6 +81,7 @@ class Executor:
         self.schema = schema
         self.vars: dict[str, VarValue] = {}
         self.traversed_edges = 0
+        self.sort_index_buckets = -1  # sortWithIndex instrumentation
 
     # ------------------------------------------------------------------ API
 
@@ -459,12 +460,28 @@ class Executor:
     # ---------------------------------------------------------------- order
 
     def _apply_order(self, gq: dql.GraphQuery, uids: np.ndarray) -> np.ndarray:
-        """Multi-key order (reference worker/sort.go; host-side over snapshot
-        values — index-bucket walk is an optimization applied when sortable).
+        """Multi-key order (reference worker/sort.go).
 
-        Stable sorts applied from the last key to the first give multi-key
-        semantics; uids with a missing sort value always sink to the end,
-        regardless of direction (the reference's sort treats them the same)."""
+        Single-key sorts over an indexed sortable predicate walk the token
+        buckets in key order (sortWithIndex, worker/sort.go:144-259),
+        intersecting each bucket with the candidate set and stopping once
+        offset+first is satisfied; everything else falls back to the value
+        sort. Stable sorts applied from the last key to the first give
+        multi-key semantics; uids with a missing sort value always sink to
+        the end, regardless of direction (the reference's sort treats them
+        the same)."""
+        self.sort_index_buckets = -1   # -1 = value sort; else buckets touched
+        if (len(gq.order) == 1 and not gq.order[0].is_val
+                and not gq.order[0].lang
+                and int(gq.args.get("after", 0)) == 0
+                and int(gq.args.get("first", 0)) > 0):
+            # bounded sorts only: an unbounded walk of every bucket loses to
+            # the single value-sort pass (the reference races the two paths,
+            # worker/sort.go:379; early-stop is where the index wins)
+            need = int(gq.args.get("offset", 0)) + int(gq.args["first"])
+            got = self._sort_with_index(gq.order[0], uids, need)
+            if got is not None:
+                return got
         ordered = [int(u) for u in uids]
         for o in reversed(gq.order):
             present = [(self._order_key(o, u), u) for u in ordered]
@@ -472,6 +489,66 @@ class Executor:
             missing = [u for k, u in present if k is None]
             have.sort(key=lambda t: t[0], reverse=o.desc)
             ordered = [u for _, u in have] + missing
+        return np.asarray(ordered, dtype=np.int64)
+
+    def _sort_with_index(self, o: dql.Order, uids: np.ndarray,
+                         need: int) -> np.ndarray | None:
+        """Index-ordered sort: walk sortable token buckets in term order
+        (reversed for desc), intersect each with the candidate set
+        (intersectBucket, worker/sort.go:480), sort lossy buckets by value,
+        stop at `need` results (0 = unbounded). Returns None when no
+        sortable non-list index is available (value-sort fallback).
+
+        Token encodings are order-preserving (utils/tok.py), so bucket term
+        order == value order — the contract sortWithIndex relies on."""
+        from dgraph_tpu.utils import tok as tokmod
+
+        pd = self.snap.pred(o.attr)
+        entry = self.schema.get(o.attr)
+        if pd is None or entry is None or entry.is_list or \
+                getattr(entry, "lang", False):
+            # @lang predicates: tagged-only values are indexed but invisible
+            # to the untagged value sort — keep one code path (value sort)
+            return None
+        ti = tz = None
+        for name in self.schema.tokenizer_names(o.attr):
+            t = tokmod.get(name)
+            if t.sortable and name in pd.indexes:
+                ti, tz = pd.indexes[name], t
+                break
+        if ti is None or not ti.terms:
+            return None
+        cand = np.asarray(uids, dtype=np.int64)
+        indptr, tuids = ti.host_arrays()
+        ordered: list[int] = []
+        touched = 0
+        rows = range(len(ti.terms) - 1, -1, -1) if o.desc \
+            else range(len(ti.terms))
+        satisfied = False
+        for r in rows:
+            touched += 1
+            bucket = tuids[indptr[r]:indptr[r + 1]]
+            inb = us.intersect_host(bucket, cand)
+            if len(inb) == 0:
+                continue
+            if tz.lossy and len(inb) > 1:
+                # lossy tokenizer: one bucket spans many values — order
+                # within the bucket by actual value (sort.go intersectBucket
+                # sorts each bucket's result by value)
+                keyed = sorted(
+                    ((self._order_key(o, int(u)), int(u)) for u in inb),
+                    key=lambda t: (t[0] is None, t[0]), reverse=o.desc)
+                inb = [u for _, u in keyed]
+            ordered.extend(int(u) for u in inb)
+            if need and len(ordered) >= need:
+                satisfied = True
+                break
+        self.sort_index_buckets = touched
+        if not satisfied:
+            # uids with no index entry (no value) sink to the end, ascending
+            # — identical to the value-sort fallback's missing tail
+            missing = np.setdiff1d(cand, np.asarray(ordered, dtype=np.int64))
+            ordered.extend(int(u) for u in missing)
         return np.asarray(ordered, dtype=np.int64)
 
     def _order_key(self, o: dql.Order, uid: int):

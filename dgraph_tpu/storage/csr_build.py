@@ -66,12 +66,21 @@ class TokenIndex:
     terms: list[bytes]      # sorted; host-side (binary-searched for ranges)
     indptr: jnp.ndarray     # int32[T+1]
     uids: jnp.ndarray       # int32[sum row lens], sorted per row
+    _host: tuple | None = None   # lazy (indptr, uids) int64 host mirrors
 
     def term_row(self, term: bytes) -> int:
         import bisect
 
         i = bisect.bisect_left(self.terms, term)
         return i if i < len(self.terms) and self.terms[i] == term else -1
+
+    def host_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(indptr, uids) host mirrors, fetched from device once per
+        snapshot (index sorts / bucket walks are host-orchestrated)."""
+        if self._host is None:
+            self._host = (np.asarray(self.indptr),
+                          np.asarray(self.uids).astype(np.int64))
+        return self._host
 
 
 @dataclass
