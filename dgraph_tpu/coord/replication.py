@@ -1,0 +1,178 @@
+"""Replication: WAL shipping to a replica quorum with leader failover.
+
+Reference semantics (the contract, not the transport):
+  - worker/draft.go:190 proposeAndWait — a mutation is acked only after the
+    Raft quorum has the entry; :485-624 Run loop stores entries before
+    applying them.
+  - conn/node.go:47-105 — replica membership and health; CheckQuorum.
+  - raftwal/wal.go:31 — the per-replica durable log replayed on restart.
+  - worker/draft.go:452 retrieveSnapshot — a lagging follower catches up by
+    full snapshot + log tail from the leader.
+
+TPU-era redesign: replicas are posting-store directories; the data plane
+needing consensus is ONLY the WAL byte stream (device snapshots rebuild from
+it deterministically), so replication is synchronous record shipping — every
+WAL record fsyncs on a majority of live replicas before the leader's own
+append proceeds. Failover promotes the live replica with the longest log
+(Raft's up-to-date rule) by opening a Node on its directory — the normal
+crash-recovery path — and fences the old term via a per-replica term file.
+
+In-process today (one ReplicaGroup object owns the member dirs — the
+embedded single-process cluster mode of SURVEY.md §4); the record stream is
+already the wire format a gRPC/DCN transport would carry.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from dgraph_tpu.api.server import Node
+
+_U32 = struct.Struct("<I")
+
+
+class NoQuorum(Exception):
+    """Fewer than a majority of replicas are alive and acking."""
+
+
+class StaleLeader(Exception):
+    """A deposed leader tried to ship records (term fencing)."""
+
+
+class _Member:
+    """One replica: a directory with wal.log (+ snapshot) and a term file."""
+
+    def __init__(self, member_id: int, dirpath: str) -> None:
+        self.id = member_id
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self.alive = True
+        self._wal = None
+
+    # -- term fencing --------------------------------------------------------
+
+    @property
+    def term(self) -> int:
+        try:
+            with open(os.path.join(self.dir, "TERM")) as f:
+                return int(f.read().strip() or 0)
+        except FileNotFoundError:
+            return 0
+
+    def set_term(self, term: int) -> None:
+        with open(os.path.join(self.dir, "TERM"), "w") as f:
+            f.write(str(term))
+
+    # -- log append (the follower side of the ship) --------------------------
+
+    def append(self, data: bytes, sync: bool) -> None:
+        if self._wal is None:
+            self._wal = open(os.path.join(self.dir, "wal.log"), "ab")
+        self._wal.write(_U32.pack(len(data)) + data)
+        if sync:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.flush()
+            self._wal.close()
+            self._wal = None
+
+    def wal_len(self) -> int:
+        try:
+            return os.path.getsize(os.path.join(self.dir, "wal.log"))
+        except FileNotFoundError:
+            return 0
+
+
+class ReplicaGroup:
+    """A leader Node plus follower replicas with synchronous quorum shipping."""
+
+    def __init__(self, base_dir: str, n: int = 3) -> None:
+        if n < 1:
+            raise ValueError("need n >= 1 replicas")
+        self.n = n
+        self.term = 1
+        self.members = [_Member(i, os.path.join(base_dir, f"replica{i}"))
+                        for i in range(n)]
+        for m in self.members:
+            m.set_term(self.term)
+        self.leader_id = 0
+        self.node: Node = self._open_leader()
+
+    # -- leadership ----------------------------------------------------------
+
+    @property
+    def quorum(self) -> int:
+        return self.n // 2 + 1
+
+    def _followers(self) -> list[_Member]:
+        return [m for m in self.members if m.id != self.leader_id]
+
+    def _open_leader(self) -> Node:
+        node = Node(self.members[self.leader_id].dir)
+        node.store.wal_sink = self._ship
+        return node
+
+    def _ship(self, data: bytes, sync: bool) -> None:
+        """Deliver one WAL record to followers; ack needs a quorum counting
+        the leader itself (proposeAndWait's commit wait).
+
+        Quorum feasibility and term fencing are checked for EVERY live
+        follower before any append, so a rejected ship leaves no follower
+        holding a record the leader never wrote."""
+        live = [m for m in self._followers() if m.alive]
+        for m in live:
+            if m.term > self.term:
+                raise StaleLeader(
+                    f"member {m.id} is at term {m.term} > {self.term}")
+        if len(live) + 1 < self.quorum:
+            raise NoQuorum(
+                f"{len(live) + 1}/{self.n} acks < quorum {self.quorum}")
+        for m in live:
+            m.append(data, sync)
+
+    # -- failures ------------------------------------------------------------
+
+    def kill(self, member_id: int) -> None:
+        """Crash a member. Killing the leader triggers failover to the live
+        member with the longest log (Raft's up-to-date election rule)."""
+        m = self.members[member_id]
+        m.alive = False
+        m.close()
+        if member_id != self.leader_id:
+            return
+        self.node.close()
+        live = [x for x in self.members if x.alive]
+        if len(live) < self.quorum:
+            raise NoQuorum(
+                f"{len(live)} live members cannot form quorum {self.quorum}")
+        new_leader = max(live, key=lambda x: (x.wal_len(), -x.id))
+        self.term += 1
+        for x in live:
+            x.set_term(self.term)
+        self.leader_id = new_leader.id
+        new_leader.close()
+        self.node = self._open_leader()
+
+    def rejoin(self, member_id: int) -> None:
+        """Bring a dead member back via snapshot + WAL tail from the leader
+        (retrieveSnapshot / populateShard analog)."""
+        m = self.members[member_id]
+        if member_id == self.leader_id:
+            raise ValueError("leader cannot rejoin itself")
+        # fold the leader's log so the copy is compact, then clone state
+        # (clone_to flushes + copies under the store lock, so no concurrent
+        # commit can land half-shipped in the copy window)
+        self.node.store.checkpoint(self.node.store.max_seen_commit_ts)
+        m.close()
+        self.node.store.clone_to(m.dir)
+        m.set_term(self.term)
+        m.alive = True
+
+    def close(self) -> None:
+        self.node.close()
+        for m in self.members:
+            m.close()

@@ -191,6 +191,35 @@ class Store:
     # -- bulk ingest ---------------------------------------------------------
 
     @contextlib.contextmanager
+    def _sink_suspended(self):
+        """Checkpoint's WAL-reset rewrites are LOCAL compaction — shipping
+        them would append duplicates to follower logs while the leader
+        truncates its own (followers keep full history instead)."""
+        sink, self.wal_sink = self.wal_sink, None
+        try:
+            yield
+        finally:
+            self.wal_sink = sink
+
+    def clone_to(self, dst_dir: str) -> None:
+        """Copy this store's durable state (snapshot + WAL) to another dir,
+        atomically vs concurrent writers (follower catch-up,
+        worker/predicate_move.go populateShard / retrieveSnapshot)."""
+        import shutil
+
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+            for name in ("snapshot.bin", "wal.log"):
+                src = os.path.join(self.dir, name)
+                dst = os.path.join(dst_dir, name)
+                if os.path.exists(src):
+                    shutil.copyfile(src, dst)
+                elif os.path.exists(dst):
+                    os.remove(dst)
+
+    @contextlib.contextmanager
     def suspend_wal(self):
         """Run with the WAL off (bulk loads write packed bases directly and
         then checkpoint — reference bulk loader writes SSTs, not the Raft
@@ -219,11 +248,21 @@ class Store:
 
     # -- WAL ----------------------------------------------------------------
 
+    # Replication hook: when set, every WAL record is offered to the sink
+    # BEFORE the local append (a record must reach the quorum before the
+    # leader treats it as durable — worker/draft.go proposeAndWait waits for
+    # the Raft commit the same way). The sink raising aborts the local write.
+    wal_sink = None
+
     def _wal_write(self, rec: dict, sync: bool = False) -> None:
         if self._wal is None:
             return
         data = json.dumps(rec, separators=(",", ":")).encode("utf-8")
         with self._lock:
+            # ship under the same lock as the local append so followers see
+            # records in exactly the leader's log order
+            if self.wal_sink is not None:
+                self.wal_sink(data, sync)
             self._wal.write(_U32.pack(len(data)) + data)
             if sync:
                 self._wal.flush()
@@ -289,7 +328,7 @@ class Store:
                 pl.rollup(upto_ts)
             self.snapshot_ts = max(self.snapshot_ts, upto_ts)
             return
-        with self._lock:
+        with self._lock, self._sink_suspended():
             self.snapshot_ts = max(self.snapshot_ts, upto_ts)
             snap_path = os.path.join(self.dir, "snapshot.bin.tmp")
             with open(snap_path, "wb") as f:

@@ -1,0 +1,127 @@
+"""Replication contract: quorum WAL shipping, leader failover, catch-up.
+
+Round-2 verdict item 8: 3-node in-process cluster — write, kill leader,
+fail over, read: the bank invariant holds and nothing committed is lost.
+Reference: worker/draft.go:190/:485-624, conn/node.go:47-105,
+raftwal/wal.go:31, retrieveSnapshot :452; the bank hammer mirrors
+contrib/integration/bank.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.coord.replication import NoQuorum, ReplicaGroup, StaleLeader
+from dgraph_tpu.coord.zero import TxnConflict
+
+N_ACCOUNTS = 8
+START = 100
+
+
+def _seed_bank(node):
+    node.alter(schema_text="bal: int .\nacct: string @index(exact) .")
+    quads = [f'<0x{i:x}> <acct> "a{i}" .\n<0x{i:x}> <bal> "{START}"^^<xs:int> .'
+             for i in range(1, N_ACCOUNTS + 1)]
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+
+
+def _balances(node) -> dict[int, int]:
+    out, _ = node.query('{ q(func: has(acct)) { uid bal } }')
+    return {int(r["uid"], 16): r["bal"] for r in out.get("q", [])}
+
+
+def _transfer(node, rng) -> bool:
+    a, b = rng.choice(np.arange(1, N_ACCOUNTS + 1), size=2, replace=False)
+    ctx = node.new_txn()
+    try:
+        bals = _balances(node)
+        amt = int(rng.integers(1, 20))
+        node.mutate(
+            set_nquads=f'<0x{a:x}> <bal> "{bals[int(a)] - amt}"^^<xs:int> .\n'
+                       f'<0x{b:x}> <bal> "{bals[int(b)] + amt}"^^<xs:int> .',
+            start_ts=ctx.start_ts)
+        node.commit(ctx.start_ts)
+        return True
+    except TxnConflict:
+        return False
+
+
+def test_kill_leader_loses_nothing(tmp_path):
+    g = ReplicaGroup(str(tmp_path), n=3)
+    _seed_bank(g.node)
+    rng = np.random.default_rng(3)
+    for _ in range(25):
+        _transfer(g.node, rng)
+    before = _balances(g.node)
+    assert sum(before.values()) == N_ACCOUNTS * START
+
+    old_leader = g.leader_id
+    g.kill(old_leader)                       # crash the primary
+    assert g.leader_id != old_leader
+    after = _balances(g.node)
+    assert after == before, "committed state lost in failover"
+
+    # the promoted leader keeps serving writes (quorum 2/3 still alive)
+    for _ in range(10):
+        _transfer(g.node, rng)
+    assert sum(_balances(g.node).values()) == N_ACCOUNTS * START
+    g.close()
+
+
+def test_second_leader_loss_breaks_quorum(tmp_path):
+    g = ReplicaGroup(str(tmp_path), n=3)
+    _seed_bank(g.node)
+    g.kill(g.leader_id)
+    with pytest.raises(NoQuorum):
+        g.kill(g.leader_id)                  # 1 live member < quorum 2
+
+
+def test_follower_loss_then_writes_then_rejoin(tmp_path):
+    g = ReplicaGroup(str(tmp_path), n=3)
+    _seed_bank(g.node)
+    rng = np.random.default_rng(9)
+    dead = next(m.id for m in g.members if m.id != g.leader_id)
+    g.kill(dead)                             # follower down: 2/3 still quorum
+    for _ in range(10):
+        _transfer(g.node, rng)
+    snapshot_bals = _balances(g.node)
+
+    g.rejoin(dead)                           # snapshot + tail catch-up
+    # fail over onto the rejoined member's cohort: kill the leader, the
+    # promoted member must carry everything incl. post-outage commits
+    g.kill(g.leader_id)
+    assert _balances(g.node) == snapshot_bals
+    assert sum(_balances(g.node).values()) == N_ACCOUNTS * START
+    g.close()
+
+
+def test_no_quorum_blocks_commits(tmp_path):
+    g = ReplicaGroup(str(tmp_path), n=3)
+    _seed_bank(g.node)
+    for m in g.members:
+        if m.id != g.leader_id:
+            g.kill(m.id)                     # both followers down
+    with pytest.raises(NoQuorum):
+        g.node.mutate(set_nquads='<0x1> <bal> "1"^^<xs:int> .',
+                      commit_now=True)
+    g.close()
+
+
+def test_stale_leader_fenced(tmp_path):
+    g = ReplicaGroup(str(tmp_path), n=3)
+    _seed_bank(g.node)
+    # a member observes a newer term (as if another leader was elected)
+    for m in g.members:
+        if m.id != g.leader_id:
+            m.set_term(g.term + 1)
+            break
+    with pytest.raises(StaleLeader):
+        g.node.mutate(set_nquads='<0x1> <bal> "0"^^<xs:int> .',
+                      commit_now=True)
+    g.close()
+
+
+def test_single_replica_degenerate(tmp_path):
+    g = ReplicaGroup(str(tmp_path), n=1)
+    _seed_bank(g.node)
+    assert sum(_balances(g.node).values()) == N_ACCOUNTS * START
+    g.close()

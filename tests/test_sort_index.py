@@ -41,15 +41,6 @@ def _run(node, q):
     return out, ex
 
 
-def _value_sort_reference(node, attr, desc=False):
-    """Ground truth via the engine's own value-sort fallback on an
-    unindexed ordering (order by val() forces the fallback)."""
-    pd = node.snapshot().preds[attr]
-    pairs = sorted(pd.host_values.items(),
-                   key=lambda t: t[1].value, reverse=desc)
-    return [u for u, _ in pairs]
-
-
 @pytest.mark.parametrize("desc", [False, True])
 def test_index_sort_matches_value_sort(node, desc):
     d = "orderdesc" if desc else "orderasc"
