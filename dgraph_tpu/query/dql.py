@@ -160,6 +160,9 @@ class GraphQuery:
     # vars
     var_name: str = ""           # `x as ...`
     needs_vars: list[str] = field(default_factory=list)
+    # vars that SOURCE the root uid set (func: uid(v)) — a strict subset of
+    # needs_vars; filter/order vars schedule the block but don't widen the root
+    root_uid_vars: list[str] = field(default_factory=list)
     # directives
     cascade: bool = False
     normalize: bool = False
@@ -395,6 +398,7 @@ class _Parser:
             if gq.func.name == "uid":
                 gq.uids, refs = _split_uid_args(gq.func.args)
                 gq.needs_vars += refs
+                gq.root_uid_vars += refs
                 gq.func = None
         elif key in ("first", "offset", "after"):
             v = self.literal()
@@ -507,6 +511,12 @@ class _Parser:
             else:
                 fn.args.append(self.literal())
             first = False
+        if fname == "eq":
+            # eq(pred, [v1, v2]) list form == eq(pred, v1, v2) variadic form:
+            # flatten here so every consumer (root func, filters, val-var
+            # compares) sees one value list (gql parses both the same way).
+            fn.args = [x for a in fn.args
+                       for x in (a if isinstance(a, list) else (a,))]
         return fn
 
     # -- directives ---------------------------------------------------------
@@ -641,6 +651,17 @@ class _Parser:
             self.next()
             gq.var_name = nm
             nm = self.name()
+            # `x as math(expr)` value-var definition (gql parser_v2: vars can
+            # bind computed nodes, not just preds). Alias by var name so two
+            # math definitions in one block don't collide on the "math" key.
+            if nm == "math" and self.peek().text == "(":
+                self.expect("(")
+                gq.math = self._parse_math()
+                self.expect(")")
+                gq.attr = "math"
+                gq.alias = gq.var_name
+                _collect_math_vars(gq.math, gq.needs_vars)
+                return gq
         # alias : pred
         if self.accept(":"):
             gq.alias = nm

@@ -138,7 +138,7 @@ class Executor:
             present = _known_uids(self.snap)
             want = np.unique(np.asarray(gq.uids, dtype=np.int64))
             uids.append(want[np.isin(want, present)] if len(present) else want)
-        for v in gq.needs_vars:
+        for v in gq.root_uid_vars:
             vv = self.vars.get(v)
             if vv is not None and vv.uids is not None:
                 uids.append(vv.uids)
@@ -156,19 +156,21 @@ class Executor:
     def _run_root_func(self, fn: dql.Function) -> np.ndarray:
         args = list(fn.args)
         if fn.is_count:
-            # eq(count(pred), n) — compare-scalar form
-            return process_task(
+            # eq(count(pred), n) — compare-scalar form; eq matches ANY listed n
+            outs = [process_task(
                 self.snap,
-                TaskQuery(fn.attr, func=(fn.name, ["__count__", int(args[0])])),
+                TaskQuery(fn.attr, func=(fn.name, ["__count__", int(n)])),
                 self.schema).dest_uids
+                for n in (args if fn.name == "eq" else args[:1])]
+            return (np.unique(np.concatenate(outs)) if outs
+                    else np.zeros(0, np.int64))
         if fn.is_valvar and args and isinstance(fn.args[0], dql.VarRef):
             # eq(val(x), v): select uids whose var value compares true
             vv = self.vars.get(fn.args[0].name)
             if vv is None:
                 return np.zeros(0, np.int64)
-            rhs = args[1]
             out = [u for u, val in sorted(vv.vals.items())
-                   if _compare_any(fn.name, val, rhs)]
+                   if _match_any_rhs(fn.name, val, args)]
             return np.asarray(out, dtype=np.int64)
         q = TaskQuery(fn.attr, func=(fn.name, args), lang=fn.lang)
         return process_task(self.snap, q, self.schema).dest_uids
@@ -375,17 +377,17 @@ class Executor:
             vv = self.vars.get(fn.args[0].name)
             if vv is None:
                 return np.zeros(0, np.int64)
-            rhs = fn.args[1]
-            keep = [int(u) for u in frontier
-                    if int(u) in vv.vals and _compare_any(name, vv.vals[int(u)], rhs)]
+            keep = [int(u) for u in frontier if int(u) in vv.vals
+                    and _match_any_rhs(name, vv.vals[int(u)], fn.args)]
             return np.asarray(keep, dtype=np.int64)
         if fn.is_count:
-            # filter-level eq(count(pred), n): degree check over frontier
+            # filter-level eq(count(pred), n): degree check over frontier;
+            # eq matches ANY listed n
             res = process_task(
                 self.snap, TaskQuery(fn.attr, frontier=frontier), self.schema)
-            n = int(fn.args[0])
+            ns = [int(a) for a in (fn.args if name == "eq" else fn.args[:1])]
             keep = [u for u, c in zip(frontier, res.counts)
-                    if _int_cmp(name, c, n)]
+                    if any(_int_cmp(name, c, n) for n in ns)]
             return np.asarray(keep, dtype=np.int64)
         if name in ("has", "uid_in", "checkpwd") or \
            self.schema.type_of(fn.attr) not in (TypeID.UID,):
@@ -638,6 +640,13 @@ def _known_uids(snap: GraphSnapshot) -> np.ndarray:
     return out
 
 
+def _match_any_rhs(op: str, val: Val, args: list) -> bool:
+    """val-var compare: args[0] is the VarRef; eq matches ANY of args[1:],
+    other ops take exactly one rhs."""
+    rhss = args[1:] if op == "eq" else args[1:2]
+    return any(_compare_any(op, val, r) for r in rhss)
+
+
 def _compare_any(op: str, a: Val, b) -> bool:
     rhs = b if isinstance(b, Val) else _val_from_literal(b, a.tid)
     try:
@@ -671,7 +680,9 @@ def _facet_filter_match(ft: dql.FilterTree, facets: dict) -> bool:
             return False
         if fn.name.lower() == "has":
             return True
-        return _compare_any(fn.name.lower(), fv, fn.args[0] if fn.args else None)
+        op = fn.name.lower()
+        rhss = fn.args if op == "eq" else fn.args[:1]
+        return any(_compare_any(op, fv, r) for r in rhss)
     parts = (_facet_filter_match(c, facets) for c in ft.children)
     if ft.op == "and":
         return all(parts)

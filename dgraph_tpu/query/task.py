@@ -383,6 +383,11 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
         # compare-scalar over count index: eq(count(pred), N)
         if args and isinstance(args[0], str) and args[0] == "__count__":
             return _count_func(pd, fname, int(args[1]))
+        if not args:
+            if fname == "eq":
+                # eq(pred, []) — degenerate but parseable; matches nothing
+                return np.zeros(0, np.int64)
+            raise TaskError(f"{fname}({pd.attr}) needs a value to compare")
         v = _parse_arg_val(pd, schema, args[0])
         if fname == "eq":
             out = [_eq_candidates(pd, schema, vv) for vv in
@@ -517,7 +522,12 @@ def _geo_func(pd: PredData, schema, fname: str, args: list) -> np.ndarray:
         if empty is not None:
             return empty
         raise TaskError(f"predicate {pd.attr} needs @index(geo)")
-    g = args[0] if isinstance(args[0], geomod.Geom) else geomod.parse_geojson(args[0])
+    a0 = args[0]
+    if isinstance(a0, (list, tuple)) and len(a0) == 2 and \
+            all(isinstance(x, (int, float)) for x in a0):
+        # DQL coordinate form: near(loc, [lon, lat], dist)
+        a0 = {"type": "Point", "coordinates": [float(a0[0]), float(a0[1])]}
+    g = a0 if isinstance(a0, geomod.Geom) else geomod.parse_geojson(a0)
     radius = float(args[1]) if fname == "near" and len(args) > 1 else None
     qtoks = geomod.query_tokens(g, radius)
     # probe covers and all their indexed ancestors/descendants

@@ -305,3 +305,66 @@ def test_orderdesc_string_prefix(env):
     s, snap = env
     out = run(env, '{ q(func: eq(age, 15), orderdesc: name) { name } }')
     assert [x["name"] for x in out["q"]] == ["Rick Grimes", "Glenn Rhee"]
+
+
+def test_eq_list_form_valvar(env):
+    # regression: eq(val(x), [v1, v2]) must flatten at parse time so the
+    # value-var compare path matches ANY listed value
+    out = run(env, '''{
+      v as var(func: has(name)) { a as age }
+      q(func: eq(val(a), [15, 17]), orderasc: val(a)) @filter(uid(v)) { name }
+    }''')
+    assert [x["name"] for x in out["q"]] == [
+        "Rick Grimes", "Glenn Rhee", "Daryl Dixon"]
+
+
+def test_eq_list_form_root(env):
+    out = run(env, '{ q(func: eq(name, ["Andrea", "Carl"]), orderasc: name) { name } }')
+    assert [x["name"] for x in out["q"]] == ["Andrea", "Carl"]
+
+
+def test_eq_empty_list(env):
+    # degenerate eq(pred, []) matches nothing instead of crashing
+    out = run(env, '{ q(func: eq(name, [])) { name } }')
+    assert out == {}
+
+
+def test_two_math_var_defs_one_block(env):
+    # regression: two `x as math(...)` defs in one block must not collide on
+    # the "math" output key
+    out = run(env, '''{
+      q(func: uid(1)) { a as math(1 + 1) b as math(2 + 2) name }
+    }''')
+    row = out["q"][0]
+    assert row["a"] == 2 and row["b"] == 4 and row["name"] == "Michonne"
+
+
+def test_eq_count_list_form(env):
+    # eq(count(pred), [n1, n2]) matches ANY listed degree — root and filter
+    out = run(env, '{ q(func: eq(count(friend), [1, 4]), orderasc: name) { name } }')
+    assert [x["name"] for x in out["q"]] == [
+        "Andrea", "Daryl Dixon", "Glenn Rhee", "Michonne", "Rick Grimes"]
+    out = run(env, '''{
+      q(func: has(name), orderasc: name) @filter(eq(count(friend), [1, 4])) { name }
+    }''')
+    assert [x["name"] for x in out["q"]] == [
+        "Andrea", "Daryl Dixon", "Glenn Rhee", "Michonne", "Rick Grimes"]
+
+
+def test_facet_eq_list_form(env):
+    # @facets(eq(key, [v1, v2])) matches ANY listed facet value
+    out = run(env, '''{
+      q(func: uid(1)) { friend @facets(eq(close, [true, false])) { name } }
+    }''')
+    names = {x["name"] for x in out["q"][0]["friend"]}
+    assert names == {"Andrea", "Daryl Dixon", "Glenn Rhee", "Rick Grimes"}
+    out = run(env, '''{
+      q(func: uid(1)) { friend @facets(eq(close, [false])) { name } }
+    }''')
+    names = {x["name"] for x in out["q"][0]["friend"]}
+    assert names == {"Andrea", "Daryl Dixon"}
+
+
+def test_ineq_missing_rhs_errors(env):
+    with pytest.raises(Exception):
+        run(env, '{ q(func: lt(age)) { name } }')
