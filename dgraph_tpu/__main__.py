@@ -22,6 +22,11 @@ def cmd_serve(args) -> int:
     if args.schema:
         with open(args.schema) as f:
             node.alter(schema_text=f.read())
+    grpc_srv = None
+    if args.grpc_port:
+        from dgraph_tpu.api.grpc_server import serve_grpc
+        grpc_srv, gport = serve_grpc(node, f"{args.host}:{args.grpc_port}")
+        print(f"serving gRPC on {args.host}:{gport}", flush=True)
     srv = make_server(node, args.host, args.port)
     print(f"serving HTTP on {args.host}:{args.port} "
           f"(postings={args.postings or '<memory>'})", flush=True)
@@ -30,6 +35,8 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if grpc_srv is not None:
+            grpc_srv.stop(0)
         node.close()
     return 0
 
@@ -93,6 +100,8 @@ def main(argv=None) -> int:
     sp = sub.add_parser("serve", help="run the embedded server (HTTP API)")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8080)
+    sp.add_argument("--grpc_port", type=int, default=9080,
+                    help="gRPC api.Dgraph port (0 disables)")
     sp.add_argument("-p", "--postings", default=None,
                     help="durable posting dir (default: in-memory)")
     sp.add_argument("--schema", default=None, help="schema file to apply")

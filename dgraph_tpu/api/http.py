@@ -107,9 +107,11 @@ class _Handler(BaseHTTPRequestHandler):
             j = json.loads(body)
             q = j.get("query", "")
             variables = j.get("variables")
-        start_ts = self._qs().get("startTs")
+        qs = self._qs()
+        start_ts = qs.get("startTs")
+        ro = qs.get("ro", qs.get("readOnly", "")).lower() == "true"
         out, ctx = self.node.query(
-            q, variables, int(start_ts) if start_ts else None)
+            q, variables, int(start_ts) if start_ts else None, read_only=ro)
         self._send(200, _envelope_ok(
             out, {"txn": {"start_ts": ctx.start_ts}}))
 
@@ -125,14 +127,24 @@ class _Handler(BaseHTTPRequestHandler):
             res = self.node.mutate(
                 set_json=j.get("set"), delete_json=j.get("delete"),
                 commit_now=commit_now, start_ts=start_ts)
+            uids, ctx = res.uids, res.context
+        elif body.lstrip().startswith("upsert"):
+            # DQL upsert block through /mutate (dgraph/cmd/server/http.go
+            # mutationHandler's upsert path)
+            from dgraph_tpu.query import dql
+            req = dql.parse(body)
+            _out, uids, ctx = self.node.upsert(
+                req.upsert["query"], req.upsert["mutations"],
+                start_ts=start_ts, commit_now=commit_now)
         else:
             sets, dels = _split_mutation_blocks(body)
             res = self.node.mutate(set_nquads=sets, del_nquads=dels,
                                    commit_now=commit_now, start_ts=start_ts)
-        ctx = res.context
+            uids, ctx = res.uids, res.context
         self._send(200, _envelope_ok(
             {"code": "Success", "message": "Done",
-             "uids": {k[2:]: hex(v) for k, v in res.uids.items()}},
+             "uids": {k[2:]: hex(v) for k, v in uids.items()
+                      if str(k).startswith("_:")}},
             {"txn": {"start_ts": ctx.start_ts,
                      "commit_ts": ctx.commit_ts,
                      "aborted": ctx.aborted}}))

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from dgraph_tpu.coord.zero import TxnConflict, Zero
 from dgraph_tpu.query import dql, rdf
 from dgraph_tpu.query import mutation as mut
+from dgraph_tpu.query import upsert as ups
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage import index as idx
 from dgraph_tpu.storage import keys as K
@@ -106,11 +107,25 @@ class Node:
 
     # -- transactions --------------------------------------------------------
 
+    # abandoned query-only txns (opened lazily by the gRPC surface, never
+    # committed/discarded) are reaped once this many accumulate, else they
+    # pin the oracle's conflict-GC watermark forever
+    MAX_IDLE_TXNS = 1024
+
     def new_txn(self) -> TxnContext:
         st = self.zero.oracle.new_txn()
         ctx = TxnContext(start_ts=st.start_ts)
         with self._lock:
             self._txns[st.start_ts] = ctx
+            if len(self._txns) > self.MAX_IDLE_TXNS:
+                # oldest pristine txns (no buffered writes) abort harmlessly:
+                # a later commit on one returns "unknown txn", same as the
+                # reference's expired-txn behavior
+                idle = sorted(ts for ts, c in self._txns.items()
+                              if not c.keys and ts != st.start_ts)
+                for ts in idle[: len(idle) // 2]:
+                    del self._txns[ts]
+                    self.zero.oracle.abort(ts)
         return ctx
 
     def commit(self, start_ts: int) -> int:
@@ -179,13 +194,10 @@ class Node:
 
     # -- Query ---------------------------------------------------------------
 
-    def query(self, q: str, variables: dict | None = None,
-              start_ts: int | None = None) -> tuple[dict, TxnContext]:
-        """Parse + execute a DQL request (edgraph/server.go:373)."""
-        req = dql.parse(q, variables)
-        if req.schema_request is not None:
-            return {"schema": self._schema_json(req.schema_request)}, \
-                TxnContext(start_ts=0)
+    def _read_view(self, start_ts: int | None) -> tuple[int, GraphSnapshot]:
+        """Snapshot for a read: committed state at read_ts, with an open
+        txn's own uncommitted layers overlaid when start_ts names one
+        (posting/list.go:528 — StartTs == readTs visibility)."""
         read_ts = start_ts if start_ts is not None else self.zero.oracle.read_ts()
         with self._lock:
             # only an EXPLICIT startTs continues an open txn: a fresh read's
@@ -193,10 +205,6 @@ class Node:
             # see its uncommitted writes
             ctx = self._txns.get(start_ts) if start_ts is not None else None
             if ctx is not None and ctx.preds:
-                # open txn reading at its own start_ts: overlay its
-                # uncommitted layers on the committed base so upsert-style
-                # query-then-mutate flows see their own writes
-                # (posting/list.go:528 — StartTs == readTs visibility)
                 base = self.snapshot(read_ts)
                 snap = GraphSnapshot(read_ts)
                 snap.preds = dict(base.preds)
@@ -210,8 +218,84 @@ class Node:
                     snap.preds.update(built)
             else:
                 snap = self.snapshot(read_ts)
+        return read_ts, snap
+
+    def query(self, q: str, variables: dict | None = None,
+              start_ts: int | None = None,
+              read_only: bool = False) -> tuple[dict, TxnContext]:
+        """Parse + execute a DQL request (edgraph/server.go:373).
+
+        read_only treats start_ts purely as a snapshot timestamp: it never
+        joins an open txn's uncommitted overlay even if some pending txn
+        happens to carry the same start_ts (read ts values come from the same
+        oracle counter, so numeric collision is possible)."""
+        req = dql.parse(q, variables)
+        if req.upsert is not None:
+            # implicit txn commits; an explicit one stays open for the
+            # client's own commit/abort
+            out, _uids, ctx = self.upsert(
+                req.upsert["query"], req.upsert["mutations"],
+                start_ts=start_ts, commit_now=start_ts is None)
+            return out, ctx
+        if req.schema_request is not None:
+            return {"schema": self._schema_json(req.schema_request)}, \
+                TxnContext(start_ts=0)
+        if read_only and start_ts is not None:
+            read_ts, snap = start_ts, self.snapshot(start_ts)
+        else:
+            read_ts, snap = self._read_view(start_ts)
         out = Executor(snap, self.store.schema).execute(req)
         return out, TxnContext(start_ts=read_ts)
+
+    def upsert(self, q: str, mutations: list[dict],
+               variables: dict | None = None, start_ts: int | None = None,
+               commit_now: bool = False) -> tuple[dict, dict, TxnContext]:
+        """Query-then-conditionally-mutate in one txn (edgraph/server.go
+        doQueryInUpsert + gql/upsert.go). `mutations` entries carry any of
+        cond / set / delete / set_json / delete_json (text cond is the inside
+        of @if(...)). Returns (query json, assigned uids, ctx)."""
+        own_txn = start_ts is None
+        with self._lock:
+            if own_txn:
+                ctx = self.new_txn()
+            else:
+                ctx = self._txns.get(start_ts)
+                if ctx is None:
+                    raise mut.MutationError(f"unknown txn {start_ts}")
+        try:
+            out: dict = {}
+            vars_map: dict = {}
+            if q.strip():
+                _, snap = self._read_view(ctx.start_ts)
+                ex = Executor(snap, self.store.schema)
+                out = ex.execute(dql.parse(q, variables))
+                vars_map = ex.vars
+            uid_map: dict = {}
+            for m in mutations:
+                cond = m.get("cond", "")
+                if cond and not ups.eval_cond(cond, vars_map):
+                    continue
+                nq_set = ups.expand(rdf.parse(m.get("set", "")), vars_map)
+                nq_del = ups.expand(rdf.parse(m.get("delete", "")), vars_map)
+                if m.get("set_json") is not None:
+                    nq_set += mut.nquads_from_json(m["set_json"], Op.SET)
+                if m.get("delete_json") is not None:
+                    nq_del += mut.nquads_from_json(m["delete_json"], Op.DEL)
+                if not nq_set and not nq_del:
+                    continue   # cond met but every quad's var was empty
+                res = self.mutate_quads(nq_set, nq_del, commit_now=False,
+                                        start_ts=ctx.start_ts)
+                uid_map.update(res.uids)
+        except BaseException:
+            if own_txn:
+                # don't leak the implicit txn (it would pin the oracle's
+                # conflict-GC watermark); an explicit txn stays open for the
+                # client to retry or abort
+                self.abort(ctx.start_ts)
+            raise
+        if commit_now:
+            self.commit(ctx.start_ts)
+        return out, uid_map, ctx
 
     def _schema_json(self, preds: list[str]) -> list[dict]:
         out = []

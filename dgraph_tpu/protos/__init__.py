@@ -1,0 +1,4 @@
+"""Wire contracts (protobuf). api_pb2 is generated from api.proto via
+`protoc --python_out=. dgraph_tpu/protos/api.proto` and committed, since the
+image has protoc but no grpc codegen plugin (stubs are hand-written in
+api/grpc_server.py and api/grpc_client.py)."""

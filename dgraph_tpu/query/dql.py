@@ -196,6 +196,9 @@ class ParsedRequest:
     mutations: list[dict] | None = None   # {"set": [nquads], "delete": [...]}
     schema_request: list[str] | None = None
     fragments: dict[str, list[GraphQuery]] = field(default_factory=dict)
+    # upsert block (gql/upsert.go ParseMutation):
+    # {"query": dql text, "mutations": [{"cond", "set", "delete"}]}
+    upsert: dict | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -300,6 +303,9 @@ class _Parser:
             req.fragments[fname] = self._parse_children(req)
         if self.peek().kind == "eof":
             return req
+        if self.peek().text == "upsert":
+            req.upsert = self._parse_upsert_block()
+            return req
         self.expect("{")
         while not self.accept("}"):
             t = self.peek()
@@ -368,6 +374,74 @@ class _Parser:
                 src_end = t.pos
         return {"op": kind, "rdf_span": (start, src_end)}
 
+    def _raw_brace_span(self) -> tuple[int, int]:
+        """Consume `{ ... }` (already at `{`), returning the raw source span
+        of the inside (same scan as _parse_mutation_block's tail)."""
+        self.expect("{")
+        start = self.peek().pos
+        depth, src_end = 1, start
+        while depth > 0:
+            t = self.next()
+            if t.kind == "eof":
+                raise ParseError("unterminated block")
+            if t.text == "{":
+                depth += 1
+            elif t.text == "}":
+                depth -= 1
+                src_end = t.pos
+        return start, src_end
+
+    def _parse_upsert_block(self) -> dict:
+        """upsert { query {...} mutation [@if(...)] { set/delete {...} } }
+        (gql/upsert.go ParseMutation). Query text and RDF bodies are captured
+        as raw spans; @if conditions as the text inside the parens."""
+        self.expect("upsert")
+        self.expect("{")
+        q_text = ""
+        muts: list[dict] = []
+        while not self.accept("}"):
+            t = self.peek()
+            if t.text == "query":
+                self.next()
+                s, e = self._raw_brace_span()
+                q_text = "{" + self.src[s:e] + "}"
+            elif t.text == "mutation":
+                self.next()
+                cond = ""
+                if self.accept("@"):
+                    if self.name() != "if":
+                        raise ParseError("expected @if on mutation")
+                    self.expect("(")
+                    cs = self.peek().pos
+                    depth, ce = 1, cs
+                    while depth > 0:
+                        tk = self.next()
+                        if tk.kind == "eof":
+                            raise ParseError("unterminated @if")
+                        if tk.text == "(":
+                            depth += 1
+                        elif tk.text == ")":
+                            depth -= 1
+                            ce = tk.pos
+                    cond = self.src[cs:ce]
+                m = {"cond": cond, "set": "", "delete": ""}
+                self.expect("{")
+                while not self.accept("}"):
+                    kind = self.peek().text
+                    if kind not in ("set", "delete"):
+                        raise ParseError(
+                            f"expected set/delete in mutation, got {kind!r}")
+                    self.next()
+                    s, e = self._raw_brace_span()
+                    m[kind] = self.src[s:e]
+                muts.append(m)
+            else:
+                raise ParseError(
+                    f"expected query/mutation in upsert, got {t.text!r}")
+        if not muts:
+            raise ParseError("upsert block needs at least one mutation")
+        return {"query": q_text, "mutations": muts}
+
     # -- query blocks -------------------------------------------------------
 
     def _parse_query_block(self, req: ParsedRequest) -> GraphQuery:
@@ -388,6 +462,12 @@ class _Parser:
             self.expect(":")
             self._parse_block_arg(gq, key)
         self._parse_directives(gq)
+        if self.peek().text != "{" and first == "var":
+            # body-less VAR block: `v as var(func: ...)` — standard in upsert
+            # queries where only the uid var matters (gql accepts it); named
+            # output blocks still require a selection set
+            gq.children = []
+            return gq
         self.expect("{")
         gq.children = self._parse_children(req)
         return gq

@@ -26,7 +26,7 @@ from dgraph_tpu.storage import index as idx
 from dgraph_tpu.storage import keys as K
 from dgraph_tpu.storage.postings import DirectedEdge, Op
 from dgraph_tpu.storage.store import Store
-from dgraph_tpu.utils.types import TypeID, Val, parse_datetime
+from dgraph_tpu.utils.types import TypeID, Val, convert, parse_datetime
 
 
 class MutationError(ValueError):
@@ -79,6 +79,9 @@ def to_edges(nquads: Iterable[rdf.NQuad], uid_map: dict[str, int],
     """
     edges: list[DirectedEdge] = []
     for nq in nquads:
+        if nq.subject_var or nq.object_var or nq.val_var:
+            raise MutationError(
+                "uid(v)/val(v) terms are only valid inside an upsert block")
         subject = uid_map[nq.subject] if nq.subject.startswith("_:") \
             else parse_uid(nq.subject)
         eop = op
@@ -115,6 +118,27 @@ def expand_edges(store: Store, edges: list[DirectedEdge]) -> list[DirectedEdge]:
     return out
 
 
+def _validate_and_convert(store: Store, e: DirectedEdge) -> DirectedEdge:
+    """Coerce the edge's value to the schema's scalar type (reference
+    ValidateAndConvert, worker/mutation.go:243): `_:a <age> "30" .` under
+    `age: int` stores an INT, so index tokens, sort keys, and output all see
+    the declared type. Unconvertible values reject the mutation."""
+    entry = store.schema.get(e.attr)
+    if entry is None or e.value is None or e.op == Op.DEL_ALL:
+        return e
+    want = entry.type_id
+    if want in (TypeID.DEFAULT, TypeID.UID) or e.value.tid == want:
+        return e
+    try:
+        v = convert(e.value, want)
+    except ValueError as ex:
+        raise MutationError(
+            f"cannot convert value {e.value.value!r} for predicate "
+            f"{e.attr!r} to schema type {want.name.lower()}: {ex}") from None
+    return DirectedEdge(e.subject, e.attr, value=v, op=e.op, lang=e.lang,
+                        facets=e.facets)
+
+
 def apply_mutations(store: Store, edges: list[DirectedEdge],
                     start_ts: int) -> tuple[list[bytes], list[bytes], set[str]]:
     """Buffer edges under start_ts with index/reverse/count maintenance.
@@ -130,7 +154,12 @@ def apply_mutations(store: Store, edges: list[DirectedEdge],
     touched_all: list[bytes] = []
     conflict: list[bytes] = []
     preds: set[str] = set()
-    for e in expand_edges(store, edges):
+    # validate as a pre-pass so a bad value rejects the WHOLE mutation before
+    # any edge is buffered (reference ValidateAndConvert runs over all edges
+    # first) — no orphaned uncommitted layers on error
+    expanded = [_validate_and_convert(store, e)
+                for e in expand_edges(store, edges)]
+    for e in expanded:
         touched = idx.add_mutation_with_index(store, e, start_ts)
         preds.add(e.attr)
         entry = store.schema.get(e.attr)

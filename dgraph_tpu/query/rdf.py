@@ -29,6 +29,12 @@ class NQuad:
     lang: str = ""
     facets: list[tuple[str, Val]] = field(default_factory=list)
     star: bool = False           # object is *
+    # upsert-block var references (reference: gql upsert uid(v)/val(v) in
+    # mutation quads) — resolved by query/upsert.py expand(); rejected by the
+    # plain mutation path
+    subject_var: str = ""        # subject was uid(v)
+    object_var: str = ""         # object was uid(v)
+    val_var: str = ""            # object was val(v)
 
 
 _XSD_TYPES = {
@@ -49,14 +55,17 @@ for _k, _v in list(_XSD_TYPES.items()):
 
 _LINE_RE = re.compile(
     r"""^\s*
-    (?P<subj><[^>]+>|_:[A-Za-z0-9_.\-]+)\s+
+    (?P<subj><[^>]+>|_:[A-Za-z0-9_.\-]+|uid\([A-Za-z0-9_]+\))\s+
     (?P<pred><[^>]+>|\*|[^\s<>]+)\s+
-    (?P<obj><[^>]+>|_:[A-Za-z0-9_.\-]+|\*|"(?:\\.|[^"\\])*"(?:@[A-Za-z\-:]+|\^\^<[^>]+>)?)
+    (?P<obj><[^>]+>|_:[A-Za-z0-9_.\-]+|\*|(?:uid|val)\([A-Za-z0-9_]+\)
+        |"(?:\\.|[^"\\])*"(?:@[A-Za-z\-:]+|\^\^<[^>]+>)?)
     \s*(?P<facets>\((?:"(?:\\.|[^"\\])*"|[^)"])*\))?\s*
     (?:<[^>]*>\s*)?      # optional label/graph — ignored
     \.\s*(?:\#.*)?$""",
     re.VERBOSE,
 )
+
+_VAR_TERM = re.compile(r"^(uid|val)\(([A-Za-z0-9_]+)\)$")
 
 
 def _strip_angle(s: str) -> str:
@@ -95,10 +104,19 @@ def parse_line(line: str) -> NQuad | None:
     pred = _strip_angle(m.group("pred"))
     obj = m.group("obj")
     nq = NQuad(subject=subj, predicate=pred)
+    vm = _VAR_TERM.match(subj)
+    if vm:
+        nq.subject, nq.subject_var = "", vm.group(2)
     if pred == "*" and obj != "*":
         raise RDFError("predicate * requires object *")
+    ovm = _VAR_TERM.match(obj)
     if obj == "*":
         nq.star = True
+    elif ovm:
+        if ovm.group(1) == "uid":
+            nq.object_var = ovm.group(2)
+        else:
+            nq.val_var = ovm.group(2)
     elif obj.startswith("<") or obj.startswith("_:"):
         nq.object_id = _strip_angle(obj)
     else:

@@ -1,0 +1,214 @@
+"""Upsert blocks: query + @if conds + uid(v)/val(v) mutation quads in one
+txn (reference: gql/upsert.go ParseMutation, edgraph doQueryInUpsert)."""
+
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import dql
+from dgraph_tpu.query.mutation import MutationError
+from dgraph_tpu.query.upsert import UpsertError, eval_cond
+
+
+@pytest.fixture
+def node():
+    n = Node()
+    n.alter(schema_text="""
+        email: string @index(exact) @upsert .
+        name: string @index(exact) .
+        score: int @index(int) .
+        total: int .
+        follows: uid @reverse .
+    """)
+    return n
+
+
+UPSERT_INSERT = '''upsert {
+  query { v as var(func: eq(email, "a@x.io")) }
+  mutation @if(eq(len(v), 0)) {
+    set {
+      _:u <email> "a@x.io" .
+      _:u <name> "alice" .
+    }
+  }
+}'''
+
+
+def test_insert_if_absent_idempotent(node):
+    out, uids, ctx = node.upsert(
+        dql.parse(UPSERT_INSERT).upsert["query"],
+        dql.parse(UPSERT_INSERT).upsert["mutations"], commit_now=True)
+    assert uids  # created
+    # second run: v is non-empty now, cond fails, nothing inserted
+    _, uids2, _ = node.upsert(
+        dql.parse(UPSERT_INSERT).upsert["query"],
+        dql.parse(UPSERT_INSERT).upsert["mutations"], commit_now=True)
+    assert uids2 == {}
+    res, _ = node.query('{ q(func: eq(email, "a@x.io")) { name } }')
+    assert res == {"q": [{"name": "alice"}]}
+
+
+def test_uid_var_subject_update(node):
+    node.mutate(set_nquads='_:a <email> "b@x.io" .\n_:a <name> "old" .',
+                commit_now=True)
+    q = '{ v as var(func: eq(email, "b@x.io")) }'
+    node.upsert(q, [{"cond": "gt(len(v), 0)",
+                     "set": 'uid(v) <name> "new" .', "delete": ""}],
+                commit_now=True)
+    res, _ = node.query('{ q(func: eq(email, "b@x.io")) { name } }')
+    assert res == {"q": [{"name": "new"}]}
+
+
+def test_val_var_copies_per_subject(node):
+    node.mutate(set_nquads='''
+        _:a <name> "a" .
+        _:a <score> "10" .
+        _:b <name> "b" .
+        _:b <score> "20" .
+    ''', commit_now=True)
+    q = '{ v as var(func: has(score)) { s as score } }'
+    node.upsert(q, [{"cond": "", "set": 'uid(v) <total> val(s) .',
+                     "delete": ""}], commit_now=True)
+    res, _ = node.query('{ q(func: has(total), orderasc: total) { name total } }')
+    assert res == {"q": [{"name": "a", "total": 10},
+                         {"name": "b", "total": 20}]}
+
+
+def test_delete_via_uid_var(node):
+    node.mutate(set_nquads='_:a <email> "gone@x.io" .\n_:a <name> "g" .',
+                commit_now=True)
+    q = '{ v as var(func: eq(email, "gone@x.io")) }'
+    node.upsert(q, [{"cond": "", "set": "",
+                     "delete": "uid(v) <email> * .\nuid(v) <name> * ."}],
+                commit_now=True)
+    res, _ = node.query('{ q(func: has(email)) { email } }')
+    assert res == {}
+
+
+def test_empty_var_drops_quads(node):
+    q = '{ v as var(func: eq(email, "nobody@x.io")) }'
+    # no cond: quads referencing the empty var just vanish; txn still commits
+    _, uids, _ = node.upsert(q, [{"cond": "", "set": 'uid(v) <name> "x" .',
+                                  "delete": ""}], commit_now=True)
+    assert uids == {}
+
+
+def test_uid_object_var_cross_product(node):
+    node.mutate(set_nquads='''
+        _:a <name> "fan" .
+        _:x <email> "s1@x.io" .
+        _:y <email> "s2@x.io" .
+    ''', commit_now=True)
+    q = '''{
+      f as var(func: eq(name, "fan"))
+      s as var(func: has(email))
+    }'''
+    node.upsert(q, [{"cond": "", "set": "uid(f) <follows> uid(s) .",
+                     "delete": ""}], commit_now=True)
+    res, _ = node.query('{ q(func: eq(name, "fan")) { follows { email } } }')
+    emails = {x["email"] for x in res["q"][0]["follows"]}
+    assert emails == {"s1@x.io", "s2@x.io"}
+
+
+def test_upsert_through_query_surface(node):
+    # the full text form through Node.query (HTTP /mutate parses the same way)
+    out, ctx = node.query(UPSERT_INSERT)
+    res, _ = node.query('{ q(func: eq(email, "a@x.io")) { name } }')
+    assert res == {"q": [{"name": "alice"}]}
+
+
+def test_multiple_conditional_mutations(node):
+    node.mutate(set_nquads='_:a <email> "c@x.io" .', commit_now=True)
+    q = '{ v as var(func: eq(email, "c@x.io")) }'
+    node.upsert(q, [
+        {"cond": "eq(len(v), 0)", "set": '_:n <name> "created" .', "delete": ""},
+        {"cond": "gt(len(v), 0)", "set": 'uid(v) <name> "updated" .', "delete": ""},
+    ], commit_now=True)
+    res, _ = node.query('{ q(func: eq(email, "c@x.io")) { name } }')
+    assert res == {"q": [{"name": "updated"}]}
+    res, _ = node.query('{ q(func: eq(name, "created")) { name } }')
+    assert res == {}
+
+
+def test_vars_not_valid_outside_upsert(node):
+    with pytest.raises(MutationError):
+        node.mutate(set_nquads='uid(v) <name> "x" .', commit_now=True)
+
+
+def test_cond_grammar():
+    class VV:
+        def __init__(self, uids):
+            self.uids = uids
+            self.vals = {}
+    vm = {"v": VV([1, 2]), "w": VV([])}
+    assert eval_cond("eq(len(v), 2)", vm)
+    assert eval_cond("gt(len(v), 1) and eq(len(w), 0)", vm)
+    assert eval_cond("eq(len(v), 9) or le(len(w), 0)", vm)
+    assert eval_cond("not eq(len(v), 0)", vm)
+    # AND binds tighter than OR
+    assert eval_cond("eq(len(v), 9) or eq(len(v), 2) and eq(len(w), 0)", vm)
+    assert not eval_cond("(eq(len(v), 9) or eq(len(v), 2)) and gt(len(w), 0)", vm)
+    assert eval_cond("eq(len(missing), 0)", vm)   # unknown var == empty
+    with pytest.raises(UpsertError):
+        eval_cond("bogus(len(v), 1)", vm)
+    with pytest.raises(UpsertError):
+        eval_cond("eq(len(v), 1) eq(len(v), 2)", vm)
+
+
+def test_parse_upsert_block_shape():
+    req = dql.parse(UPSERT_INSERT)
+    assert req.upsert is not None
+    assert 'var(func: eq(email, "a@x.io"))' in req.upsert["query"]
+    m = req.upsert["mutations"][0]
+    assert m["cond"].strip() == "eq(len(v), 0)"
+    assert '<email> "a@x.io"' in m["set"]
+
+
+def test_upsert_unknown_start_ts_rejected(node):
+    with pytest.raises(MutationError):
+        node.upsert('{ v as var(func: has(name)) }',
+                    [{"cond": "", "set": '_:x <name> "y" .', "delete": ""}],
+                    start_ts=999999)
+
+
+def test_upsert_error_aborts_implicit_txn(node):
+    before = len(node._txns)
+    with pytest.raises(MutationError):
+        node.upsert("", [
+            {"cond": "", "set": '_:ok <name> "fine" .', "delete": ""},
+            {"cond": "", "set": '_:bad <score> "not-an-int" .', "delete": ""},
+        ], commit_now=True)
+    # implicit txn cleaned up, nothing committed, no leak
+    assert len(node._txns) == before
+    res, _ = node.query('{ q(func: eq(name, "fine")) { name } }')
+    assert res == {}
+
+
+def test_upsert_explicit_txn_not_autocommitted(node):
+    node.mutate(set_nquads='_:a <email> "open@x.io" .', commit_now=True)
+    ctx = node.new_txn()
+    node.upsert('{ v as var(func: eq(email, "open@x.io")) }',
+                [{"cond": "", "set": 'uid(v) <name> "buffered" .',
+                  "delete": ""}], start_ts=ctx.start_ts)
+    # not yet visible: the explicit txn is still open
+    res, _ = node.query('{ q(func: eq(name, "buffered")) { name } }')
+    assert res == {}
+    node.commit(ctx.start_ts)
+    res, _ = node.query('{ q(func: eq(name, "buffered")) { name } }')
+    assert res == {"q": [{"name": "buffered"}]}
+
+
+def test_idle_txn_reaping(node):
+    node.MAX_IDLE_TXNS = 8
+    first = node.new_txn()
+    for _ in range(16):
+        node.new_txn()
+    # the earliest pristine txn was reaped; later commits on it fail cleanly
+    with pytest.raises(MutationError):
+        node.commit(first.start_ts)
+    assert len(node._txns) <= 16
+
+
+def test_bodyless_named_block_still_errors(node):
+    from dgraph_tpu.query.dql import ParseError
+    with pytest.raises(ParseError):
+        dql.parse('{ q(func: has(name)) }')
