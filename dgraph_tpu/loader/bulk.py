@@ -29,7 +29,7 @@ import numpy as np
 from dgraph_tpu.coord.zero import UidLease
 from dgraph_tpu.loader.xidmap import XidMap
 from dgraph_tpu.storage import keys as K
-from dgraph_tpu.storage import packed
+from dgraph_tpu.storage import native, packed
 from dgraph_tpu.storage.index import index_tokens
 from dgraph_tpu.storage.postings import (Op, Posting, PostingList, lang_uid,
                                          value_fingerprint)
@@ -290,7 +290,7 @@ def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
 
         # one vectorized pack across every list (reduce.go's per-key pack,
         # batched for numpy)
-        for kb, pu in zip(batch_keys, packed.pack_many(batch_rows)):
+        for kb, pu in zip(batch_keys, native.pack_many(batch_rows)):
             pl = PostingList()
             pl.base_ts = commit_ts
             pl.base_packed = pu

@@ -188,9 +188,9 @@ def _tablet_uids(store: Store, kbs: list[bytes], read_ts: int,
             out[i] = pl.uids(read_ts, own_start_ts=own)
     for lo in range(0, len(batch_idx), _UNPACK_CHUNK):
         part = batch_idx[lo : lo + _UNPACK_CHUNK]
-        from dgraph_tpu.storage import packed
+        from dgraph_tpu.storage import native
 
-        for i, u in zip(part, packed.unpack_many(
+        for i, u in zip(part, native.unpack_many(
                 [pls[i].base_packed for i in part])):
             out[i] = u.astype(np.int64)
     return out

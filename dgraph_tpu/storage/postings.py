@@ -26,7 +26,7 @@ from enum import IntEnum
 
 import numpy as np
 
-from dgraph_tpu.storage import packed
+from dgraph_tpu.storage import native, packed
 from dgraph_tpu.utils.types import Val
 
 # uid slot used by non-lang value postings (reference uses math.MaxUint64 for
@@ -158,7 +158,7 @@ class PostingList:
             # silently return future state (reference gates with a min-readTs
             # watermark before snapshotting, posting/mvcc.go:105).
             raise ValueError(f"read at ts {read_ts} below rollup watermark {self.base_ts}")
-        uids = packed.unpack(self.base_packed).astype(np.int64)
+        uids = native.unpack(self.base_packed).astype(np.int64)
         live: dict[int, Posting] = dict(self.base_postings)
         present = dict.fromkeys(uids.tolist(), True)
 
@@ -201,7 +201,7 @@ class PostingList:
 
     def uids(self, read_ts: int, after_uid: int = 0, own_start_ts: int | None = None) -> np.ndarray:
         if self._base_only(read_ts, own_start_ts):
-            u = packed.unpack(self.base_packed).astype(np.int64)
+            u = native.unpack(self.base_packed).astype(np.int64)
         else:
             u, _ = self._fold(read_ts, own_start_ts)
         if after_uid:
@@ -210,7 +210,7 @@ class PostingList:
 
     def postings(self, read_ts: int, own_start_ts: int | None = None) -> list[Posting]:
         if self._base_only(read_ts, own_start_ts):
-            u = packed.unpack(self.base_packed).astype(np.int64)
+            u = native.unpack(self.base_packed).astype(np.int64)
             live = self.base_postings
         else:
             u, live = self._fold(read_ts, own_start_ts)
@@ -284,7 +284,7 @@ class PostingList:
                 return
             u, live = self._fold(upto_ts)
             keep = [l for l in self.layers if l.commit_ts > upto_ts]
-            self.base_packed = packed.pack(u.astype(np.uint64))
+            self.base_packed = native.pack(u.astype(np.uint64))
             self.base_postings = live
             self.layers = keep
             self.base_ts = upto_ts

@@ -1,0 +1,87 @@
+"""Native C++ codec (native/codec.cc via storage/native.py) must be
+bit-identical to the numpy codec — same wire format, every width class."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.storage import native, packed
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+def _eq(a: packed.PackedUidList, b: packed.PackedUidList):
+    assert a.count == b.count
+    np.testing.assert_array_equal(a.block_first, b.block_first)
+    np.testing.assert_array_equal(a.block_last, b.block_last)
+    np.testing.assert_array_equal(a.block_count, b.block_count)
+    np.testing.assert_array_equal(a.block_width, b.block_width)
+    np.testing.assert_array_equal(a.block_off, b.block_off)
+    np.testing.assert_array_equal(a.words, b.words)
+
+
+CASES = [
+    np.zeros(0, np.uint64),
+    np.array([7], np.uint64),
+    np.arange(1, 129, dtype=np.uint64),                    # exactly one block
+    np.arange(1, 130, dtype=np.uint64),                    # block boundary +1
+    np.cumsum(np.ones(1000, np.uint64)),                   # width 1
+    np.cumsum(np.full(5000, 1 << 20, np.uint64)),          # width 21
+    np.array([1, 2, 3, 1 << 40, (1 << 40) + 5], np.uint64),  # raw64 escape
+]
+
+
+@pytest.mark.parametrize("uids", CASES, ids=range(len(CASES)))
+def test_pack_bit_identical(uids):
+    _eq(native.pack(uids), packed.pack(uids))
+
+
+def test_random_roundtrip(rng):
+    for _ in range(20):
+        n = int(rng.integers(1, 3000))
+        gaps = rng.integers(1, 1 << int(rng.integers(1, 34)), size=n)
+        uids = np.cumsum(gaps.astype(np.uint64))
+        npl, ppl = native.pack(uids), packed.pack(uids)
+        _eq(npl, ppl)
+        np.testing.assert_array_equal(native.unpack(ppl), uids)
+        np.testing.assert_array_equal(packed.unpack(npl), uids)
+
+
+def test_pack_many_matches(rng):
+    rows = []
+    for _ in range(200):
+        n = int(rng.integers(0, 400))
+        rows.append(np.cumsum(rng.integers(1, 1000, size=n).astype(np.uint64)))
+    rows.append(np.array([3, 1 << 45], np.uint64))          # raw row
+    nat = native.pack_many(rows)
+    ref = packed.pack_many(rows)
+    for a, b in zip(nat, ref):
+        _eq(a, b)
+    for a, r in zip(nat, rows):
+        np.testing.assert_array_equal(packed.unpack(a), r)
+
+
+def test_seek_contract_native(rng):
+    uids = np.cumsum(rng.integers(1, 50, size=4000).astype(np.uint64))
+    pl = native.pack(uids)
+    for probe in [0, int(uids[17]), int(uids[-1]), int(uids[-1]) + 10]:
+        b = packed.seek_block(pl, probe)
+        if b < pl.nblocks:
+            assert pl.block_last[b] > probe
+        if b > 0:
+            assert pl.block_last[b - 1] <= probe
+
+
+def test_unpack_many_matches(rng):
+    rows = []
+    for _ in range(300):
+        n = int(rng.integers(0, 500))
+        rows.append(np.cumsum(rng.integers(1, 1 << 22, size=n).astype(np.uint64)))
+    rows.append(np.array([9, 1 << 40], np.uint64))
+    pls = packed.pack_many(rows)
+    nat = native.unpack_many(pls)
+    ref = packed.unpack_many(pls)
+    assert len(nat) == len(ref)
+    for a, b, r in zip(nat, ref, rows):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, r)
