@@ -18,7 +18,7 @@ def cmd_serve(args) -> int:
     from dgraph_tpu.api.http import make_server
     from dgraph_tpu.api.server import Node
 
-    node = Node(dirpath=args.postings)
+    node = Node(dirpath=args.postings, trace_fraction=args.trace)
     if args.schema:
         with open(args.schema) as f:
             node.alter(schema_text=f.read())
@@ -105,6 +105,8 @@ def main(argv=None) -> int:
     sp.add_argument("-p", "--postings", default=None,
                     help="durable posting dir (default: in-memory)")
     sp.add_argument("--schema", default=None, help="schema file to apply")
+    sp.add_argument("--trace", type=float, default=1.0,
+                    help="fraction of requests to trace (/debug/requests)")
     sp.set_defaults(fn=cmd_serve)
 
     vp = sub.add_parser("version", help="print version")

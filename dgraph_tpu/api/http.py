@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -70,11 +71,24 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes --------------------------------------------------------------
 
     def do_GET(self):
+        try:
+            self._do_get()
+        except Exception as e:
+            self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
+
+    def _do_get(self):
         path = urlparse(self.path).path.rstrip("/")
         if path == "/health":
             self._send(200, json.dumps(self.node.health()).encode())
         elif path == "/state":
             self._send(200, json.dumps(self.node.state()).encode())
+        elif path == "/debug/vars":
+            # expvar-style metrics dump (reference x/metrics.go /debug/vars)
+            self._send(200, json.dumps(self.node.metrics.to_dict()).encode())
+        elif path == "/debug/requests":
+            # recent sampled request traces (net/trace /debug/requests)
+            n = int(self._qs().get("n", "32"))
+            self._send(200, json.dumps(self.node.traces.recent(n)).encode())
         else:
             self._send(404, _envelope_err("ErrorInvalidRequest", "no such path"))
 
@@ -110,10 +124,13 @@ class _Handler(BaseHTTPRequestHandler):
         qs = self._qs()
         start_ts = qs.get("startTs")
         ro = qs.get("ro", qs.get("readOnly", "")).lower() == "true"
+        t0 = time.perf_counter_ns()
         out, ctx = self.node.query(
             q, variables, int(start_ts) if start_ts else None, read_only=ro)
         self._send(200, _envelope_ok(
-            out, {"txn": {"start_ts": ctx.start_ts}}))
+            out, {"txn": {"start_ts": ctx.start_ts},
+                  "server_latency":
+                      {"total_ns": time.perf_counter_ns() - t0}}))
 
     def _mutate(self):
         body = self._read_body()

@@ -22,6 +22,7 @@ the layers — first-committer-wins snapshot isolation
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 from dgraph_tpu.coord.zero import TxnConflict, Zero
@@ -35,6 +36,7 @@ from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData, build_pred,
                                           build_snapshot)
 from dgraph_tpu.storage.postings import Op
 from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.schema import parse_schema
 
 SNAP_CACHE = 4  # snapshots kept device-resident
@@ -63,9 +65,12 @@ class MutationResult:
 class Node:
     """One embedded server (store + zero + snapshot cache)."""
 
-    def __init__(self, dirpath: str | None = None, n_groups: int = 1) -> None:
+    def __init__(self, dirpath: str | None = None, n_groups: int = 1,
+                 trace_fraction: float = 1.0) -> None:
         self.store = Store(dirpath)
         self.zero = Zero(n_groups)
+        self.metrics = metrics.Registry()
+        self.traces = metrics.TraceStore(fraction=trace_fraction)
         self._txns: dict[int, TxnContext] = {}
         self._lock = threading.RLock()       # commit/read linearization
         self._snaps: dict[int, GraphSnapshot] = {}
@@ -131,6 +136,7 @@ class Node:
     def commit(self, start_ts: int) -> int:
         """CommitOrAbort (edgraph/server.go:462). Returns commit_ts; raises
         TxnConflict after aborting the txn's buffered layers on conflict."""
+        t0 = time.perf_counter()
         with self._lock:
             ctx = self._txns.pop(start_ts, None)
             if ctx is None:
@@ -140,9 +146,13 @@ class Node:
             except TxnConflict:
                 self.store.abort(start_ts, ctx.keys)
                 ctx.aborted = True
+                self.metrics.counter("dgraph_num_aborts_total").inc()
                 raise
             self.store.commit(start_ts, commit_ts, ctx.keys)
             ctx.commit_ts = commit_ts
+            self.metrics.counter("dgraph_num_commits_total").inc()
+            self.metrics.histogram("dgraph_commit_latency_s").observe(
+                time.perf_counter() - t0)
             return commit_ts
 
     def abort(self, start_ts: int) -> None:
@@ -182,6 +192,8 @@ class Node:
                 snap.preds[attr] = cached[1]
                 continue
             pd = build_pred(self.store, attr, eff)
+            self.metrics.counter("dgraph_posting_reads_total").inc(
+                len(self.store.by_pred.get((int(K.KeyKind.DATA), attr), ())))
             if eff >= pct:
                 self._pred_cache[attr] = (eff, pd)
             snap.preds[attr] = pd
@@ -229,23 +241,43 @@ class Node:
         joins an open txn's uncommitted overlay even if some pending txn
         happens to carry the same start_ts (read ts values come from the same
         oracle counter, so numeric collision is possible)."""
-        req = dql.parse(q, variables)
-        if req.upsert is not None:
-            # implicit txn commits; an explicit one stays open for the
-            # client's own commit/abort
-            out, _uids, ctx = self.upsert(
-                req.upsert["query"], req.upsert["mutations"],
-                start_ts=start_ts, commit_now=start_ts is None)
-            return out, ctx
-        if req.schema_request is not None:
-            return {"schema": self._schema_json(req.schema_request)}, \
-                TxnContext(start_ts=0)
-        if read_only and start_ts is not None:
-            read_ts, snap = start_ts, self.snapshot(start_ts)
-        else:
-            read_ts, snap = self._read_view(start_ts)
-        out = Executor(snap, self.store.schema).execute(req)
-        return out, TxnContext(start_ts=read_ts)
+        tr = self.traces.start(
+            "query", q.strip().splitlines()[0][:120] if q.strip() else "")
+        m = self.metrics
+        m.counter("dgraph_num_queries_total").inc()
+        m.counter("dgraph_pending_queries_total").inc()
+        t0 = time.perf_counter()
+        try:
+            req = dql.parse(q, variables)
+            tr.printf("parsed: %d query blocks", len(req.queries))
+            if req.upsert is not None:
+                # implicit txn commits; an explicit one stays open for the
+                # client's own commit/abort
+                out, _uids, ctx = self.upsert(
+                    req.upsert["query"], req.upsert["mutations"],
+                    start_ts=start_ts, commit_now=start_ts is None)
+                return out, ctx
+            if req.schema_request is not None:
+                return {"schema": self._schema_json(req.schema_request)}, \
+                    TxnContext(start_ts=0)
+            if read_only and start_ts is not None:
+                read_ts, snap = start_ts, self.snapshot(start_ts)
+            else:
+                read_ts, snap = self._read_view(start_ts)
+            tr.printf("snapshot at ts %d (%d preds)", read_ts, len(snap.preds))
+            out = Executor(snap, self.store.schema).execute(req)
+            tr.printf("executed")
+            return out, TxnContext(start_ts=read_ts)
+        except Exception as e:
+            self.traces.finish(tr, error=str(e))
+            tr = None
+            raise
+        finally:
+            m.counter("dgraph_pending_queries_total").dec()
+            m.histogram("dgraph_query_latency_s").observe(
+                time.perf_counter() - t0)
+            if tr is not None:
+                self.traces.finish(tr)
 
     def upsert(self, q: str, mutations: list[dict],
                variables: dict | None = None, start_ts: int | None = None,
@@ -254,6 +286,7 @@ class Node:
         doQueryInUpsert + gql/upsert.go). `mutations` entries carry any of
         cond / set / delete / set_json / delete_json (text cond is the inside
         of @if(...)). Returns (query json, assigned uids, ctx)."""
+        self.metrics.counter("dgraph_num_upserts_total").inc()
         own_txn = start_ts is None
         with self._lock:
             if own_txn:
@@ -339,33 +372,43 @@ class Node:
         nquads_del = list(nquads_del)
         if not nquads_set and not nquads_del:
             raise mut.MutationError("empty mutation")
-
-        # one critical section from txn lookup through apply+track: a
-        # concurrent commit/abort of the same start_ts can no longer
-        # interleave and orphan uncommitted layers (advisor r2 finding)
-        with self._lock:
-            if start_ts is None:
-                ctx = self.new_txn()
-            else:
-                ctx = self._txns.get(start_ts)
-                if ctx is None:
-                    raise mut.MutationError(f"unknown txn {start_ts}")
-            uid_map = mut.assign_uids(nquads_set + nquads_del, self.zero.uids)
-            edges = mut.to_edges(nquads_set, uid_map, Op.SET) + \
-                mut.to_edges(nquads_del, uid_map, Op.DEL)
-            touched, conflict, preds = mut.apply_mutations(
-                self.store, edges, ctx.start_ts)
-            ctx.keys += touched
-            ctx.conflict_keys += conflict
-            ctx.preds |= preds
-            ctx.version += 1
-            self.zero.oracle.track(ctx.start_ts, conflict, sorted(preds))
-            for p in preds:
-                self.zero.should_serve(p)
-        res = MutationResult(uids=uid_map, context=ctx)
-        if commit_now:
-            self.commit(ctx.start_ts)
-        return res
+        m = self.metrics
+        m.counter("dgraph_num_mutations_total").inc()
+        m.counter("dgraph_active_mutations_total").inc()
+        t0 = time.perf_counter()
+        try:
+            # one critical section from txn lookup through apply+track: a
+            # concurrent commit/abort of the same start_ts can no longer
+            # interleave and orphan uncommitted layers (advisor r2 finding)
+            with self._lock:
+                if start_ts is None:
+                    ctx = self.new_txn()
+                else:
+                    ctx = self._txns.get(start_ts)
+                    if ctx is None:
+                        raise mut.MutationError(f"unknown txn {start_ts}")
+                uid_map = mut.assign_uids(nquads_set + nquads_del,
+                                          self.zero.uids)
+                edges = mut.to_edges(nquads_set, uid_map, Op.SET) + \
+                    mut.to_edges(nquads_del, uid_map, Op.DEL)
+                touched, conflict, preds = mut.apply_mutations(
+                    self.store, edges, ctx.start_ts)
+                ctx.keys += touched
+                ctx.conflict_keys += conflict
+                ctx.preds |= preds
+                ctx.version += 1
+                self.zero.oracle.track(ctx.start_ts, conflict, sorted(preds))
+                for p in preds:
+                    self.zero.should_serve(p)
+                m.counter("dgraph_posting_writes_total").inc(len(touched))
+            res = MutationResult(uids=uid_map, context=ctx)
+            if commit_now:
+                self.commit(ctx.start_ts)
+            return res
+        finally:
+            m.counter("dgraph_active_mutations_total").dec()
+            m.histogram("dgraph_mutation_latency_s").observe(
+                time.perf_counter() - t0)
 
     def run_request(self, q: str, variables: dict | None = None,
                     commit_now: bool = True) -> tuple[dict, MutationResult | None]:
@@ -391,6 +434,7 @@ class Node:
               drop_all: bool = False) -> None:
         """Schema mutations + drops (server.go:213), with the reindex
         pipeline (worker/mutation.go:97 runSchemaMutation)."""
+        self.metrics.counter("dgraph_num_alters_total").inc()
         with self._lock:
             if drop_all:
                 for attr in set(self.store.predicates()) | \

@@ -1,0 +1,166 @@
+"""Metrics + request tracing (reference: x/metrics.go expvar counters at
+/debug/vars, golang.org/x/net/trace request traces at /debug/requests with
+sampled LazyPrintf breadcrumbs, edgraph/server.go:289,388).
+
+Design: one Registry per server Node (tests run many embedded nodes — a
+process-global expvar table like the reference's would bleed counts between
+them). Counters take the GIL-side lock only on read-modify-write; histograms
+keep a bounded ring of recent samples and compute percentiles on demand
+rather than maintaining buckets (the /debug surface is low-QPS)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+
+
+class Counter:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    def dec(self, n: int = 1) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Histogram:
+    """Bounded ring of recent samples; percentiles computed on read."""
+
+    __slots__ = ("_ring", "_lock", "count", "total")
+
+    def __init__(self, cap: int = 2048) -> None:
+        self._ring: deque[float] = deque(maxlen=cap)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._ring.append(v)
+            self.count += 1
+            self.total += v
+
+    def snapshot(self) -> dict:
+        """count is lifetime; mean and percentiles all describe the same
+        recent window (the ring) so the distribution is self-consistent."""
+        with self._lock:
+            vals = sorted(self._ring)
+            count = self.count
+        if not vals:
+            return {"count": count, "mean": 0.0}
+        pick = lambda q: vals[min(len(vals) - 1, int(q * len(vals)))]
+        return {"count": count,
+                "mean": round(sum(vals) / len(vals), 6),
+                "p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99),
+                "max": vals[-1]}
+
+
+class Registry:
+    """Named metrics with the reference's dgraph_* vocabulary pre-registered
+    (x/metrics.go:27-76)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, Counter] = {}
+        self.histograms: dict[str, Histogram] = {}
+        for name in ("dgraph_num_queries_total", "dgraph_num_mutations_total",
+                     "dgraph_num_commits_total", "dgraph_num_aborts_total",
+                     "dgraph_posting_reads_total",
+                     "dgraph_posting_writes_total",
+                     "dgraph_pending_queries_total",
+                     "dgraph_active_mutations_total",
+                     "dgraph_num_upserts_total", "dgraph_num_alters_total"):
+            self.counters[name] = Counter()
+        for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
+                     "dgraph_commit_latency_s"):
+            self.histograms[name] = Histogram()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self.counters.setdefault(name, Counter())
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self.histograms.setdefault(name, Histogram())
+
+    def to_dict(self) -> dict:
+        """expvar-style dump for /debug/vars."""
+        out: dict = {c: m.value for c, m in sorted(self.counters.items())}
+        out.update({h: m.snapshot() for h, m in sorted(self.histograms.items())})
+        return out
+
+
+class Trace:
+    """One request's breadcrumb trail (net/trace analog)."""
+
+    __slots__ = ("kind", "title", "t0", "events", "error", "elapsed")
+
+    def __init__(self, kind: str, title: str) -> None:
+        self.kind = kind
+        self.title = title
+        self.t0 = time.perf_counter()
+        self.events: list[tuple[float, str]] = []
+        self.error = ""
+        self.elapsed = 0.0            # frozen by TraceStore.finish
+
+    def printf(self, msg: str, *args) -> None:
+        self.events.append((time.perf_counter() - self.t0,
+                            msg % args if args else msg))
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "title": self.title,
+                "elapsed_s": round(self.elapsed, 6),
+                "error": self.error,
+                "events": [{"t": round(t, 6), "msg": m}
+                           for t, m in self.events]}
+
+
+class _NullTrace:
+    """Unsampled requests get a no-op trace — zero overhead breadcrumbs."""
+
+    def printf(self, msg: str, *args) -> None:
+        pass
+
+    error = ""
+
+
+NULL_TRACE = _NullTrace()
+
+
+class TraceStore:
+    """Sampled request traces, newest-first ring (reference: --trace fraction
+    gating tr.New, /debug/requests rendering)."""
+
+    def __init__(self, fraction: float = 1.0, keep: int = 64) -> None:
+        self.fraction = fraction
+        self._ring: deque[Trace] = deque(maxlen=keep)
+        self._lock = threading.Lock()
+
+    def start(self, kind: str, title: str):
+        if self.fraction <= 0 or random.random() >= self.fraction:
+            return NULL_TRACE
+        return Trace(kind, title)
+
+    def finish(self, tr, error: str = "") -> None:
+        if tr is NULL_TRACE:
+            return
+        tr.error = error
+        tr.elapsed = time.perf_counter() - tr.t0
+        with self._lock:
+            self._ring.appendleft(tr)
+
+    def recent(self, n: int = 32) -> list[dict]:
+        with self._lock:
+            return [t.to_dict() for i, t in enumerate(self._ring) if i < n]

@@ -1,0 +1,94 @@
+"""Observability: dgraph_* counters, latency histograms, request traces,
+and the /debug HTTP surface (reference: x/metrics.go, net/trace sampling in
+edgraph/server.go:289,388)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import TxnConflict
+from dgraph_tpu.utils import metrics
+
+
+def test_counters_and_latency():
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    n.mutate(set_nquads='_:a <name> "m" .', commit_now=True)
+    n.query('{ q(func: eq(name, "m")) { name } }')
+    c = n.metrics.counters
+    assert c["dgraph_num_queries_total"].value == 1
+    assert c["dgraph_num_mutations_total"].value == 1
+    assert c["dgraph_num_commits_total"].value == 1
+    assert c["dgraph_num_alters_total"].value == 1
+    assert c["dgraph_posting_writes_total"].value > 0
+    assert c["dgraph_posting_reads_total"].value > 0
+    assert c["dgraph_pending_queries_total"].value == 0   # dec in finally
+    h = n.metrics.histograms["dgraph_query_latency_s"].snapshot()
+    assert h["count"] == 1 and h["p50"] > 0
+
+
+def test_abort_counter():
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    t1, t2 = n.new_txn(), n.new_txn()
+    n.mutate(set_nquads='<0x9> <name> "x" .', start_ts=t1.start_ts)
+    n.mutate(set_nquads='<0x9> <name> "y" .', start_ts=t2.start_ts)
+    n.commit(t1.start_ts)
+    with pytest.raises(TxnConflict):
+        n.commit(t2.start_ts)
+    assert n.metrics.counters["dgraph_num_aborts_total"].value == 1
+
+
+def test_traces_record_breadcrumbs_and_errors():
+    n = Node(trace_fraction=1.0)
+    n.alter(schema_text="name: string @index(exact) .")
+    n.query('{ q(func: has(name)) { name } }')
+    recent = n.traces.recent()
+    assert recent and recent[0]["kind"] == "query"
+    msgs = [e["msg"] for e in recent[0]["events"]]
+    assert any("parsed" in m for m in msgs)
+    assert any("executed" in m for m in msgs)
+    with pytest.raises(Exception):
+        n.query("{ bad dql !!!")
+    assert n.traces.recent()[0]["error"]
+
+
+def test_trace_sampling_off():
+    n = Node(trace_fraction=0.0)
+    n.alter(schema_text="name: string .")
+    n.query("{ q(func: has(name)) { name } }")
+    assert n.traces.recent() == []
+
+
+def test_debug_http_endpoints():
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    srv = make_server(n, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        body = json.dumps({"query": '{ q(func: has(name)) { name } }'}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/query", body,
+            {"Content-Type": "application/json"}), timeout=5).read()
+        v = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/vars", timeout=5).read())
+        assert v["dgraph_num_queries_total"] >= 1
+        assert "dgraph_query_latency_s" in v
+        tr = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/requests", timeout=5).read())
+        assert tr and tr[0]["kind"] == "query"
+    finally:
+        srv.shutdown()
+
+
+def test_histogram_percentiles():
+    h = metrics.Histogram(cap=100)
+    for i in range(1, 101):
+        h.observe(float(i))
+    s = h.snapshot()
+    assert s["count"] == 100 and s["p50"] == 51.0 and s["max"] == 100.0
