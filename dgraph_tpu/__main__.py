@@ -15,10 +15,24 @@ VERSION = "dgraph-tpu 0.2.0"
 
 
 def cmd_serve(args) -> int:
+    import threading
+
     from dgraph_tpu.api.http import make_server
     from dgraph_tpu.api.server import Node
 
     node = Node(dirpath=args.postings, trace_fraction=args.trace)
+    if args.memory_mb:
+        budget = args.memory_mb * (1 << 20)
+
+        def _enforce():
+            import time as _t
+            while True:
+                _t.sleep(10)
+                try:
+                    node.enforce_memory(budget)
+                except Exception:
+                    pass
+        threading.Thread(target=_enforce, daemon=True).start()
     if args.schema:
         with open(args.schema) as f:
             node.alter(schema_text=f.read())
@@ -107,6 +121,9 @@ def main(argv=None) -> int:
     sp.add_argument("--schema", default=None, help="schema file to apply")
     sp.add_argument("--trace", type=float, default=1.0,
                     help="fraction of requests to trace (/debug/requests)")
+    sp.add_argument("--memory_mb", type=int, default=0,
+                    help="posting-list memory budget; periodic rollup + "
+                         "cache drop keeps usage under it (0 = unbounded)")
     sp.set_defaults(fn=cmd_serve)
 
     vp = sub.add_parser("version", help="print version")

@@ -457,6 +457,51 @@ class Node:
                     idx.rebuild_count(self.store, e.predicate, read_ts, commit_ts)
             self._invalidate_snapshots()
 
+    # -- memory management ---------------------------------------------------
+
+    def enforce_memory(self, budget_bytes: int) -> dict:
+        """Bring host posting-list memory under budget (the --memory_mb
+        contract; reference posting/lists.go:123-180 periodic commit +
+        LRU eviction under AllottedMemory).
+
+        Levers, cheapest first:
+        1. roll up the layer-heaviest lists below the min-pending watermark
+           (folds Python layer dicts into the packed numpy base — the same
+           compaction the reference's periodic commit achieves);
+        2. drop cached device snapshots and the predicate build cache
+           (rebuilt read-through on the next query).
+        Never touches uncommitted layers or layers a live txn could read.
+        """
+        stats = self.store.memory_stats()
+        rolled = 0
+        if stats["bytes"] > budget_bytes and stats["layers"]:
+            pend = self.zero.oracle.min_pending()
+            upto = self.store.max_seen_commit_ts if pend is None \
+                else min(pend - 1, self.store.max_seen_commit_ts)
+            if upto > 0:
+                with self.store._lock:
+                    pls = list(self.store.lists.values())
+                pls.sort(key=lambda p: p.layer_count(), reverse=True)
+                for pl in pls:
+                    if pl.layer_count() == 0:
+                        break
+                    pl.rollup(upto)
+                    rolled += 1
+                    if rolled % 256 == 0 and \
+                            self.store.memory_stats()["bytes"] <= budget_bytes:
+                        break
+                stats = self.store.memory_stats()
+        dropped_snaps = 0
+        if stats["bytes"] > budget_bytes:
+            with self._lock:
+                dropped_snaps = len(self._snaps) + len(self._pred_cache)
+                self._snaps.clear()
+                self._pred_cache.clear()
+        self.metrics.counter("dgraph_memory_bytes").set(stats["bytes"])
+        return {"bytes": stats["bytes"], "lists": stats["lists"],
+                "layers": stats["layers"], "rolled_up": rolled,
+                "dropped_caches": dropped_snaps}
+
     # -- ops -----------------------------------------------------------------
 
     def health(self) -> dict:

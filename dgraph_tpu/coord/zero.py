@@ -102,6 +102,12 @@ class Oracle:
             self._pending[ts] = st
             return st
 
+    def min_pending(self) -> int | None:
+        """Smallest open txn start_ts (the MinTs watermark feeding rollup and
+        conflict GC; reference oracle.go MinTs)."""
+        with self._lock:
+            return min(self._pending) if self._pending else None
+
     def read_ts(self) -> int:
         """Snapshot ts for a fresh read-only query: everything committed so
         far is visible (max assigned; application is synchronous here)."""

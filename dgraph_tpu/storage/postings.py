@@ -289,6 +289,24 @@ class PostingList:
             self.layers = keep
             self.base_ts = upto_ts
 
+    # rough per-Posting host cost (object header + dict slot + Val), used by
+    # the memory manager's budget accounting (posting/lists.go AllottedMemory)
+    _POSTING_COST = 200
+
+    def approx_bytes(self) -> int:
+        with self._lock:
+            n = 256 + self.base_packed.nbytes
+            n += self._POSTING_COST * len(self.base_postings)
+            for layer in self.layers:
+                n += 64 + self._POSTING_COST * len(layer.postings)
+            for layer in self.uncommitted.values():
+                n += 64 + self._POSTING_COST * len(layer.postings)
+            return n
+
+    def layer_count(self) -> int:
+        with self._lock:
+            return len(self.layers)
+
     def min_pending_start_ts(self) -> int | None:
         with self._lock:
             return min(self.uncommitted) if self.uncommitted else None

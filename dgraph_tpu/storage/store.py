@@ -120,6 +120,18 @@ class Store:
         with self._lock:
             return sorted(self.by_pred.get((int(kind), attr), ()))
 
+    def memory_stats(self) -> dict:
+        """Approximate host memory held by posting lists (the accounting
+        behind the --memory_mb budget; posting/lists.go:123-180)."""
+        total = 0
+        layers = 0
+        with self._lock:
+            pls = list(self.lists.values())
+        for pl in pls:
+            total += pl.approx_bytes()
+            layers += pl.layer_count()
+        return {"bytes": total, "lists": len(pls), "layers": layers}
+
     def predicates(self) -> list[str]:
         with self._lock:
             return sorted({attr for (kind, attr) in self.by_pred

@@ -30,6 +30,11 @@ class Counter:
     def dec(self, n: int = 1) -> None:
         self.inc(-n)
 
+    def set(self, v: int) -> None:
+        """Gauge-style overwrite (dgraph_memory_bytes etc.)."""
+        with self._lock:
+            self._v = v
+
     @property
     def value(self) -> int:
         return self._v
