@@ -36,6 +36,7 @@ from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData, build_pred,
                                           build_snapshot)
 from dgraph_tpu.storage.postings import Op
 from dgraph_tpu.storage.store import Store
+from dgraph_tpu.parallel.scheduler import Scheduler
 from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.schema import parse_schema
 
@@ -54,6 +55,8 @@ class TxnContext:
     preds: set[str] = field(default_factory=set)
     version: int = 0                       # bumped per mutate (overlay cache)
     overlay: tuple[int, dict] | None = None  # (version, {attr: PredData})
+    inflight: int = 0          # mutations mid-apply; commit/abort wait on 0
+    finishing: bool = False    # commit/abort started: reject new mutations
 
 
 @dataclass
@@ -73,6 +76,8 @@ class Node:
         self.traces = metrics.TraceStore(fraction=trace_fraction)
         self._txns: dict[int, TxnContext] = {}
         self._lock = threading.RLock()       # commit/read linearization
+        self._inflight_cv = threading.Condition(self._lock)
+        self._sched = Scheduler()            # conflict-keyed mutation apply
         self._snaps: dict[int, GraphSnapshot] = {}
         # incremental-build cache: attr -> (eff_ts it was built at, PredData).
         # Reused when no commit touched the predicate since (pred_commit_ts),
@@ -127,7 +132,8 @@ class Node:
                 # a later commit on one returns "unknown txn", same as the
                 # reference's expired-txn behavior
                 idle = sorted(ts for ts, c in self._txns.items()
-                              if not c.keys and ts != st.start_ts)
+                              if not c.keys and not c.inflight
+                              and ts != st.start_ts)
                 for ts in idle[: len(idle) // 2]:
                     del self._txns[ts]
                     self.zero.oracle.abort(ts)
@@ -138,8 +144,17 @@ class Node:
         TxnConflict after aborting the txn's buffered layers on conflict."""
         t0 = time.perf_counter()
         with self._lock:
-            ctx = self._txns.pop(start_ts, None)
+            ctx = self._txns.get(start_ts)
             if ctx is None:
+                raise mut.MutationError(f"unknown txn {start_ts}")
+            # cut off new mutations first, then drain in-flight applies —
+            # otherwise a steady write stream could starve this wait and
+            # late mutations would silently ride the commit
+            ctx.finishing = True
+            while ctx.inflight:
+                self._inflight_cv.wait()
+            if self._txns.pop(start_ts, None) is None:
+                # a concurrent commit/abort won the race while we waited
                 raise mut.MutationError(f"unknown txn {start_ts}")
             try:
                 commit_ts = self.zero.oracle.commit(start_ts)
@@ -157,6 +172,11 @@ class Node:
 
     def abort(self, start_ts: int) -> None:
         with self._lock:
+            ctx = self._txns.get(start_ts)
+            if ctx is not None:
+                ctx.finishing = True
+                while ctx.inflight:
+                    self._inflight_cv.wait()
             ctx = self._txns.pop(start_ts, None)
             self.zero.oracle.abort(start_ts)
             if ctx is not None:
@@ -216,6 +236,11 @@ class Node:
             # ts may numerically equal a pending txn's start_ts and must not
             # see its uncommitted writes
             ctx = self._txns.get(start_ts) if start_ts is not None else None
+            if ctx is not None:
+                # drain this txn's in-flight applies: the overlay build reads
+                # the uncommitted layer dicts a concurrent apply mutates
+                while ctx.inflight:
+                    self._inflight_cv.wait()
             if ctx is not None and ctx.preds:
                 base = self.snapshot(read_ts)
                 snap = GraphSnapshot(read_ts)
@@ -377,30 +402,62 @@ class Node:
         m.counter("dgraph_active_mutations_total").inc()
         t0 = time.perf_counter()
         try:
-            # one critical section from txn lookup through apply+track: a
-            # concurrent commit/abort of the same start_ts can no longer
-            # interleave and orphan uncommitted layers (advisor r2 finding)
             with self._lock:
                 if start_ts is None:
                     ctx = self.new_txn()
                 else:
                     ctx = self._txns.get(start_ts)
-                    if ctx is None:
+                    if ctx is None or ctx.finishing:
                         raise mut.MutationError(f"unknown txn {start_ts}")
+                # inflight pins the txn: commit/abort of this start_ts wait
+                # until apply completes, so they can't interleave mid-apply
+                # and orphan uncommitted layers (advisor r2 invariant, now
+                # kept WITHOUT serializing all mutations behind one lock)
+                ctx.inflight += 1
+            applied = False
+            try:
                 uid_map = mut.assign_uids(nquads_set + nquads_del,
                                           self.zero.uids)
                 edges = mut.to_edges(nquads_set, uid_map, Op.SET) + \
                     mut.to_edges(nquads_del, uid_map, Op.DEL)
-                touched, conflict, preds = mut.apply_mutations(
-                    self.store, edges, ctx.start_ts)
-                ctx.keys += touched
-                ctx.conflict_keys += conflict
-                ctx.preds |= preds
-                ctx.version += 1
-                self.zero.oracle.track(ctx.start_ts, conflict, sorted(preds))
-                for p in preds:
-                    self.zero.should_serve(p)
-                m.counter("dgraph_posting_writes_total").inc(len(touched))
+                # conflict-keyed parallel apply (worker/scheduler.go:34-95):
+                # disjoint (attr, uid) footprints run concurrently; shared
+                # footprints serialize in arrival order. Objects of uid edges
+                # are in the footprint too (reverse/count maintenance does
+                # read-modify-write on the object side). `S * *` deletes
+                # only learn their footprint by reading the store at apply
+                # time, so they take the scheduler exclusively.
+                exclusive = any(e.attr == "*" for e in edges)
+                skeys: set[int] = set()
+                if not exclusive:
+                    for e in edges:
+                        skeys.add(hash((e.attr, e.subject)))
+                        if e.object_uid:
+                            skeys.add(hash((e.attr, e.object_uid)))
+                touched, conflict, preds = self._sched.run(
+                    skeys, lambda: mut.apply_mutations(
+                        self.store, edges, ctx.start_ts),
+                    exclusive=exclusive)
+                applied = True
+            finally:
+                with self._lock:
+                    try:
+                        if applied:
+                            ctx.keys += touched
+                            ctx.conflict_keys += conflict
+                            ctx.preds |= preds
+                            ctx.version += 1
+                            self.zero.oracle.track(ctx.start_ts, conflict,
+                                                   sorted(preds))
+                            m.counter("dgraph_posting_writes_total").inc(
+                                len(touched))
+                    finally:
+                        # unconditional: a parked commit/abort must wake even
+                        # if oracle bookkeeping above raised
+                        ctx.inflight -= 1
+                        self._inflight_cv.notify_all()
+            for p in preds:
+                self.zero.should_serve(p)
             res = MutationResult(uids=uid_map, context=ctx)
             if commit_now:
                 self.commit(ctx.start_ts)
