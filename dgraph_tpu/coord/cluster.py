@@ -1,0 +1,222 @@
+"""Multi-group cluster: per-group stores, tablet routing, predicate moves.
+
+Reference semantics:
+- worker/groups.go:292 BelongsTo — every predicate ("tablet") is owned by
+  exactly one group; mutations and task execution route to the owner.
+- worker/mutation.go:470 populateMutationMap — a mutation's edges are split
+  by owning group and applied on each.
+- worker/predicate_move.go:86-177 — moving a tablet: block writes, abort
+  open txns touching it, stream every key of the predicate to the target
+  group at a snapshot ts, flip the tablet map in Zero, delete at the source.
+
+Topology: one shared Zero (oracle + uid lease + tablet map) over N group
+stores in one process — the same collapse the reference's own test harness
+uses (embedded zero+workers). Queries assemble a federated snapshot by
+building each predicate's device arrays from its OWNING group's store, so
+the Executor is unchanged. Cross-group transactions work because conflict
+detection is centralized in the shared oracle while buffered layers live in
+each group's store.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.query import dql
+from dgraph_tpu.query import mutation as mut
+from dgraph_tpu.query.engine import Executor
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage.csr_build import GraphSnapshot, build_pred
+from dgraph_tpu.storage.postings import Op
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import SchemaState, parse_schema
+
+
+class MoveInProgress(Exception):
+    pass
+
+
+class Cluster:
+    """N group stores behind one Zero (embedded multi-group topology)."""
+
+    def __init__(self, n_groups: int = 2, dirs: list[str] | None = None) -> None:
+        self.zero = Zero(n_groups)
+        self.stores = [Store(dirs[g] if dirs else None)
+                       for g in range(n_groups)]
+        self._lock = threading.RLock()
+        self._txn_keys: dict[int, dict[int, list[bytes]]] = {}  # ts -> g -> keys
+
+    # -- routing -------------------------------------------------------------
+
+    def group_of(self, attr: str) -> int:
+        return self.zero.should_serve(attr)
+
+    def store_of(self, attr: str) -> Store:
+        return self.stores[self.group_of(attr)]
+
+    @property
+    def schema(self) -> SchemaState:
+        """Cluster-wide schema view: alter replicates entries to every group,
+        but mutation-time INFERRED entries land only on the owning group's
+        store — merge them all (each predicate is owned by exactly one group,
+        so there are no conflicting entries)."""
+        merged = SchemaState()
+        for s in self.stores:
+            for attr in s.schema.predicates():
+                merged.set(s.schema.get(attr))
+        return merged
+
+    # -- schema --------------------------------------------------------------
+
+    def alter(self, schema_text: str) -> None:
+        for e in parse_schema(schema_text):
+            for s in self.stores:
+                s.set_schema(e)
+
+    # -- mutate --------------------------------------------------------------
+
+    def mutate(self, set_nquads: str = "", del_nquads: str = "",
+               commit_now: bool = True) -> dict[str, int]:
+        """Split edges by owning group, apply on each, commit via the shared
+        oracle (populateMutationMap + MutateOverNetwork)."""
+        from dgraph_tpu.query import rdf
+
+        nq_set = rdf.parse(set_nquads) if set_nquads else []
+        nq_del = rdf.parse(del_nquads) if del_nquads else []
+        with self._lock:
+            for e in nq_set + nq_del:
+                if self.zero.writes_blocked(e.predicate) or (
+                        e.predicate == "*" and self.zero.moving_tablets()):
+                    raise MoveInProgress(
+                        f"predicate {e.predicate!r} is moving; retry")
+            st = self.zero.oracle.new_txn()
+            keys_by_group: dict[int, list[bytes]] = {}
+            try:
+                uid_map = mut.assign_uids(nq_set + nq_del, self.zero.uids)
+                edges = mut.to_edges(nq_set, uid_map, Op.SET) + \
+                    mut.to_edges(nq_del, uid_map, Op.DEL)
+                by_group: dict[int, list] = {}
+                for e in edges:
+                    if e.attr == "*":
+                        # S * * expands against each group's OWN predicates —
+                        # the reference fans * deletes to every group
+                        # (populateMutationMap, worker/mutation.go:470)
+                        for g in range(len(self.stores)):
+                            by_group.setdefault(g, []).append(e)
+                        continue
+                    by_group.setdefault(self.group_of(e.attr), []).append(e)
+                conflicts: list[bytes] = []
+                preds: set[str] = set()
+                for g, ge in sorted(by_group.items()):
+                    touched, conflict, p = mut.apply_mutations(
+                        self.stores[g], ge, st.start_ts)
+                    keys_by_group[g] = touched
+                    conflicts += conflict
+                    preds |= p
+                self.zero.oracle.track(st.start_ts, conflicts, sorted(preds))
+                self._txn_keys[st.start_ts] = keys_by_group
+            except BaseException:
+                # abort everything buffered so far: leaked pending txns pin
+                # the oracle's purge watermark forever
+                for g, kb in keys_by_group.items():
+                    self.stores[g].abort(st.start_ts, kb)
+                self.zero.oracle.abort(st.start_ts)
+                raise
+            if commit_now:
+                self.commit(st.start_ts)
+        return uid_map
+
+    def commit(self, start_ts: int) -> int:
+        with self._lock:
+            keys_by_group = self._txn_keys.pop(start_ts, {})
+            try:
+                commit_ts = self.zero.oracle.commit(start_ts)
+            except Exception:
+                for g, kb in keys_by_group.items():
+                    self.stores[g].abort(start_ts, kb)
+                raise
+            for g, kb in keys_by_group.items():
+                self.stores[g].commit(start_ts, commit_ts, kb)
+            return commit_ts
+
+    # -- query ---------------------------------------------------------------
+
+    def query(self, q: str, variables: dict | None = None) -> dict:
+        """Federated read: each predicate's snapshot arrays build from its
+        owning group's store (ProcessTaskOverNetwork routes the same way)."""
+        with self._lock:
+            # read_ts under the lock: a move completing in between would make
+            # the moved predicate invisible (streamed copy commits above our
+            # ts, source copy already deleted)
+            read_ts = self.zero.oracle.read_ts()
+            snap = GraphSnapshot(read_ts)
+            for attr, g in sorted(self.zero.tablets().items()):
+                if any(self.stores[g].by_pred.get((int(kind), attr))
+                       for kind in (K.KeyKind.DATA, K.KeyKind.REVERSE)):
+                    snap.preds[attr] = build_pred(self.stores[g], attr,
+                                                  read_ts)
+        return Executor(snap, self.schema).execute(dql.parse(q, variables))
+
+    # -- predicate move ------------------------------------------------------
+
+    def move_predicate(self, attr: str, dst_group: int) -> dict:
+        """The full move protocol (worker/predicate_move.go:86-177):
+        1. block writes on the tablet (new mutations raise MoveInProgress);
+        2. abort open txns that touched it (Zero TryAbort);
+        3. snapshot-read every key of the predicate at ts and stream the
+           effective postings into the destination store under one txn;
+        4. flip the tablet map;
+        5. delete the predicate at the source;
+        6. unblock writes.
+        """
+        src_group = self.group_of(attr)
+        if src_group == dst_group:
+            return {"moved_keys": 0, "aborted_txns": 0}
+        src, dst = self.stores[src_group], self.stores[dst_group]
+        self.zero.block_writes(attr)
+        try:
+            with self._lock:
+                aborted = 0
+                for ts in self.zero.oracle.pending_on(attr):
+                    self.zero.oracle.abort(ts)
+                    kb = self._txn_keys.pop(ts, {})
+                    for g, keys in kb.items():
+                        self.stores[g].abort(ts, keys)
+                    aborted += 1
+                read_ts = self.zero.oracle.read_ts()
+                move_st = self.zero.oracle.new_txn()
+                moved_keys: list[bytes] = []
+                try:
+                    for kind in (K.KeyKind.DATA, K.KeyKind.REVERSE,
+                                 K.KeyKind.INDEX, K.KeyKind.COUNT):
+                        for kb in src.keys_of(kind, attr):
+                            pl = src.lists.get(kb)
+                            if pl is None:
+                                continue
+                            key = K.parse_key(kb)
+                            for p in pl.postings(read_ts):
+                                dst.add_mutation(move_st.start_ts, key, p)
+                            moved_keys.append(kb)
+                    entry = src.schema.get(attr)
+                    if entry is not None:
+                        dst.set_schema(entry)
+                    # the move txn carries no conflict keys (writes on attr
+                    # are blocked), so the oracle commit always succeeds
+                    commit_ts = self.zero.oracle.commit(move_st.start_ts)
+                except BaseException:
+                    # mid-stream failure: drop the partial copy and the
+                    # pending move txn; source stays authoritative
+                    dst.abort(move_st.start_ts, moved_keys)
+                    self.zero.oracle.abort(move_st.start_ts)
+                    raise
+                dst.commit(move_st.start_ts, commit_ts, moved_keys)
+                self.zero.move_tablet(attr, dst_group)
+                src.delete_predicate(attr)
+                return {"moved_keys": len(moved_keys), "aborted_txns": aborted}
+        finally:
+            self.zero.unblock_writes(attr)
+
+    def close(self) -> None:
+        for s in self.stores:
+            s.close()

@@ -102,6 +102,13 @@ class Oracle:
             self._pending[ts] = st
             return st
 
+    def pending_on(self, attr: str) -> list[int]:
+        """start_ts of open txns that touched a predicate (the TryAbort
+        candidates when that tablet moves; zero.go:436 + predicate_move)."""
+        with self._lock:
+            return [ts for ts, st in self._pending.items()
+                    if attr in st.preds]
+
     def min_pending(self) -> int | None:
         """Smallest open txn start_ts (the MinTs watermark feeding rollup and
         conflict GC; reference oracle.go MinTs)."""
@@ -219,7 +226,27 @@ class Zero:
         self.uids = UidLease()
         self.n_groups = max(1, n_groups)
         self._tablets: dict[str, int] = {}
+        self._moving: set[str] = set()     # tablets mid-move: writes blocked
         self._tlock = threading.Lock()
+
+    def block_writes(self, attr: str) -> None:
+        """Mark a tablet read-only for the duration of a move (the reference
+        aborts/rejects mutations on a moving predicate,
+        predicate_move.go:86 + worker/mutation.go tablet checks)."""
+        with self._tlock:
+            self._moving.add(attr)
+
+    def unblock_writes(self, attr: str) -> None:
+        with self._tlock:
+            self._moving.discard(attr)
+
+    def writes_blocked(self, attr: str) -> bool:
+        with self._tlock:
+            return attr in self._moving
+
+    def moving_tablets(self) -> set[str]:
+        with self._tlock:
+            return set(self._moving)
 
     def should_serve(self, attr: str) -> int:
         """Group owning a predicate; first-asker claims it, balanced by

@@ -1,0 +1,159 @@
+"""Multi-group cluster: tablet routing, cross-group txns, federated queries,
+and the predicate-move protocol (reference worker/groups.go BelongsTo,
+worker/mutation.go populateMutationMap, worker/predicate_move.go:86-177)."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.coord.cluster import Cluster, MoveInProgress
+from dgraph_tpu.storage import keys as K
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(n_groups=2)
+    c.alter("""
+        name: string @index(exact) .
+        follows: [uid] @reverse @count .
+        age: int @index(int) .
+    """)
+    return c
+
+
+def _seed(c):
+    c.mutate(set_nquads="""
+        _:a <name> "ann" .
+        _:a <age> "30" .
+        _:b <name> "bob" .
+        _:b <age> "41" .
+        _:a <follows> _:b .
+    """)
+
+
+def test_tablets_split_across_groups(cluster):
+    _seed(cluster)
+    groups = {cluster.group_of(a) for a in ("name", "age", "follows")}
+    assert len(groups) == 2          # load-balanced claim spread the tablets
+
+
+def test_cross_group_txn_and_federated_query(cluster):
+    _seed(cluster)
+    out = cluster.query('{ q(func: eq(name, "ann")) { name age follows { name } } }')
+    assert out == {"q": [{"name": "ann", "age": 30,
+                          "follows": [{"name": "bob"}]}]}
+
+
+def test_move_predicate_full_protocol(cluster):
+    _seed(cluster)
+    attr = "name"
+    src = cluster.group_of(attr)
+    dst = 1 - src
+    before = cluster.query('{ q(func: eq(name, "bob"), orderasc: name) { name age } }')
+    report = cluster.move_predicate(attr, dst)
+    assert report["moved_keys"] > 0
+    # ownership flipped; data gone at the source, fully served at the target
+    assert cluster.group_of(attr) == dst
+    assert not cluster.stores[src].keys_of(K.KeyKind.DATA, attr)
+    assert cluster.stores[dst].keys_of(K.KeyKind.DATA, attr)
+    after = cluster.query('{ q(func: eq(name, "bob"), orderasc: name) { name age } }')
+    assert after == before
+    # index keys moved too: eq() above used the exact index on the new group
+    assert cluster.stores[dst].keys_of(K.KeyKind.INDEX, attr)
+
+
+def test_move_blocks_writes_and_aborts_open_txns(cluster):
+    _seed(cluster)
+    attr = "age"
+    dst = 1 - cluster.group_of(attr)
+    # an open txn touching the predicate gets aborted by the move
+    from dgraph_tpu.query import rdf
+    from dgraph_tpu.query import mutation as mut
+    from dgraph_tpu.storage.postings import Op
+    st = cluster.zero.oracle.new_txn()
+    edges = mut.to_edges(rdf.parse('<0x1> <age> "99" .'), {}, Op.SET)
+    touched, conflict, preds = mut.apply_mutations(
+        cluster.store_of(attr), edges, st.start_ts)
+    cluster.zero.oracle.track(st.start_ts, conflict, sorted(preds))
+    cluster._txn_keys[st.start_ts] = {cluster.group_of(attr): touched}
+    report = cluster.move_predicate(attr, dst)
+    assert report["aborted_txns"] == 1
+    with pytest.raises(Exception):
+        cluster.commit(st.start_ts)
+    # the aborted write is invisible
+    out = cluster.query('{ q(func: eq(name, "ann")) { age } }')
+    assert out["q"][0]["age"] == 30
+
+
+def test_writes_rejected_mid_move(cluster):
+    _seed(cluster)
+    cluster.zero.block_writes("age")
+    with pytest.raises(MoveInProgress):
+        cluster.mutate(set_nquads='<0x1> <age> "50" .')
+    cluster.zero.unblock_writes("age")
+    cluster.mutate(set_nquads='<0x1> <age> "50" .')
+    out = cluster.query('{ q(func: eq(name, "ann")) { age } }')
+    assert out["q"][0]["age"] == 50
+
+
+def test_reverse_and_count_follow_the_move(cluster):
+    _seed(cluster)
+    attr = "follows"
+    dst = 1 - cluster.group_of(attr)
+    cluster.move_predicate(attr, dst)
+    out = cluster.query('{ q(func: eq(name, "bob")) { ~follows { name } } }')
+    assert out == {"q": [{"~follows": [{"name": "ann"}]}]}
+    out = cluster.query('{ q(func: eq(count(follows), 1)) { name } }')
+    assert out == {"q": [{"name": "ann"}]}
+
+
+def test_move_to_same_group_noop(cluster):
+    _seed(cluster)
+    g = cluster.group_of("name")
+    assert cluster.move_predicate("name", g) == {"moved_keys": 0,
+                                                 "aborted_txns": 0}
+
+
+def test_conflict_detection_spans_groups(cluster):
+    _seed(cluster)
+    from dgraph_tpu.coord.zero import TxnConflict
+    from dgraph_tpu.query import rdf
+    from dgraph_tpu.query import mutation as mut
+    from dgraph_tpu.storage.postings import Op
+
+    def open_write(val):
+        st = cluster.zero.oracle.new_txn()
+        edges = mut.to_edges(rdf.parse(f'<0x1> <age> "{val}" .'), {}, Op.SET)
+        touched, conflict, preds = mut.apply_mutations(
+            cluster.store_of("age"), edges, st.start_ts)
+        cluster.zero.oracle.track(st.start_ts, conflict, sorted(preds))
+        cluster._txn_keys[st.start_ts] = {cluster.group_of("age"): touched}
+        return st.start_ts
+
+    t1, t2 = open_write(71), open_write(72)
+    cluster.commit(t1)
+    with pytest.raises(TxnConflict):
+        cluster.commit(t2)
+
+
+def test_star_delete_spans_groups(cluster):
+    _seed(cluster)
+    # <0x1>=ann has name (one group) and age (the other); S * * must clear both
+    out = cluster.query('{ q(func: eq(name, "ann")) { uid } }')
+    uid = out["q"][0]["uid"]
+    cluster.mutate(del_nquads=f"<{uid}> * * .")
+    out = cluster.query(f'{{ q(func: uid({uid})) {{ name age }} }}')
+    assert out == {}
+
+
+def test_failed_mutation_aborts_oracle_txn(cluster):
+    _seed(cluster)
+    before = cluster.zero.oracle.pending_count()
+    with pytest.raises(Exception):
+        cluster.mutate(set_nquads='<0x1> <age> "not-an-int" .')
+    assert cluster.zero.oracle.pending_count() == before
+    # and a MoveInProgress rejection leaks nothing either (raises pre-txn)
+    cluster.zero.block_writes("age")
+    with pytest.raises(MoveInProgress):
+        cluster.mutate(set_nquads='<0x1> <age> "77" .')
+    cluster.zero.unblock_writes("age")
+    assert cluster.zero.oracle.pending_count() == before
