@@ -105,14 +105,26 @@ class Node:
             uid_typed = entry is None or entry.type_id.name == "UID" or \
                 entry.type_id.name == "DEFAULT"
             for kb in keys:
-                key = K.parse_key(kb)
-                m = max(m, key.uid)
+                m = max(m, K.uid_of(kb))
                 pl = self.store.lists.get(kb)
-                if pl is not None and kind == int(K.KeyKind.DATA) and uid_typed:
-                    u = pl.uids(max(ts, pl.base_ts))
-                    u = u[u < self._SLOT_BITS]
-                    if len(u):
-                        m = max(m, int(u[-1]))
+                if pl is None or kind != int(K.KeyKind.DATA) or not uid_typed:
+                    continue
+                bp = pl.base_packed
+                if not pl.layers and not pl.uncommitted:
+                    # packed metadata already carries the max object uid —
+                    # decoding every list made cold-open O(edges). Slot-tagged
+                    # values (>= _SLOT_BITS) force the slow path: the max
+                    # REAL uid hides below them.
+                    if not bp.nblocks:
+                        continue
+                    last = int(bp.block_last[-1])
+                    if last < self._SLOT_BITS:
+                        m = max(m, last)
+                        continue
+                u = pl.uids(max(ts, pl.base_ts))
+                u = u[u < self._SLOT_BITS]
+                if len(u):
+                    m = max(m, int(u[-1]))
         return m
 
     # -- transactions --------------------------------------------------------

@@ -65,6 +65,7 @@ def _parse_chunk(payload: bytes) -> bytes:
     ~8x faster (the map/reduce handoff of mapper.go is also a flat
     MapEntry stream, not parsed structs)."""
     from dgraph_tpu.query import rdf
+    from dgraph_tpu.utils.types import TypeID, Val
 
     subs, preds, objs, vals, langs, facets, stars = [], [], [], [], [], [], []
     for line in payload.decode("utf-8").splitlines():
@@ -84,6 +85,25 @@ def _parse_chunk(payload: bytes) -> bytes:
                 stars.append(False)
                 continue
             if not line.strip() or line.lstrip().startswith("#"):
+                continue
+        elif "(" not in line and "\\" not in line and line.count('"') == 2:
+            # fast path for plain string literals `<s> <p> "text" .` (no
+            # escapes/lang/type/facets) — the other dominant bulk shape
+            lq = line.index('"')
+            rq = line.rindex('"')
+            head = line[:lq].split()
+            tail = line[rq + 1:].split()
+            if (len(head) == 2 and tail == ["."]
+                    and (head[0][0] == "_"
+                         or (head[0][0] == "<" and head[0][-1] == ">"))
+                    and head[1][0] == "<" and head[1][-1] == ">"):
+                subs.append(head[0][1:-1] if head[0][0] == "<" else head[0])
+                preds.append(head[1][1:-1])
+                objs.append("")
+                vals.append(Val(TypeID.DEFAULT, line[lq + 1:rq]))
+                langs.append("")
+                facets.append(None)
+                stars.append(False)
                 continue
         for q in rdf.parse(line):
             subs.append(q.subject)
@@ -154,11 +174,16 @@ def _group_rows(subs: np.ndarray, objs: np.ndarray):
     object array) per subject — the reduce step's k-way merge, vectorized."""
     order = np.lexsort((objs, subs))
     subs, objs = subs[order], objs[order]
+    # global dedupe on the sorted pairs: per-row np.unique calls dominated
+    # the reduce step at bulk scale
+    if len(subs):
+        keep = np.ones(len(subs), bool)
+        keep[1:] = (subs[1:] != subs[:-1]) | (objs[1:] != objs[:-1])
+        subs, objs = subs[keep], objs[keep]
     uq, starts = np.unique(subs, return_index=True)
     bounds = np.append(starts, len(subs))
     for i, s in enumerate(uq):
-        row = objs[bounds[i]:bounds[i + 1]]
-        yield int(s), np.unique(row)
+        yield int(s), objs[bounds[i]:bounds[i + 1]]
 
 
 def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,

@@ -71,6 +71,18 @@ def schema_key(attr: str) -> Key:
     return Key(KeyKind.SCHEMA, attr)
 
 
+def kind_attr_of(b: bytes) -> tuple[int, str]:
+    """Fast partial parse — just (kind, attr), no Key object. Hot in snapshot
+    load and uid-lease recovery, which touch every key once."""
+    (alen,) = _U32.unpack_from(b, 1)
+    return b[0], b[5: 5 + alen].decode("utf-8")
+
+
+def uid_of(b: bytes) -> int:
+    """Subject/object uid of a DATA/REVERSE key without a full parse."""
+    return _U64.unpack(b[-8:])[0]
+
+
 def parse_key(b: bytes) -> Key:
     """Inverse of Key.encode (reference: x/keys.go:253 Parse)."""
     kind = KeyKind(b[0])

@@ -99,13 +99,18 @@ class _Layer:
     del_all: bool = False
 
 
+# shared immutable empty base: bulk loads create hundreds of thousands of
+# lists, and packing a fresh empty array per list measurably slowed them
+_EMPTY_PACKED = packed.pack(np.zeros(0, dtype=np.uint64))
+
+
 class PostingList:
     """MVCC posting list for one storage key."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self.base_ts: int = 0
-        self.base_packed: packed.PackedUidList = packed.pack(np.zeros(0, dtype=np.uint64))
+        self.base_packed: packed.PackedUidList = _EMPTY_PACKED
         self.base_postings: dict[int, Posting] = {}   # only uids with value/facets
         self.layers: list[_Layer] = []                # sorted by commit_ts
         self.uncommitted: dict[int, _Layer] = {}      # start_ts -> pending layer

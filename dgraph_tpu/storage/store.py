@@ -391,15 +391,20 @@ class Store:
 
     def _write_list(self, f, kb: bytes, pl: PostingList) -> None:
         bp = pl.base_packed
-        postings = json.dumps(
+        postings = b"[]" if not pl.base_postings else json.dumps(
             [posting_to_json(p) for p in pl.base_postings.values()]).encode()
-        f.write(_U32.pack(len(kb)) + kb)
-        f.write(struct.pack("<QI", pl.base_ts, bp.count))
+        parts = [_U32.pack(len(kb)), kb,
+                 struct.pack("<QI", pl.base_ts, bp.count)]
         for arr in (bp.block_first, bp.block_last, bp.block_count,
                     bp.block_width, bp.block_off, bp.words):
             b = arr.tobytes()
-            f.write(_U32.pack(len(b)) + b)
-        f.write(_U32.pack(len(postings)) + postings)
+            parts.append(_U32.pack(len(b)))
+            parts.append(b)
+        parts.append(_U32.pack(len(postings)))
+        parts.append(postings)
+        # one buffered write per list: 9 separate f.write calls per list
+        # dominated checkpoint time at bulk scale
+        f.write(b"".join(parts))
 
     def _load(self) -> None:
         snap = os.path.join(self.dir, "snapshot.bin")
@@ -433,15 +438,18 @@ class Store:
                     off += blen
                 (plen,) = _U32.unpack_from(raw, off)
                 off += 4
-                plist_json = json.loads(raw[off : off + plen])
+                pbody = raw[off : off + plen]
                 off += plen
                 pl = PostingList()
                 pl.base_ts = base_ts
                 pl.base_packed = packed.PackedUidList(count, *arrs)
-                pl.base_postings = {p.uid: p for p in map(posting_from_json, plist_json)}
-                key = K.parse_key(kb)
+                if pbody != b"[]":   # uid-only lists skip the json machinery
+                    pl.base_postings = {
+                        p.uid: p
+                        for p in map(posting_from_json, json.loads(pbody))}
+                kind, attr = K.kind_attr_of(kb)
                 self.lists[kb] = pl
-                self.by_pred.setdefault((int(key.kind), key.attr), set()).add(kb)
+                self.by_pred.setdefault((kind, attr), set()).add(kb)
         self._replay_wal(os.path.join(self.dir, "wal.log"))
 
     def close(self) -> None:
