@@ -24,10 +24,16 @@ already the wire format a gRPC/DCN transport would carry.
 
 from __future__ import annotations
 
+import json
 import os
 import struct
+import threading
 
 from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import dql
+from dgraph_tpu.query.engine import Executor
+from dgraph_tpu.storage.csr_build import build_snapshot
+from dgraph_tpu.storage.store import Store
 
 _U32 = struct.Struct("<I")
 
@@ -48,6 +54,7 @@ class _Member:
         self.dir = dirpath
         os.makedirs(dirpath, exist_ok=True)
         self.alive = True
+        self.reader: "FollowerReader | None" = None
         self._wal = None
 
     # -- term fencing --------------------------------------------------------
@@ -87,19 +94,75 @@ class _Member:
             return 0
 
 
+class FollowerReader:
+    """A read replica: an in-memory Store that live-applies shipped WAL
+    records, serving (slightly stale) snapshot reads for hedging
+    (worker/draft.go applies committed entries to follower state the same
+    way; worker/task.go:75-132 reads from it on backup requests)."""
+
+    def __init__(self, dirpath: str | None = None) -> None:
+        # memory-only going forward: the member's file WAL is the durability
+        # story, the reader just mirrors state. An existing replica dir seeds
+        # the mirror (rejoin / restart), then the file handles detach so the
+        # member's own appends stay the only writer.
+        if dirpath and (os.path.exists(os.path.join(dirpath, "snapshot.bin"))
+                        or os.path.exists(os.path.join(dirpath, "wal.log"))):
+            s = Store(dirpath)
+            if s._wal is not None:
+                s._wal.close()
+                s._wal = None
+            s.dir = None
+            self.store = s
+        else:
+            self.store = Store()
+        self._lock = threading.Lock()
+        self._snap = None
+        self._snap_version = -1
+        # bumped per applied record: max_seen_commit_ts alone misses
+        # schema/drop records, which must also invalidate the cache
+        self._version = 0
+
+    def apply(self, data: bytes) -> None:
+        with self._lock:
+            self.store.apply_record(json.loads(data))
+            self._version += 1
+
+    def query(self, q: str, variables: dict | None = None) -> dict:
+        # capture state under the lock, build OUTSIDE it: the leader's
+        # synchronous ship path blocks on this lock, so holding it across a
+        # full snapshot build would stall every commit for the rebuild
+        with self._lock:
+            ver = self._version
+            ts = self.store.max_seen_commit_ts
+            snap = self._snap if self._snap_version == ver else None
+        if snap is None:
+            snap = build_snapshot(self.store, read_ts=ts + 1)
+            with self._lock:
+                if self._snap_version < ver or self._snap is None:
+                    self._snap, self._snap_version = snap, ver
+        return Executor(snap, self.store.schema).execute(
+            dql.parse(q, variables))
+
+
 class ReplicaGroup:
     """A leader Node plus follower replicas with synchronous quorum shipping."""
 
-    def __init__(self, base_dir: str, n: int = 3) -> None:
+    def __init__(self, base_dir: str, n: int = 3,
+                 serve_reads: bool = False) -> None:
         if n < 1:
             raise ValueError("need n >= 1 replicas")
         self.n = n
         self.term = 1
+        self.serve_reads = serve_reads
         self.members = [_Member(i, os.path.join(base_dir, f"replica{i}"))
                         for i in range(n)]
         for m in self.members:
             m.set_term(self.term)
         self.leader_id = 0
+        self.hedged_reads = 0
+        if serve_reads:
+            for m in self._followers_of(0):
+                m.reader = FollowerReader(m.dir)
         self.node: Node = self._open_leader()
 
     # -- leadership ----------------------------------------------------------
@@ -109,7 +172,10 @@ class ReplicaGroup:
         return self.n // 2 + 1
 
     def _followers(self) -> list[_Member]:
-        return [m for m in self.members if m.id != self.leader_id]
+        return self._followers_of(self.leader_id)
+
+    def _followers_of(self, leader_id: int) -> list[_Member]:
+        return [m for m in self.members if m.id != leader_id]
 
     def _open_leader(self) -> Node:
         node = Node(self.members[self.leader_id].dir)
@@ -133,6 +199,51 @@ class ReplicaGroup:
                 f"{len(live) + 1}/{self.n} acks < quorum {self.quorum}")
         for m in live:
             m.append(data, sync)
+            if m.reader is not None:
+                m.reader.apply(data)
+
+    # -- hedged reads --------------------------------------------------------
+
+    def read(self, q: str, variables: dict | None = None,
+             hedge_after: float = 0.05) -> tuple[str, dict]:
+        """Backup-request read (worker/task.go:75-132): ask the leader; when
+        it hasn't answered within hedge_after seconds — or is dead — race a
+        live follower reader; the first answer wins. Returns
+        ("leader" | "followerN", result). Follower answers reflect the
+        quorum-acked prefix (read-your-quorum, possibly a beat behind the
+        leader's unacked tail — the same staleness contract as the
+        reference's best-effort backup reads)."""
+        result: list[tuple[str, dict]] = []
+        errs: list[Exception] = []
+        done = threading.Event()
+        leader = self.members[self.leader_id]
+        leader_asked = leader.alive
+        if leader.alive:
+            def from_leader():
+                try:
+                    out, _ = self.node.query(q, variables)
+                    result.append(("leader", out))
+                except Exception as e:   # noqa: BLE001 — raced result decides
+                    errs.append(e)
+                finally:
+                    done.set()
+            threading.Thread(target=from_leader, daemon=True).start()
+            done.wait(hedge_after)
+            if result:
+                return result[0]
+        self.hedged_reads += 1
+        for m in self._followers():
+            if m.alive and m.reader is not None:
+                out = m.reader.query(q, variables)
+                return result[0] if result else (f"follower{m.id}", out)
+        if not leader_asked:
+            # dead leader AND no follower reader: nothing will ever answer
+            raise NoQuorum("no live member can serve reads")
+        # no follower reader available: block on the leader after all
+        done.wait()
+        if result:
+            return result[0]
+        raise errs[0] if errs else NoQuorum("no live member can serve reads")
 
     # -- failures ------------------------------------------------------------
 
@@ -155,6 +266,7 @@ class ReplicaGroup:
             x.set_term(self.term)
         self.leader_id = new_leader.id
         new_leader.close()
+        new_leader.reader = None      # leaders serve reads directly
         self.node = self._open_leader()
 
     def rejoin(self, member_id: int) -> None:
@@ -171,6 +283,8 @@ class ReplicaGroup:
         self.node.store.clone_to(m.dir)
         m.set_term(self.term)
         m.alive = True
+        if self.serve_reads:
+            m.reader = FollowerReader(m.dir)   # reseed from the fresh clone
 
     def close(self) -> None:
         self.node.close()

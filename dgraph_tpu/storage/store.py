@@ -291,41 +291,46 @@ class Store:
             off += 4
             if off + n > len(raw):
                 break  # torn tail write — ignore (crash mid-append)
-            rec = json.loads(raw[off : off + n])
+            self.apply_record(json.loads(raw[off : off + n]))
             off += n
-            t = rec["t"]
-            if t == "m":
-                key = K.parse_key(base64.b64decode(rec["k"]))
-                self.get(key).add_mutation(rec["s"], posting_from_json(rec["p"]))
-                self.dirty.add(key.encode())
-            elif t == "c":
-                for kb64 in rec["k"]:
-                    kb = base64.b64decode(kb64)
-                    self._bump_pred_ts(kb, rec["ts"])
-                    pl = self.lists.get(kb)
-                    if pl is None:
-                        continue
-                    if rec["ts"] <= self.snapshot_ts:
-                        # already folded into the snapshot base (crash between
-                        # snapshot replace and WAL truncation): replaying would
-                        # double-apply — notably DEL_ALL — on the rolled-up base
-                        pl.abort(rec["s"])
-                    else:
-                        pl.commit(rec["s"], rec["ts"])
-                self.max_seen_commit_ts = max(self.max_seen_commit_ts, rec["ts"])
-            elif t == "a":
-                for kb64 in rec["k"]:
-                    kb = base64.b64decode(kb64)
-                    pl = self.lists.get(kb)
-                    if pl is not None:
-                        pl.abort(rec["s"])
-            elif t == "s":
-                for e in parse_schema(rec["line"]):
-                    self.schema.set(e)
-            elif t == "dp":
-                self._delete_predicate_mem(rec["attr"])
-            elif t == "dk":
-                self._drop_kind_mem(rec["attr"], K.KeyKind(rec["kind"]))
+
+    def apply_record(self, rec: dict) -> None:
+        """Apply one WAL record to in-memory state — replay on restart, and
+        the follower-side live apply when records arrive over replication
+        (worker/draft.go:485-624 applies committed entries the same way)."""
+        t = rec["t"]
+        if t == "m":
+            key = K.parse_key(base64.b64decode(rec["k"]))
+            self.get(key).add_mutation(rec["s"], posting_from_json(rec["p"]))
+            self.dirty.add(key.encode())
+        elif t == "c":
+            for kb64 in rec["k"]:
+                kb = base64.b64decode(kb64)
+                self._bump_pred_ts(kb, rec["ts"])
+                pl = self.lists.get(kb)
+                if pl is None:
+                    continue
+                if rec["ts"] <= self.snapshot_ts:
+                    # already folded into the snapshot base (crash between
+                    # snapshot replace and WAL truncation): replaying would
+                    # double-apply — notably DEL_ALL — on the rolled-up base
+                    pl.abort(rec["s"])
+                else:
+                    pl.commit(rec["s"], rec["ts"])
+            self.max_seen_commit_ts = max(self.max_seen_commit_ts, rec["ts"])
+        elif t == "a":
+            for kb64 in rec["k"]:
+                kb = base64.b64decode(kb64)
+                pl = self.lists.get(kb)
+                if pl is not None:
+                    pl.abort(rec["s"])
+        elif t == "s":
+            for e in parse_schema(rec["line"]):
+                self.schema.set(e)
+        elif t == "dp":
+            self._delete_predicate_mem(rec["attr"])
+        elif t == "dk":
+            self._drop_kind_mem(rec["attr"], K.KeyKind(rec["kind"]))
 
     # -- snapshot / checkpoint ---------------------------------------------
 

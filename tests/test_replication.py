@@ -125,3 +125,94 @@ def test_single_replica_degenerate(tmp_path):
     _seed_bank(g.node)
     assert sum(_balances(g.node).values()) == N_ACCOUNTS * START
     g.close()
+
+
+# -- hedged reads (worker/task.go:75-132 backup requests) --------------------
+
+def _mk_read_group(tmp_path, n=3):
+    from dgraph_tpu.coord.replication import ReplicaGroup
+    g = ReplicaGroup(str(tmp_path / "grp"), n=n, serve_reads=True)
+    g.node.alter(schema_text="name: string @index(exact) .\nbal: int .")
+    g.node.mutate(set_nquads='_:a <name> "hedge" .\n_:a <bal> "10" .',
+                  commit_now=True)
+    return g
+
+
+def test_fast_leader_serves_read(tmp_path):
+    g = _mk_read_group(tmp_path)
+    g.node.query('{ q(func: eq(name, "hedge")) { bal } }')  # warm the snapshot
+    src, out = g.read('{ q(func: eq(name, "hedge")) { bal } }', hedge_after=5)
+    assert src == "leader" and out["q"][0]["bal"] == 10
+    assert g.hedged_reads == 0
+    g.close()
+
+
+def test_slow_leader_hedges_to_follower(tmp_path):
+    import time as _time
+    g = _mk_read_group(tmp_path)
+    real_query = g.node.query
+
+    def slow_query(*a, **kw):
+        _time.sleep(0.5)
+        return real_query(*a, **kw)
+
+    g.node.query = slow_query
+    t0 = _time.perf_counter()
+    src, out = g.read('{ q(func: eq(name, "hedge")) { bal } }',
+                      hedge_after=0.02)
+    dt = _time.perf_counter() - t0
+    assert src.startswith("follower")
+    assert out["q"][0]["bal"] == 10       # quorum-acked data is visible
+    assert dt < 0.45                      # did not wait for the slow leader
+    assert g.hedged_reads == 1
+    g.close()
+
+
+def test_dead_leader_read_from_follower(tmp_path):
+    g = _mk_read_group(tmp_path)
+    # mark dead WITHOUT failover (the window before election completes)
+    g.members[g.leader_id].alive = False
+    src, out = g.read('{ q(func: eq(name, "hedge")) { bal } }')
+    assert src.startswith("follower")
+    assert out["q"][0]["bal"] == 10
+    g.close()
+
+
+def test_follower_reader_tracks_new_commits(tmp_path):
+    g = _mk_read_group(tmp_path)
+    g.node.mutate(set_nquads='_:b <name> "late" .', commit_now=True)
+    fid = next(m.id for m in g._followers() if m.reader is not None)
+    out = g.members[fid].reader.query('{ q(func: eq(name, "late")) { name } }')
+    assert out == {"q": [{"name": "late"}]}
+    g.close()
+
+
+def test_rejoined_member_reader_reseeds(tmp_path):
+    g = _mk_read_group(tmp_path)
+    victim = next(m.id for m in g._followers())
+    g.kill(victim)
+    g.node.mutate(set_nquads='_:c <name> "while-dead" .', commit_now=True)
+    g.rejoin(victim)
+    out = g.members[victim].reader.query(
+        '{ q(func: eq(name, "while-dead")) { name } }')
+    assert out == {"q": [{"name": "while-dead"}]}
+    g.close()
+
+
+def test_read_raises_when_nothing_can_serve(tmp_path):
+    from dgraph_tpu.coord.replication import NoQuorum, ReplicaGroup
+    g = ReplicaGroup(str(tmp_path / "g2"), n=3)   # serve_reads=False
+    g.members[g.leader_id].alive = False
+    with pytest.raises(NoQuorum):
+        g.read("{ q(func: has(name)) { name } }")
+    g.close()
+
+
+def test_follower_sees_shipped_predicate_drop(tmp_path):
+    g = _mk_read_group(tmp_path)
+    fid = next(m.id for m in g._followers() if m.reader is not None)
+    rd = g.members[fid].reader
+    assert rd.query('{ q(func: has(name)) { name } }')["q"]
+    g.node.store.delete_predicate("name")   # ships a "dp" record
+    assert rd.query('{ q(func: has(name)) { name } }') == {}
+    g.close()
