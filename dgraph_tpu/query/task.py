@@ -468,12 +468,23 @@ def _regexp_func(pd: PredData, schema, pattern: str, flags: str) -> np.ndarray:
             return empty
         raise TaskError(f"predicate {pd.attr} needs @index(trigram)")
     rx = remod.compile(pattern, remod.IGNORECASE if "i" in flags else 0)
-    # candidate trigrams: any literal 3-gram required by the pattern; fall back
-    # to scanning every indexed uid when the pattern has no required literal.
-    # Case-insensitive patterns can't prune by literal trigrams (the index
-    # stores raw-case trigrams), so they take the full-scan path.
-    literals = _required_trigrams(pattern) if "i" not in flags else []
-    if literals:
+    # candidate trigrams: any literal 3-gram required by the pattern; fall
+    # back to scanning every indexed uid when the pattern has no required
+    # literal. Case-insensitive patterns prune by the union of each required
+    # trigram's 2^3 case variants (the index stores raw-case trigrams) —
+    # codesearch's case-folded query expansion, not a full scan.
+    literals = _required_trigrams(pattern)
+    if literals and "i" in flags:
+        cands = None
+        for t in literals:
+            rows = [r for v in _case_variants(t)
+                    if (r := ti.term_row(v.encode())) >= 0]
+            uids = _index_uids_for_rows(ti, rows)
+            cands = uids if cands is None else us.intersect_host(cands, uids)
+            if not len(cands):
+                break
+        cands = cands if cands is not None else np.zeros(0, np.int64)
+    elif literals:
         rows = [r for t in literals if (r := ti.term_row(t.encode())) >= 0]
         cands = _index_uids_intersect_rows(ti, rows) if rows and len(rows) == len(literals) \
             else _index_uids_for_rows(ti, rows)
@@ -491,9 +502,26 @@ def _regexp_func(pd: PredData, schema, pattern: str, flags: str) -> np.ndarray:
     return np.asarray(keep, dtype=np.int64)
 
 
+def _case_variants(tri: str) -> list[str]:
+    """All case spellings of one trigram (8 for pure-alpha)."""
+    out = [""]
+    for c in tri:
+        if c.lower() != c.upper():
+            out = [p + v for p in out for v in (c.lower(), c.upper())]
+        else:
+            out = [p + c for p in out]
+    return out
+
+
 def _required_trigrams(pattern: str) -> list[str]:
     """Literal trigrams that every match must contain (simplified codesearch
-    query planning): longest literal run outside character classes/operators."""
+    query planning): longest literal run outside character classes/operators.
+
+    Alternation (`a|b`), groups (`(ab)?` can make a whole run optional), and
+    counted repeats (`b{0,3}`) mean no single run is required — those
+    patterns fall back to the unpruned scan rather than risk dropping
+    matches (the reference's planner builds per-branch OR queries here,
+    worker/trigram.go + codesearch index/regexp)."""
     runs, cur = [], []
     escaped = False
     for c in pattern:
@@ -502,8 +530,10 @@ def _required_trigrams(pattern: str) -> list[str]:
             escaped = False
         elif c == "\\":
             escaped = True
-        elif c in ".*+?()[]{}|^$":
-            if c in "*?|":  # preceding char is optional/alternated — drop it
+        elif c in "(|{":
+            return []
+        elif c in ".*+?)[]}^$":
+            if c in "*?":   # preceding char is optional — drop it
                 if cur:
                     cur.pop()
             runs.append("".join(cur))

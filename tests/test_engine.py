@@ -368,3 +368,22 @@ def test_facet_eq_list_form(env):
 def test_ineq_missing_rhs_errors(env):
     with pytest.raises(Exception):
         run(env, '{ q(func: lt(age)) { name } }')
+
+
+def test_regexp_case_insensitive():
+    # values store raw-case trigrams; /rick/i must still find "Rick Grimes"
+    # through the case-variant trigram probe (not a full scan)
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="name: string @index(trigram) .")
+    n.mutate(set_nquads="""
+        _:a <name> "Rick Grimes" .
+        _:b <name> "GLENN RHEE" .
+        _:c <name> "daryl dixon" .
+    """, commit_now=True)
+    out, _ = n.query('{ q(func: regexp(name, /rick/i)) { name } }')
+    assert [x["name"] for x in out["q"]] == ["Rick Grimes"]
+    out, _ = n.query('{ q(func: regexp(name, /GRIMES|rhee/i)) { name } }')
+    assert {x["name"] for x in out["q"]} == {"Rick Grimes", "GLENN RHEE"}
+    out, _ = n.query('{ q(func: regexp(name, /dixon$/i)) { name } }')
+    assert [x["name"] for x in out["q"]] == ["daryl dixon"]

@@ -168,3 +168,22 @@ def test_first_truncation(snap_env):
 def test_missing_index_errors(snap_env):
     with pytest.raises(TaskError, match="needs @index"):
         run(snap_env, TaskQuery("bio", func=("eq", ["x"])))
+
+
+def test_case_insensitive_regexp_uses_trigram_pruning():
+    """/pat/i prunes candidates via case-variant trigram probes instead of a
+    full index scan (codesearch case-folded query expansion)."""
+    from dgraph_tpu.query.task import _case_variants, _required_trigrams
+    assert set(_case_variants("ab1")) == {"ab1", "Ab1", "aB1", "AB1"}
+    assert _required_trigrams("RiCk") == ["RiC", "iCk"]
+
+
+def test_required_trigrams_alternation_groups_unsafe():
+    """Patterns where no literal is required must return [] (full scan),
+    never a branch literal that would drop other branches' matches."""
+    from dgraph_tpu.query.task import _required_trigrams
+    assert _required_trigrams("GRIMES|rhee") == []
+    assert _required_trigrams("(abc)?def") == []
+    assert _required_trigrams("ab{0,3}cde") == []
+    assert _required_trigrams("film 1. of") == ["fil", "ilm", "lm ", "m 1"]
+    assert _required_trigrams("rick") == ["ric", "ick"]
