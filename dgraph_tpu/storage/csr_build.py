@@ -215,7 +215,7 @@ def build_pred(store: Store, attr: str, read_ts: int,
     kbs = store.keys_of(K.KeyKind.DATA, attr)
     tablet_uids = _tablet_uids(store, kbs, read_ts, own)
     for kb, u in zip(kbs, tablet_uids):
-        key = K.parse_key(kb)
+        subj = K.uid_of(kb)        # DATA key: partial parse, hot loop
         pl = store.lists[kb]
         live = pl.live_map(read_ts, own_start_ts=own)
         # type heuristic for untyped predicates probes ANY value ("." tag);
@@ -223,30 +223,30 @@ def build_pred(store: Store, attr: str, read_ts: int,
         has_value = any(p.value is not None for p in live.values())
         if tid == TypeID.UID or (tid == TypeID.DEFAULT and not has_value):
             if len(u):
-                fwd_rows.append((key.uid, u))
+                fwd_rows.append((subj, u))
             for p in live.values():
                 if p.facets:
-                    pd.facets[(key.uid, p.uid)] = p.facets
+                    pd.facets[(subj, p.uid)] = p.facets
         else:
             p0 = live.get(VALUE_UID)
             v = p0.value if p0 is not None else None
             if v is not None:
-                pd.host_values[key.uid] = v
-                val_subjects.append(key.uid)
+                pd.host_values[subj] = v
+                val_subjects.append(subj)
                 s = to_device_scalar(v)
                 num_vals.append(np.nan if s is None else float(s))
             # language-tagged values
             had_lang = False
             for p in live.values():
                 if p.value is not None and p.lang:
-                    pd.lang_values.setdefault(key.uid, {})[p.lang] = p.value
+                    pd.lang_values.setdefault(subj, {})[p.lang] = p.value
                     had_lang = True
                 if p.facets:
-                    pd.facets[(key.uid, p.uid)] = p.facets
+                    pd.facets[(subj, p.uid)] = p.facets
             if v is None and had_lang:
                 # lang-only node: still a has(attr) subject (the reference's
                 # data key exists), but carries no untagged value
-                val_subjects.append(key.uid)
+                val_subjects.append(subj)
                 num_vals.append(np.nan)
     if fwd_rows:
         pd.csr = _csr_from_rows(fwd_rows)
@@ -266,7 +266,7 @@ def build_pred(store: Store, attr: str, read_ts: int,
         rev_rows = []
         for kb, u in zip(rkbs, _tablet_uids(store, rkbs, read_ts, own)):
             if len(u):
-                rev_rows.append((K.parse_key(kb).uid, u))
+                rev_rows.append((K.uid_of(kb), u))
         if rev_rows:
             pd.rev_csr = _csr_from_rows(rev_rows)
 

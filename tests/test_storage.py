@@ -294,3 +294,45 @@ def test_rebuild_survives_replay(tmp_path):
     assert 1 not in s2.get(K.count_key("friend", 1)).uids(7).tolist()
     np.testing.assert_array_equal(s2.get(K.count_key("friend", 2)).uids(7), [1])
     s2.close()
+
+
+def test_v1_snapshot_still_loads(tmp_path):
+    """Snapshots written by the pre-columnar DGTS1 row format must keep
+    loading (frozen format; the writer moved to DGTS2)."""
+    import json as _json
+    import struct as _struct
+
+    import numpy as _np
+
+    from dgraph_tpu.storage import keys as _K
+    from dgraph_tpu.storage import packed as _packed
+    from dgraph_tpu.storage.store import Store as _Store
+    _u32 = _struct.Struct("<I")
+
+    uids = _np.array([3, 7, 9], dtype=_np.uint64)
+    bp = _packed.pack(uids)
+    kb = _K.data_key("name", 1).encode()
+    d = tmp_path / "v1store"
+    d.mkdir()
+    with open(d / "snapshot.bin", "wb") as f:
+        f.write(b"DGTS1")
+        f.write(_struct.pack("<Q", 5))
+        meta = _json.dumps({"schema": "name: uid .", "max_commit_ts": 5}).encode()
+        f.write(_u32.pack(len(meta)) + meta)
+        f.write(_u32.pack(len(kb)) + kb)
+        f.write(_struct.pack("<QI", 5, bp.count))
+        for arr in (bp.block_first, bp.block_last, bp.block_count,
+                    bp.block_width, bp.block_off, bp.words):
+            b = arr.tobytes()
+            f.write(_u32.pack(len(b)) + b)
+        f.write(_u32.pack(2) + b"[]")
+    s = _Store(str(d))
+    _np.testing.assert_array_equal(s.lists[kb].uids(5), [3, 7, 9])
+    # and the next checkpoint upgrades it to v2 transparently
+    s.checkpoint(5)
+    s.close()
+    with open(d / "snapshot.bin", "rb") as f:
+        assert f.read(5) == b"DGTS2"
+    s2 = _Store(str(d))
+    _np.testing.assert_array_equal(s2.lists[kb].uids(5), [3, 7, 9])
+    s2.close()
