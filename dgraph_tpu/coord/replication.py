@@ -160,6 +160,11 @@ class ReplicaGroup:
             m.set_term(self.term)
         self.leader_id = 0
         self.hedged_reads = 0
+        # fault injection hook (test/ops surface): called per (member,
+        # record) before a follower append; raising simulates a transport
+        # fault for that member — it stops counting toward the quorum.
+        # Reference analog: conn/pool Echo health failures.
+        self.fault_hook = None
         if serve_reads:
             for m in self._followers_of(0):
                 m.reader = FollowerReader(m.dir)
@@ -188,12 +193,24 @@ class ReplicaGroup:
 
         Quorum feasibility and term fencing are checked for EVERY live
         follower before any append, so a rejected ship leaves no follower
-        holding a record the leader never wrote."""
+        holding a record the leader never wrote. A member whose transport
+        faults (fault_hook raising) is marked dead — the failure-detection
+        path — and the quorum re-checked before anything is appended. Term
+        fencing runs FIRST, over every live member: a higher-term member
+        deposes this leader even if its transport is currently faulty."""
         live = [m for m in self._followers() if m.alive]
         for m in live:
             if m.term > self.term:
                 raise StaleLeader(
                     f"member {m.id} is at term {m.term} > {self.term}")
+        if self.fault_hook is not None:
+            for m in list(live):
+                try:
+                    self.fault_hook(m, data)
+                except Exception:
+                    m.alive = False      # detected failure: stop counting it
+                    m.close()
+                    live.remove(m)
         if len(live) + 1 < self.quorum:
             raise NoQuorum(
                 f"{len(live) + 1}/{self.n} acks < quorum {self.quorum}")

@@ -35,7 +35,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from dgraph_tpu.storage import keys as K
-from dgraph_tpu.storage.postings import VALUE_UID
+from dgraph_tpu.storage.postings import VALUE_UID, PostingList
 from dgraph_tpu.storage.store import Store
 from dgraph_tpu.utils.types import TypeID, Val, to_device_scalar
 
@@ -178,7 +178,9 @@ def _tablet_uids(store: Store, kbs: list[bytes], read_ts: int,
     """uids() for every key of a tablet, batching pure-base lists through one
     vectorized decode (packed.unpack_many) — per-list numpy overhead
     dominates a 100k-list snapshot build otherwise."""
-    pls = [store.lists[kb] for kb in kbs]
+    # .get: a predicate dropped mid-build (follower live-apply) reads as
+    # empty rather than KeyError; the reader's version bump rebuilds after
+    pls = [store.lists.get(kb) or PostingList() for kb in kbs]
     out: list[np.ndarray | None] = [None] * len(pls)
     batch_idx: list[int] = []
     for i, pl in enumerate(pls):
@@ -216,7 +218,9 @@ def build_pred(store: Store, attr: str, read_ts: int,
     tablet_uids = _tablet_uids(store, kbs, read_ts, own)
     for kb, u in zip(kbs, tablet_uids):
         subj = K.uid_of(kb)        # DATA key: partial parse, hot loop
-        pl = store.lists[kb]
+        pl = store.lists.get(kb)
+        if pl is None:             # predicate dropped mid-build (follower
+            continue               # live-apply); version bump rebuilds
         live = pl.live_map(read_ts, own_start_ts=own)
         # type heuristic for untyped predicates probes ANY value ("." tag);
         # host_values below still reads only the untagged slot

@@ -36,6 +36,7 @@ from dgraph_tpu.storage import keys as K
 from dgraph_tpu.storage import packed
 from dgraph_tpu.storage.postings import Op, Posting, PostingList
 from dgraph_tpu.utils.schema import SchemaEntry, SchemaState, parse_schema
+from dgraph_tpu.utils.sync import SafeLock
 from dgraph_tpu.utils.types import TypeID, Val, marshal, unmarshal
 
 _U32 = struct.Struct("<I")
@@ -85,7 +86,7 @@ class Store:
         self.by_pred: dict[tuple[int, str], set[bytes]] = {}
         self.schema = SchemaState()
         self.dirty: set[bytes] = set()
-        self._lock = threading.RLock()
+        self._lock = SafeLock()   # lock-discipline asserts: utils/sync.py
         self._wal: io.BufferedWriter | None = None
         self.max_seen_commit_ts = 0
         # attr -> highest commit_ts of any commit touching it: the dirty
@@ -157,6 +158,7 @@ class Store:
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, commit_ts)
 
     def _bump_pred_ts(self, kb: bytes, commit_ts: int) -> None:
+        self._lock.assert_held()   # caller owns the commit critical section
         attr = K.parse_key(kb).attr
         if commit_ts > self.pred_commit_ts.get(attr, 0):
             self.pred_commit_ts[attr] = commit_ts
@@ -298,6 +300,10 @@ class Store:
         """Apply one WAL record to in-memory state — replay on restart, and
         the follower-side live apply when records arrive over replication
         (worker/draft.go:485-624 applies committed entries the same way)."""
+        with self._lock:
+            self._apply_record_locked(rec)
+
+    def _apply_record_locked(self, rec: dict) -> None:
         t = rec["t"]
         if t == "m":
             key = K.parse_key(base64.b64decode(rec["k"]))

@@ -1,0 +1,72 @@
+"""Failure detection and fault injection (SURVEY §5): transport faults on
+the replication ship path mark members dead, quorum math reacts, recovery
+works through the normal rejoin path. Reference analog: conn/pool.go
+Echo-based health checks + Raft CheckQuorum."""
+
+import pytest
+
+from dgraph_tpu.coord.replication import NoQuorum, ReplicaGroup
+
+
+def _mk(tmp_path, n=3):
+    g = ReplicaGroup(str(tmp_path / "fg"), n=n)
+    g.node.alter(schema_text="v: int .")
+    g.node.mutate(set_nquads='<0x1> <v> "1"^^<xs:int> .', commit_now=True)
+    return g
+
+
+def test_transport_fault_marks_member_dead(tmp_path):
+    g = _mk(tmp_path)
+    victim = next(m for m in g._followers())
+
+    def flaky(m, data):
+        if m.id == victim.id:
+            raise IOError("injected transport fault")
+
+    g.fault_hook = flaky
+    # write still succeeds: 2/3 quorum without the faulty member
+    g.node.mutate(set_nquads='<0x1> <v> "2"^^<xs:int> .', commit_now=True)
+    assert not victim.alive
+    g.fault_hook = None
+    g.close()
+
+
+def test_all_followers_faulty_blocks_commit(tmp_path):
+    g = _mk(tmp_path)
+    g.fault_hook = lambda m, data: (_ for _ in ()).throw(IOError("down"))
+    with pytest.raises(NoQuorum):
+        g.node.mutate(set_nquads='<0x1> <v> "3"^^<xs:int> .', commit_now=True)
+    g.fault_hook = None
+    g.close()
+
+
+def test_faulted_member_recovers_via_rejoin(tmp_path):
+    g = _mk(tmp_path)
+    victim = next(m for m in g._followers())
+    g.fault_hook = lambda m, data: (_ for _ in ()).throw(
+        IOError("x")) if m.id == victim.id else None
+    g.node.mutate(set_nquads='<0x1> <v> "4"^^<xs:int> .', commit_now=True)
+    assert not victim.alive
+    g.fault_hook = None
+    g.node.mutate(set_nquads='<0x1> <v> "5"^^<xs:int> .', commit_now=True)
+    g.rejoin(victim.id)
+    # rejoined member can now be promoted with full state
+    g.kill(g.leader_id)
+    out, _ = g.node.query('{ q(func: uid(0x1)) { v } }')
+    assert out["q"][0]["v"] == 5
+    g.close()
+
+
+def test_no_partial_append_on_rejected_ship(tmp_path):
+    """A NoQuorum rejection must leave no follower holding a record the
+    leader never wrote (atomicity of the ship)."""
+    g = _mk(tmp_path)
+    lens_before = {m.id: m.wal_len() for m in g._followers()}
+    # both followers fault on the NEXT ship
+    g.fault_hook = lambda m, data: (_ for _ in ()).throw(IOError("gone"))
+    with pytest.raises(NoQuorum):
+        g.node.mutate(set_nquads='<0x1> <v> "9"^^<xs:int> .', commit_now=True)
+    g.fault_hook = None
+    for m in g._followers():
+        assert m.wal_len() == lens_before[m.id]
+    g.close()
