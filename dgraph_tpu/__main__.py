@@ -107,6 +107,15 @@ def cmd_live(args) -> int:
     return 0
 
 
+def cmd_convert(args) -> int:
+    from dgraph_tpu.loader.convert import convert_geojson
+
+    stats = convert_geojson(args.geo, args.out, geopred=args.geopred)
+    print(f"convert: {stats.features} features -> {stats.triples} triples "
+          f"-> {args.out}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dgraph_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -150,6 +159,13 @@ def main(argv=None) -> int:
                          "discarded at exit)")
     lp.add_argument("--batch", type=int, default=1000)
     lp.set_defaults(fn=cmd_live)
+
+    cp = sub.add_parser("convert", help="GeoJSON -> RDF (.rdf.gz)")
+    cp.add_argument("--geo", required=True, help="GeoJSON file (optionally .gz)")
+    cp.add_argument("--out", default="output.rdf.gz")
+    cp.add_argument("--geopred", default="loc",
+                    help="predicate for geometries")
+    cp.set_defaults(fn=cmd_convert)
 
     args = p.parse_args(argv)
     return args.fn(args)

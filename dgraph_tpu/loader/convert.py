@@ -1,0 +1,55 @@
+"""GeoJSON → RDF converter (reference: dgraph/cmd/dgraph-converter/main.go
+— reads a GeoJSON FeatureCollection, emits one blank node per feature with
+the geometry as a geo:geojson literal plus each property as a value triple).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from dataclasses import dataclass
+
+
+@dataclass
+class ConvertStats:
+    features: int = 0
+    triples: int = 0
+
+
+def _esc(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def convert_geojson(geo_path: str, out_path: str,
+                    geopred: str = "loc") -> ConvertStats:
+    op = gzip.open if geo_path.endswith(".gz") else open
+    with op(geo_path, "rt", encoding="utf-8") as f:
+        doc = json.load(f)
+    feats = doc.get("features", []) if doc.get("type") == "FeatureCollection" \
+        else [doc]
+    stats = ConvertStats()
+    with gzip.open(out_path, "wt", encoding="utf-8") as out:
+        for i, feat in enumerate(feats):
+            geom = feat.get("geometry")
+            if not geom:
+                continue
+            node = f"_:f{i}"
+            out.write(f'{node} <{geopred}> '
+                      f'"{_esc(json.dumps(geom, separators=(",", ":")))}"'
+                      f'^^<geo:geojson> .\n')
+            stats.triples += 1
+            for k, v in (feat.get("properties") or {}).items():
+                if v is None:
+                    continue
+                if isinstance(v, bool):
+                    lit = f'"{str(v).lower()}"^^<xs:boolean>'
+                elif isinstance(v, int):
+                    lit = f'"{v}"^^<xs:int>'
+                elif isinstance(v, float):
+                    lit = f'"{v}"^^<xs:float>'
+                else:
+                    lit = f'"{_esc(str(v))}"'
+                out.write(f"{node} <{k}> {lit} .\n")
+                stats.triples += 1
+            stats.features += 1
+    return stats

@@ -228,3 +228,35 @@ def test_bulk_mixed_uid_and_value_predicate_clear_error(tmp_path):
     p = _write(str(tmp_path), '_:a <p> _:b .\n_:a <p> "hello" .\n')
     with pytest.raises(BulkError, match="both uid edges and literal"):
         bulk_load(p, "", os.path.join(str(tmp_path), "o"), workers=1)
+
+
+def test_geojson_convert_roundtrip(tmp_path):
+    """convert: GeoJSON features -> RDF, loadable and geo-queryable
+    (reference dgraph/cmd/dgraph-converter/main.go)."""
+    import json as _json
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.loader.convert import convert_geojson
+    from dgraph_tpu.loader.live import live_load
+
+    geo = tmp_path / "cities.json"
+    geo.write_text(_json.dumps({"type": "FeatureCollection", "features": [
+        {"type": "Feature",
+         "geometry": {"type": "Point", "coordinates": [-122.42, 37.77]},
+         "properties": {"name": "SF", "pop": 880000, "coastal": True}},
+        {"type": "Feature",
+         "geometry": {"type": "Point", "coordinates": [2.35, 48.85]},
+         "properties": {"name": "Paris", "pop": 2140000}},
+        {"type": "Feature", "geometry": None, "properties": {"name": "skip"}},
+    ]}))
+    out = tmp_path / "cities.rdf.gz"
+    stats = convert_geojson(str(geo), str(out))
+    assert stats.features == 2 and stats.triples == 7
+
+    node = Node(str(tmp_path / "p"))
+    node.alter(schema_text="loc: geo @index(geo) .\nname: string .\npop: int .")
+    live_load(node, [str(out)])
+    res, _ = node.query('{ q(func: near(loc, [-122.42, 37.77], 1000)) '
+                        '{ name pop coastal } }')
+    assert res == {"q": [{"name": "SF", "pop": 880000, "coastal": True}]}
+    node.close()
