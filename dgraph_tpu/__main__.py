@@ -116,6 +116,31 @@ def cmd_convert(args) -> int:
     return 0
 
 
+def _apply_env_defaults(sp: argparse.ArgumentParser) -> None:
+    """DGRAPH_TPU_<FLAG> environment variables override flag defaults
+    (the reference's viper env binding: every cobra flag doubles as an env
+    key). Explicit command-line values still win."""
+    import os
+
+    for action in sp._actions:
+        if not action.option_strings or action.dest == "help":
+            continue
+        env = os.environ.get(f"DGRAPH_TPU_{action.dest.upper()}")
+        if env is None:
+            continue
+        if action.type is int:
+            action.default = int(env)
+        elif action.type is float:
+            action.default = float(env)
+        elif isinstance(action, argparse._StoreTrueAction):
+            action.default = env.lower() in ("1", "true", "yes")
+        elif action.nargs in ("+", "*"):
+            action.default = env.split(",")
+        else:
+            action.default = env
+        action.required = False
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dgraph_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -167,6 +192,8 @@ def main(argv=None) -> int:
                     help="predicate for geometries")
     cp.set_defaults(fn=cmd_convert)
 
+    for sp_ in (sp, bp, ep, lp, cp):
+        _apply_env_defaults(sp_)
     args = p.parse_args(argv)
     return args.fn(args)
 

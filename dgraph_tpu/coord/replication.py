@@ -34,6 +34,7 @@ from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage.csr_build import build_snapshot
 from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.watermark import WaterMark
 
 _U32 = struct.Struct("<I")
 
@@ -121,11 +122,20 @@ class FollowerReader:
         # bumped per applied record: max_seen_commit_ts alone misses
         # schema/drop records, which must also invalidate the cache
         self._version = 0
+        # applied watermark: record index n is done once apply(n) returns;
+        # wait_for_mark(n) = "this reader reflects the first n records"
+        # (x/watermark.go applied-watermark contract)
+        self.applied = WaterMark("applied")
 
     def apply(self, data: bytes) -> None:
         with self._lock:
-            self.store.apply_record(json.loads(data))
-            self._version += 1
+            idx = self._version + 1
+            self.applied.begin(idx)
+            try:
+                self.store.apply_record(json.loads(data))
+            finally:
+                self._version = idx
+                self.applied.done(idx)
 
     def query(self, q: str, variables: dict | None = None) -> dict:
         # capture state under the lock, build OUTSIDE it: the leader's
