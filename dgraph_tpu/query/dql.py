@@ -549,7 +549,11 @@ class _Parser:
             if first and t.kind == "name" and t.text == "count":
                 self.next()
                 self.expect("(")
-                fn.attr = self.name()
+                if self.peek().text == "~":   # count(~rev) degree compare
+                    self.next()
+                    fn.attr = "~" + self.name()
+                else:
+                    fn.attr = self.name()
                 self.expect(")")
                 fn.is_count = True
             elif first and t.kind == "name" and t.text == "val":
@@ -591,10 +595,11 @@ class _Parser:
             else:
                 fn.args.append(self.literal())
             first = False
-        if fname == "eq":
-            # eq(pred, [v1, v2]) list form == eq(pred, v1, v2) variadic form:
-            # flatten here so every consumer (root func, filters, val-var
-            # compares) sees one value list (gql parses both the same way).
+        if fname in ("eq", "uid_in"):
+            # eq(pred, [v1, v2]) / uid_in(pred, [u1, u2]) list form == the
+            # variadic form: flatten here so every consumer (root func,
+            # filters, val-var compares) sees one value list (gql parses
+            # both the same way).
             fn.args = [x for a in fn.args
                        for x in (a if isinstance(a, list) else (a,))]
         return fn
@@ -805,14 +810,24 @@ class _Parser:
         if self.accept("@"):
             langs = [self.name() if self.peek().kind == "name" else self.next().text]
             while self.accept(":"):
-                langs.append(self.name())
+                # chain elements are langs or the untagged-fallback "."
+                if self.peek().kind == "name":
+                    langs.append(self.name())
+                elif self.peek().text == ".":
+                    self.next()
+                    langs.append(".")
+                else:
+                    raise ParseError(
+                        f"bad language tag after ':' at {self.peek().pos}")
             # beware: @facets etc. are directives, not langs
             if langs[0] in ("filter", "cascade", "normalize", "facets", "groupby",
                             "recurse", "ignorereflex"):
                 self.i -= 2 if len(langs) == 1 else 0
             else:
                 gq.langs = langs
-                gq.lang = langs[0]
+                # the full chain travels in .lang ("fr:es:."): the task layer
+                # walks it and the output key mirrors it (name@fr:es:.)
+                gq.lang = ":".join(langs)
         # (args) and @directives in either order (dgraph accepts both)
         while True:
             if self.accept("("):

@@ -245,8 +245,11 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
                 for s, m in zip(frontier, matrix)]
         # filter-function applied over the frontier itself (uid_in / has)
         if fname == "uid_in":
-            want = int(str(args[0]), 0)  # accepts decimal and 0x-hex uid forms
-            keep = np.asarray([want in m for m in matrix], dtype=bool)
+            # uid_in(pred, u1, u2, ...) keeps subjects with ANY listed
+            # object (decimal and 0x-hex uid forms accepted)
+            want = {int(str(a), 0) for a in args}
+            keep = np.asarray([bool(want.intersection(m)) for m in matrix],
+                              dtype=bool)
             res.dest_uids = frontier[keep]
         elif fname == "has":
             # has(attr) over a frontier: subjects with >= 1 edge (or a value,
@@ -313,21 +316,25 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
         return res
 
     res.value_matrix = []
+    lang_chain = q.lang.split(":") if q.lang else ()
     for u, pres in zip(frontier.tolist(), present):
         vals: list[Val] = []
-        if q.lang == ".":
-            # any-language read: untagged first, else any tagged value
-            sv = pd.host_values.get(int(u))
-            if sv is not None:
-                vals = [sv]
-            else:
-                lv = pd.lang_values.get(int(u), {})
-                if lv:
-                    vals = [next(iter(lv.values()))]
-        elif q.lang:
+        if q.lang:
+            # language preference chain "fr:es:." — first hit wins; "."
+            # means untagged-first-then-any (reference: @lang fallback,
+            # query/outputnode.go valToBytes language handling)
             lv = pd.lang_values.get(int(u), {})
-            if q.lang in lv:
-                vals = [lv[q.lang]]
+            for lg in lang_chain:
+                if lg == ".":
+                    sv = pd.host_values.get(int(u))
+                    if sv is not None:
+                        vals = [sv]
+                    elif lv:
+                        vals = [next(iter(lv.values()))]
+                    break
+                if lg in lv:
+                    vals = [lv[lg]]
+                    break
         elif pres:
             sv = pd.host_values.get(int(u))
             if sv is not None:
@@ -377,12 +384,18 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
     if fname == "uid":
         return np.unique(np.asarray([int(a) for a in args], dtype=np.int64))
     if fname == "has":
+        if q.reverse:
+            # has(~pred): nodes with at least one INCOMING edge
+            if pd.rev_csr is None:
+                return np.zeros(0, np.int64)
+            return np.asarray(pd.rev_csr.subjects).astype(np.int64)
         return pd.has_subjects().astype(np.int64)
 
     if fname in ("le", "lt", "ge", "gt", "eq"):
-        # compare-scalar over count index: eq(count(pred), N)
+        # compare-scalar over count index: eq(count(pred), N); the reverse
+        # form eq(count(~pred), N) compares in-degrees over the reverse CSR
         if args and isinstance(args[0], str) and args[0] == "__count__":
-            return _count_func(pd, fname, int(args[1]))
+            return _count_func(pd, fname, int(args[1]), reverse=q.reverse)
         if not args:
             if fname == "eq":
                 # eq(pred, []) — degenerate but parseable; matches nothing
@@ -420,13 +433,15 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
     raise TaskError(f"unknown function {fname!r}")
 
 
-def _count_func(pd: PredData, op: str, n: int) -> np.ndarray:
+def _count_func(pd: PredData, op: str, n: int,
+                reverse: bool = False) -> np.ndarray:
     """Compare-scalar on degree (reference countParams.evaluate :1498; the
     count index becomes a device degree reduction over the CSR)."""
-    if pd.csr is None:
+    csr = pd.rev_csr if reverse else pd.csr
+    if csr is None:
         return np.zeros(0, np.int64)
-    indptr = np.asarray(pd.csr.indptr)
-    subjects = np.asarray(pd.csr.subjects).astype(np.int64)
+    indptr = np.asarray(csr.indptr)
+    subjects = np.asarray(csr.subjects).astype(np.int64)
     deg = indptr[1:] - indptr[:-1]
     mask = {"eq": deg == n, "le": deg <= n, "lt": deg < n,
             "ge": deg >= n, "gt": deg > n}[op]

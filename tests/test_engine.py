@@ -387,3 +387,46 @@ def test_regexp_case_insensitive():
     assert {x["name"] for x in out["q"]} == {"Rick Grimes", "GLENN RHEE"}
     out, _ = n.query('{ q(func: regexp(name, /dixon$/i)) { name } }')
     assert [x["name"] for x in out["q"]] == ["daryl dixon"]
+
+
+def test_lang_fallback_chain():
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) @lang .")
+    n.mutate(set_nquads='_:a <name> "Alice" .\n_:a <name> "Alicia"@es .\n'
+                        '_:b <name> "Bobby"@en .', commit_now=True)
+    out, _ = n.query('{ q(func: eq(name, "Alice")) { name@fr:es:. } }')
+    assert out == {"q": [{"name@fr:es:.": "Alicia"}]}
+    out, _ = n.query('{ q(func: has(name)) { name@fr:. } }')
+    assert {r["name@fr:."] for r in out["q"]} == {"Alice", "Bobby"}
+    out, _ = n.query('{ q(func: has(name)) { name@fr:de } }')
+    assert out == {}                      # chain without "." can miss
+
+
+def test_count_reverse_at_root(env):
+    # eq(count(~friend), n): degree compare over the REVERSE index
+    out = run(env, '{ q(func: eq(count(~friend), 2), orderasc: name) { name } }')
+    assert [x["name"] for x in out["q"]] == ["Andrea", "Michonne"]
+
+
+def test_uid_in_list_form(env):
+    out = run(env, '{ q(func: has(friend)) @filter(uid_in(friend, [0x2, 0x6])) '
+                   '{ name } }')
+    assert {x["name"] for x in out["q"]} == {"Michonne", "Andrea"}
+
+
+def test_has_reverse_at_root(env):
+    # has(~friend): nodes with INCOMING friend edges (Carl has none outgoing
+    # but one incoming; uid2/3 have incoming from Michonne, etc.)
+    out = run(env, '{ q(func: has(~friend), orderasc: name) { name } }')
+    assert [x["name"] for x in out["q"]] == [
+        "Andrea", "Carl", "Daryl Dixon", "Glenn Rhee", "Michonne",
+        "Rick Grimes"]
+
+
+def test_bad_lang_chain_rejected():
+    from dgraph_tpu.query.dql import ParseError, parse
+    with pytest.raises(ParseError):
+        parse('{ q(func: has(name)) { name@en:2 } }')
+    with pytest.raises(ParseError):
+        parse('{ q(func: has(name)) { name@en: } }')
