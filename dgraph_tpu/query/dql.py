@@ -34,7 +34,9 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:\\.|[^"\\])*")
   | (?P<hexnum>0x[0-9a-fA-F]+)
   | (?P<number>-?\d+\.\d+|-?\d+|-?\.\d+)
-  | (?P<name>[a-zA-Z_][a-zA-Z0-9_.]*|<[^>]+>)
+  | (?P<name>[a-zA-Z_][a-zA-Z0-9_.]*|<[^>\s]+>)  # IRIs never contain spaces
+                                                 # (else `a < b ... >` would
+                                                 # lex as one giant IRI)
   | (?P<varname>\$[a-zA-Z_][a-zA-Z0-9_]*)
   | (?P<spread>\.\.\.)
   | (?P<punct>[{}()\[\]:@~*]|!=|<=|>=|==|[<>=!+\-*/%])
@@ -178,6 +180,7 @@ class GraphQuery:
     expand: str = ""             # expand(_all_) / expand(val)
     math: MathTree | None = None
     val_ref: str = ""            # val(x) child
+    checkpwd: str = ""           # checkpwd(pwd, "<candidate>") child
     is_internal: bool = False
 
     def all_needs(self) -> list[str]:
@@ -799,6 +802,14 @@ class _Parser:
                     gq.needs_vars.append(str(self.literal()))
                 gq.attr = "uid"
                 gq.is_uid_node = True
+            elif nm == "checkpwd" and self.peek().text == "(":
+                # checkpwd(pwd, "candidate") selection: per-uid bool keyed
+                # "checkpwd(pwd)" (reference query/outputnode.go checkPwd)
+                self.expect("(")
+                gq.attr = self.name()
+                gq.checkpwd = str(self.literal())
+                self.expect(")")
+                gq.alias = f"checkpwd({gq.attr})"
             elif nm == "uid":
                 gq.is_uid_node = True
             elif nm == "expand":
@@ -863,7 +874,10 @@ class _Parser:
 
     # -- math ---------------------------------------------------------------
 
-    _MATH_BINOPS = [("+", "-"), ("*", "/", "%")]
+    # comparisons bind loosest (math(a + 1 > b) parses as (a+1) > b), like
+    # the reference's mathOpPrecedence (gql/math.go)
+    _MATH_BINOPS = [("<", ">", "<=", ">=", "==", "!="), ("+", "-"),
+                    ("*", "/", "%")]
 
     def _parse_math(self, level: int = 0) -> MathTree:
         if level >= len(self._MATH_BINOPS):

@@ -239,6 +239,15 @@ class Executor:
             self.traversed_edges += res.traversed_edges
             if self.traversed_edges > MAX_QUERY_EDGES:
                 raise QueryError("query exceeded edge budget (ErrTooBig)")
+            if cgq.checkpwd:
+                # checkpwd(pwd, "cand"): stored password -> bool per uid
+                # (query/outputnode.go checkPwd)
+                from dgraph_tpu.utils.types import verify_password
+                res.value_matrix = [
+                    [Val(TypeID.BOOL,
+                         bool(vs) and verify_password(cgq.checkpwd,
+                                                      str(vs[0].value)))]
+                    for vs in res.value_matrix]
             child.uid_matrix = res.uid_matrix
             child.value_matrix = res.value_matrix
             child.facet_matrix = res.facet_matrix

@@ -151,21 +151,73 @@ _STOPWORDS = frozenset(
 )
 
 
+def _is_cons(w: str, i: int) -> bool:
+    c = w[i]
+    if c in "aeiou":
+        return False
+    if c == "y":
+        return i == 0 or not _is_cons(w, i - 1)
+    return True
+
+
+def _measure(w: str) -> int:
+    """Porter's m: number of VC sequences."""
+    m, i, n = 0, 0, len(w)
+    while i < n and _is_cons(w, i):
+        i += 1
+    while i < n:
+        while i < n and not _is_cons(w, i):
+            i += 1
+        if i >= n:
+            break
+        m += 1
+        while i < n and _is_cons(w, i):
+            i += 1
+    return m
+
+
+def _ends_cvc(w: str) -> bool:
+    n = len(w)
+    if n < 3:
+        return False
+    return (_is_cons(w, n - 3) and not _is_cons(w, n - 2)
+            and _is_cons(w, n - 1) and w[-1] not in "wxy")
+
+
 def porter_stem(w: str) -> str:
-    """Compact Porter stemmer (step 1 + common suffix strips) — enough to make
-    full-text matching insensitive to plurals/verb forms, the property the
-    reference gets from Bleve's English stemmer."""
+    """Compact Porter stemmer (steps 1a/1b/1c + common suffix strips) —
+    enough to make full-text matching insensitive to plurals/verb forms, the
+    property the reference gets from Bleve's English stemmer. The 1b cleanup
+    (re-add 'e' on short CVC stems, undouble consonants) keeps inflections
+    and their base form on the SAME token: hiking/hike → hike, not hik/hike."""
     if len(w) <= 3:
         return w
     for suf, rep in (("sses", "ss"), ("ies", "i"), ("ss", "ss"), ("s", "")):
         if w.endswith(suf):
             w = w[: len(w) - len(suf)] + rep
             break
+    matched = ""
+    if w.endswith("eed"):                   # Porter 1b: (m>0) EED -> EE
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+        return w
     for suf in ("ational", "tional", "ization", "fulness", "ousness", "iveness",
                 "biliti", "entli", "ousli", "ing", "edly", "ed", "ly", "ment", "ness"):
         if w.endswith(suf) and len(w) - len(suf) >= 3:
             w = w[: len(w) - len(suf)]
+            matched = suf
             break
+    if matched in ("ing", "ed", "edly"):
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif len(w) >= 2 and w[-1] == w[-2] and _is_cons(w, len(w) - 1) \
+                and w[-1] not in "lsz":
+            w = w[:-1]                      # hopping -> hopp -> hop
+        elif _measure(w) == 1 and _ends_cvc(w):
+            w += "e"                        # hiking -> hik -> hike
+    if len(w) > 2 and w.endswith("y") and any(
+            not _is_cons(w, i) for i in range(len(w) - 1)):
+        w = w[:-1] + "i"                    # pony/ponies both -> poni
     return w
 
 

@@ -430,3 +430,48 @@ def test_bad_lang_chain_rejected():
         parse('{ q(func: has(name)) { name@en:2 } }')
     with pytest.raises(ParseError):
         parse('{ q(func: has(name)) { name@en: } }')
+
+
+def test_checkpwd_child():
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .\npwd: password .")
+    n.mutate(set_nquads='_:a <name> "A" .\n'
+                        '_:a <pwd> "secret123"^^<xs:password> .',
+             commit_now=True)
+    out, _ = n.query('{ q(func: eq(name, "A")) { checkpwd(pwd, "secret123") } }')
+    assert out == {"q": [{"checkpwd(pwd)": True}]}
+    out, _ = n.query('{ q(func: eq(name, "A")) { checkpwd(pwd, "wrong1") } }')
+    assert out == {"q": [{"checkpwd(pwd)": False}]}
+
+
+def test_fulltext_stemming_inflections():
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="bio: string @index(fulltext) .\n"
+                        "name: string @index(exact) .")
+    n.mutate(set_nquads='_:a <name> "A" .\n'
+                        '_:a <bio> "loves hiking in the mountains" .\n'
+                        '_:b <name> "B" .\n_:b <bio> "agreed to run fast" .',
+             commit_now=True)
+    out, _ = n.query('{ q(func: alloftext(bio, "mountain hike")) { name } }')
+    assert out == {"q": [{"name": "A"}]}
+    out, _ = n.query('{ q(func: anyoftext(bio, "agree running")) { name } }')
+    assert out == {"q": [{"name": "B"}]}
+
+
+def test_math_comparisons_and_cond():
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .\nscore: float .")
+    n.mutate(set_nquads='_:a <name> "hi" .\n_:a <score> "7.5"^^<xs:float> .\n'
+                        '_:b <name> "lo" .\n_:b <score> "3.0"^^<xs:float> .',
+             commit_now=True)
+    out, _ = n.query('''{
+      var(func: has(score)) { s as score
+        c as math(cond(s > 5.0, 1, 0))
+        d as math(cond(s <= 3.0, 1, 0)) }
+      q(func: has(score), orderasc: name) { name val(c) val(d) }
+    }''')
+    assert out["q"] == [{"name": "hi", "val(c)": 1, "val(d)": 0},
+                       {"name": "lo", "val(c)": 0, "val(d)": 1}]
