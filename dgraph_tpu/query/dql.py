@@ -664,7 +664,10 @@ class _Parser:
                 self.next()
                 self.expect(":")
                 gq.facets.order.append((self.name(), t.text == "orderdesc"))
-            elif t.kind == "name" and _is_func_ahead(self.toks, self.i):
+            elif t.text.lower() == "not" or t.text == "(" or (
+                    t.kind == "name" and _is_func_ahead(self.toks, self.i)):
+                # filter trees can open with NOT / a paren group, not just a
+                # function name: @facets(NOT eq(close, true))
                 gq.facets.filter = self._parse_filter_tree()
             else:
                 nm = self.name()

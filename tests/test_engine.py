@@ -475,3 +475,16 @@ def test_math_comparisons_and_cond():
     }''')
     assert out["q"] == [{"name": "hi", "val(c)": 1, "val(d)": 0},
                        {"name": "lo", "val(c)": 0, "val(d)": 1}]
+
+
+def test_facet_filter_not_and_parens(env):
+    out = run(env, '''{
+      q(func: uid(1)) { friend @facets(NOT eq(close, true)) { name } }
+    }''')
+    names = {x["name"] for x in out["q"][0]["friend"]}
+    assert names == {"Daryl Dixon", "Andrea"}
+    out = run(env, '''{
+      q(func: uid(1)) { friend @facets((eq(close, true))) { name } }
+    }''')
+    names = {x["name"] for x in out["q"][0]["friend"]}
+    assert names == {"Rick Grimes", "Glenn Rhee"}
