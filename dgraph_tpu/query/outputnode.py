@@ -107,9 +107,17 @@ def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
             targets = child.uid_matrix[idx] if idx < len(child.uid_matrix) else []
             facets = (child.facet_matrix[idx]
                       if child.facet_matrix and idx < len(child.facet_matrix) else [])
-            sub_frontier = np.sort(child.dest_uids)
+            # memoized per CHILD, not per parent uid: pre_traverse runs once
+            # per parent and these were rebuilt every call (the JSON-encode
+            # hot spot at scale)
+            sub_frontier = getattr(child, "_sorted_dest", None)
+            if sub_frontier is None:
+                sub_frontier = child._sorted_dest = np.sort(child.dest_uids)
+            kept = getattr(child, "_kept_set", None)
+            if kept is None:
+                kept = child._kept_set = set(
+                    int(x) for x in child.dest_uids)
             objs = []
-            kept = set(int(x) for x in child.dest_uids)
             # nested count(uid): emit a per-parent {"count": n} object over the
             # kept (post-filter) targets, ALONGSIDE any sibling attributes —
             # the reference appends it as one more list entry (query.go:472)
