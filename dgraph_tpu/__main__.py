@@ -39,10 +39,15 @@ def cmd_serve(args) -> int:
     grpc_srv = None
     if args.grpc_port:
         from dgraph_tpu.api.grpc_server import serve_grpc
-        grpc_srv, gport = serve_grpc(node, f"{args.host}:{args.grpc_port}")
-        print(f"serving gRPC on {args.host}:{gport}", flush=True)
-    srv = make_server(node, args.host, args.port)
-    print(f"serving HTTP on {args.host}:{args.port} "
+        grpc_srv, gport = serve_grpc(node, f"{args.host}:{args.grpc_port}",
+                                     tls_cert=args.tls_cert,
+                                     tls_key=args.tls_key)
+        print(f"serving gRPC on {args.host}:{gport}"
+              f"{' (TLS)' if args.tls_cert else ''}", flush=True)
+    srv = make_server(node, args.host, args.port,
+                      tls_cert=args.tls_cert, tls_key=args.tls_key)
+    print(f"serving HTTP{'S' if args.tls_cert else ''} on "
+          f"{args.host}:{args.port} "
           f"(postings={args.postings or '<memory>'})", flush=True)
     try:
         srv.serve_forever()
@@ -158,6 +163,9 @@ def main(argv=None) -> int:
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")
+    sp.add_argument("--tls_cert", default=None,
+                    help="PEM certificate: serve HTTP and gRPC over TLS")
+    sp.add_argument("--tls_key", default=None, help="PEM private key")
     sp.set_defaults(fn=cmd_serve)
 
     vp = sub.add_parser("version", help="print version")

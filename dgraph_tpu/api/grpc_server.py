@@ -133,12 +133,19 @@ class DgraphService:
 
 
 def serve_grpc(node: Node, addr: str = "localhost:9080",
-               max_workers: int = 8) -> tuple[grpc.Server, int]:
+               max_workers: int = 8, tls_cert: str | None = None,
+               tls_key: str | None = None) -> tuple[grpc.Server, int]:
     """Start a grpc server bound to addr; returns (server, bound port) —
-    pass port 0 to pick a free one. Caller stops it."""
+    pass port 0 to pick a free one. Caller stops it. A cert+key pair turns
+    on server-side TLS (x/tls_helper.go surface)."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((DgraphService(node).handler(),))
-    port = server.add_insecure_port(addr)
+    if tls_cert and tls_key:
+        with open(tls_key, "rb") as kf, open(tls_cert, "rb") as cf:
+            creds = grpc.ssl_server_credentials(((kf.read(), cf.read()),))
+        port = server.add_secure_port(addr, creds)
+    else:
+        port = server.add_insecure_port(addr)
     if port == 0:
         # grpc signals bind failure by returning 0, not raising
         raise RuntimeError(f"could not bind gRPC listener on {addr}")

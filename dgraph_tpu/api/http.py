@@ -216,10 +216,19 @@ def _split_mutation_blocks(body: str) -> tuple[str, str]:
     return sets, dels
 
 
-def make_server(node: Node, host: str = "127.0.0.1",
-                port: int = 8080) -> ThreadingHTTPServer:
+def make_server(node: Node, host: str = "127.0.0.1", port: int = 8080,
+                tls_cert: str | None = None,
+                tls_key: str | None = None) -> ThreadingHTTPServer:
+    """HTTP (or HTTPS when a cert+key pair is given — the reference's
+    x/tls_helper.go server-side TLS surface)."""
     handler = type("BoundHandler", (_Handler,), {"node": node})
-    return ThreadingHTTPServer((host, port), handler)
+    srv = ThreadingHTTPServer((host, port), handler)
+    if tls_cert and tls_key:
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key)
+        srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+    return srv
 
 
 def serve_forever(node: Node, host: str = "127.0.0.1", port: int = 8080):
