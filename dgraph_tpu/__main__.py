@@ -112,6 +112,29 @@ def cmd_live(args) -> int:
     return 0
 
 
+def cmd_worker(args) -> int:
+    """Serve one group's tablets over the internal wire protocol
+    (the reference's worker gRPC on port 7080)."""
+    import time
+
+    from dgraph_tpu.parallel.remote import serve_worker
+    from dgraph_tpu.storage.store import Store
+
+    store = Store(args.postings)
+    server, port = serve_worker(store, f"{args.host}:{args.port}")
+    print(f"worker serving {len(store.predicates())} tablets on "
+          f"{args.host}:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop(0)
+        store.close()
+    return 0
+
+
 def cmd_convert(args) -> int:
     from dgraph_tpu.loader.convert import convert_geojson
 
@@ -193,6 +216,13 @@ def main(argv=None) -> int:
     lp.add_argument("--batch", type=int, default=1000)
     lp.set_defaults(fn=cmd_live)
 
+    wp = sub.add_parser("worker", help="serve one group's tablets over the "
+                                       "internal worker protocol")
+    wp.add_argument("--host", default="127.0.0.1")
+    wp.add_argument("--port", type=int, default=7080)
+    wp.add_argument("-p", "--postings", required=True)
+    wp.set_defaults(fn=cmd_worker)
+
     cp = sub.add_parser("convert", help="GeoJSON -> RDF (.rdf.gz)")
     cp.add_argument("--geo", required=True, help="GeoJSON file (optionally .gz)")
     cp.add_argument("--out", default="output.rdf.gz")
@@ -200,7 +230,7 @@ def main(argv=None) -> int:
                     help="predicate for geometries")
     cp.set_defaults(fn=cmd_convert)
 
-    for sp_ in (sp, bp, ep, lp, cp):
+    for sp_ in (sp, bp, ep, lp, cp, wp):
         _apply_env_defaults(sp_)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,226 @@
+"""Cross-process task execution: the Worker gRPC service + client.
+
+Reference semantics: worker/task.go:137 ProcessTaskOverNetwork — a
+per-predicate task routes to the group serving that tablet; remote groups
+answer over the internal wire protocol (protos/internal.proto ServeTask),
+local ones short-circuit to the in-process call. worker/groups.go:292
+BelongsTo is the routing decision; here the caller's tablet map makes it.
+
+Serialization: uid arrays as raw int64-LE bytes (numpy buffer in/out, no
+per-element parse); typed values/facets as the store's JSON value encoding.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+
+import numpy as np
+
+try:
+    import grpc
+except ImportError:              # pragma: no cover
+    grpc = None
+
+from ..protos import internal_pb2 as ipb
+from ..query.task import TaskQuery, TaskResult, process_task
+from ..storage.store import _val_from_json, _val_to_json
+
+SERVICE = "dgraph_tpu.internal.Worker"
+
+
+def _uids_to_bytes(a) -> bytes:
+    return np.ascontiguousarray(np.asarray(a, dtype="<i8")).tobytes()
+
+
+def _uids_from_bytes(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, dtype="<i8").astype(np.int64)
+
+
+def _vals_json(rows) -> str:
+    return json.dumps([[_val_to_json(v) for v in row] for row in rows])
+
+
+def _vals_from_json(s: str):
+    return [[_val_from_json(j) for j in row] for row in json.loads(s)]
+
+
+def _facets_json(rows) -> str:
+    return json.dumps([[[[k, _val_to_json(v)] for k, v in fac]
+                        for fac in row] for row in rows])
+
+
+def _facets_from_json(s: str):
+    return [[tuple((k, _val_from_json(j)) for k, j in fac)
+             for fac in row] for row in json.loads(s)]
+
+
+def encode_result(res: TaskResult) -> ipb.TaskResponse:
+    offs = np.zeros(len(res.uid_matrix) + 1, dtype="<i8")
+    if res.uid_matrix:
+        np.cumsum([len(r) for r in res.uid_matrix], out=offs[1:])
+    flat = (np.concatenate([np.asarray(r, dtype="<i8")
+                            for r in res.uid_matrix])
+            if res.uid_matrix else np.zeros(0, dtype="<i8"))
+    return ipb.TaskResponse(
+        matrix_flat=flat.tobytes(), matrix_offsets=offs.tobytes(),
+        dest_uids=_uids_to_bytes(res.dest_uids), counts=list(res.counts),
+        value_matrix_json=_vals_json(res.value_matrix)
+        if res.value_matrix else "",
+        facet_matrix_json=_facets_json(res.facet_matrix)
+        if res.facet_matrix else "",
+        traversed_edges=res.traversed_edges)
+
+
+def decode_result(msg: ipb.TaskResponse) -> TaskResult:
+    res = TaskResult()
+    offs = np.frombuffer(msg.matrix_offsets, dtype="<i8")
+    flat = _uids_from_bytes(msg.matrix_flat)
+    if len(offs) > 1:
+        res.uid_matrix = [flat[int(offs[i]): int(offs[i + 1])]
+                          for i in range(len(offs) - 1)]
+    res.dest_uids = _uids_from_bytes(msg.dest_uids)
+    res.counts = list(msg.counts)
+    if msg.value_matrix_json:
+        res.value_matrix = _vals_from_json(msg.value_matrix_json)
+    if msg.facet_matrix_json:
+        res.facet_matrix = _facets_from_json(msg.facet_matrix_json)
+    res.traversed_edges = msg.traversed_edges
+    return res
+
+
+def encode_task(q: TaskQuery, read_ts: int) -> ipb.TaskRequest:
+    return ipb.TaskRequest(
+        attr=q.attr, has_frontier=q.frontier is not None,
+        frontier=_uids_to_bytes(q.frontier) if q.frontier is not None else b"",
+        func_name=q.func[0] if q.func else "",
+        func_args_json=json.dumps(q.func[1]) if q.func else "",
+        lang=q.lang, facet_keys=list(q.facet_keys), first=q.first,
+        reverse=q.reverse, read_ts=read_ts)
+
+
+def decode_task(msg: ipb.TaskRequest) -> tuple[TaskQuery, int]:
+    func = (msg.func_name, json.loads(msg.func_args_json)) \
+        if msg.func_name else None
+    return TaskQuery(
+        attr=("~" if msg.reverse else "") + msg.attr,
+        frontier=_uids_from_bytes(msg.frontier) if msg.has_frontier else None,
+        func=func, lang=msg.lang, facet_keys=list(msg.facet_keys),
+        first=msg.first), msg.read_ts
+
+
+class WorkerService:
+    """One group's task server: answers ServeTask against its own store's
+    snapshot at the requested read_ts."""
+
+    def __init__(self, store) -> None:
+        import threading
+
+        from ..storage.csr_build import build_snapshot
+
+        self.store = store
+        self._build_snapshot = build_snapshot
+        self._lock = threading.Lock()
+        self._snap = None
+        self._snap_ts = -1
+
+    def _snapshot(self, read_ts: int):
+        # visibility is commit_ts <= read_ts, so build at eff exactly
+        # (eff+1 would leak a commit landing at that ts); the lock keeps the
+        # 8-thread gRPC pool from cross-serving snapshots built for
+        # different read timestamps
+        eff = min(read_ts, self.store.max_seen_commit_ts)
+        with self._lock:
+            if self._snap is None or self._snap_ts != eff:
+                self._snap = self._build_snapshot(self.store, read_ts=eff)
+                self._snap_ts = eff
+            return self._snap
+
+    def serve_task(self, msg: ipb.TaskRequest, context) -> ipb.TaskResponse:
+        q, read_ts = decode_task(msg)
+        res = process_task(self._snapshot(read_ts), q, self.store.schema)
+        return encode_result(res)
+
+    def membership(self, _msg: ipb.MembershipRequest,
+                   context) -> ipb.MembershipResponse:
+        return ipb.MembershipResponse(
+            tablets=self.store.predicates(),
+            max_commit_ts=self.store.max_seen_commit_ts)
+
+    def handler(self):
+        def u(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+        return grpc.method_handlers_generic_handler(SERVICE, {
+            "ServeTask": u(self.serve_task, ipb.TaskRequest,
+                           ipb.TaskResponse),
+            "Membership": u(self.membership, ipb.MembershipRequest,
+                            ipb.MembershipResponse),
+        })
+
+
+def serve_worker(store, addr: str = "localhost:0",
+                 max_workers: int = 8):
+    """Start a Worker gRPC server for one group's store; returns
+    (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((WorkerService(store).handler(),))
+    port = server.add_insecure_port(addr)
+    if port == 0:
+        raise RuntimeError(f"could not bind worker listener on {addr}")
+    server.start()
+    return server, port
+
+
+class RemoteWorker:
+    """Client stub for one remote group (the conn/pool analog)."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        self.channel = grpc.insecure_channel(addr)
+        self._serve = self.channel.unary_unary(
+            f"/{SERVICE}/ServeTask",
+            request_serializer=ipb.TaskRequest.SerializeToString,
+            response_deserializer=ipb.TaskResponse.FromString)
+        self._membership = self.channel.unary_unary(
+            f"/{SERVICE}/Membership",
+            request_serializer=ipb.MembershipRequest.SerializeToString,
+            response_deserializer=ipb.MembershipResponse.FromString)
+
+    def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
+        return decode_result(self._serve(encode_task(q, read_ts)))
+
+    def membership(self) -> ipb.MembershipResponse:
+        return self._membership(ipb.MembershipRequest())
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class NetworkDispatcher:
+    """ProcessTaskOverNetwork: route each task by its tablet's owner —
+    local group short-circuits, remote groups go over the wire."""
+
+    def __init__(self, zero, local_group: int, local_snap_fn,
+                 remotes: dict[int, RemoteWorker], schema) -> None:
+        self.zero = zero
+        self.local_group = local_group
+        self.local_snap_fn = local_snap_fn     # read_ts -> GraphSnapshot
+        self.remotes = remotes
+        self.schema = schema
+
+    def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
+        attr = q.attr[1:] if q.attr.startswith("~") else q.attr
+        # consult (don't claim) the tablet map: a query on a never-seen
+        # predicate answers empty locally instead of minting a tablet
+        group = self.zero.tablets().get(attr)
+        if group is None or group == self.local_group:
+            return process_task(self.local_snap_fn(read_ts), q, self.schema)
+        rw = self.remotes.get(group)
+        if rw is None:
+            # a silent local fallback would answer with empty results for
+            # data that exists — surface the unreachable group instead
+            raise RuntimeError(
+                f"no connection to group {group} serving {attr!r}")
+        return rw.process_task(q, read_ts)

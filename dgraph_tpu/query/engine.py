@@ -76,12 +76,19 @@ class Executor:
     (query/query_test.go TestMain, SURVEY.md §4).
     """
 
-    def __init__(self, snap: GraphSnapshot, schema: SchemaState):
+    def __init__(self, snap: GraphSnapshot, schema: SchemaState,
+                 dispatch=None):
         self.snap = snap
         self.schema = schema
         self.vars: dict[str, VarValue] = {}
         self.traversed_edges = 0
         self.sort_index_buckets = -1  # sortWithIndex instrumentation
+        # task dispatch seam (ProcessTaskOverNetwork): the default executes
+        # against the local snapshot; a NetworkDispatcher routes each task
+        # to its tablet's owning group over the internal wire protocol
+        self._remote = dispatch is not None
+        self._dispatch = dispatch or (
+            lambda q: process_task(self.snap, q, self.schema))
 
     # ------------------------------------------------------------------ API
 
@@ -135,9 +142,16 @@ class Executor:
     def _root_uids(self, gq: dql.GraphQuery) -> np.ndarray:
         uids: list[np.ndarray] = []
         if gq.uids:
-            present = _known_uids(self.snap)
             want = np.unique(np.asarray(gq.uids, dtype=np.int64))
-            uids.append(want[np.isin(want, present)] if len(present) else want)
+            if self._remote:
+                # existence spans groups the local snapshot can't see;
+                # accept explicit uids as-is (the reference validates
+                # against the cluster, not one tablet server)
+                uids.append(want)
+            else:
+                present = _known_uids(self.snap)
+                uids.append(want[np.isin(want, present)]
+                            if len(present) else want)
         for v in gq.root_uid_vars:
             vv = self.vars.get(v)
             if vv is not None and vv.uids is not None:
@@ -157,10 +171,9 @@ class Executor:
         args = list(fn.args)
         if fn.is_count:
             # eq(count(pred), n) — compare-scalar form; eq matches ANY listed n
-            outs = [process_task(
-                self.snap,
-                TaskQuery(fn.attr, func=(fn.name, ["__count__", int(n)])),
-                self.schema).dest_uids
+            outs = [self._dispatch(
+                TaskQuery(fn.attr, func=(fn.name, ["__count__", int(n)]))
+                ).dest_uids
                 for n in (args if fn.name == "eq" else args[:1])]
             return (np.unique(np.concatenate(outs)) if outs
                     else np.zeros(0, np.int64))
@@ -173,7 +186,7 @@ class Executor:
                    if _match_any_rhs(fn.name, val, args)]
             return np.asarray(out, dtype=np.int64)
         q = TaskQuery(fn.attr, func=(fn.name, args), lang=fn.lang)
-        return process_task(self.snap, q, self.schema).dest_uids
+        return self._dispatch(q).dest_uids
 
     # ---------------------------------------------------------------- levels
 
@@ -235,7 +248,7 @@ class Executor:
                            if cgq.facets is not None else [])
             if cgq.facets is not None:
                 tq.facet_keys = tq.facet_keys or ["__all__"]
-            res = process_task(self.snap, tq, self.schema)
+            res = self._dispatch(tq)
             self.traversed_edges += res.traversed_edges
             if self.traversed_edges > MAX_QUERY_EDGES:
                 raise QueryError("query exceeded edge budget (ErrTooBig)")
@@ -392,8 +405,7 @@ class Executor:
         if fn.is_count:
             # filter-level eq(count(pred), n): degree check over frontier;
             # eq matches ANY listed n
-            res = process_task(
-                self.snap, TaskQuery(fn.attr, frontier=frontier), self.schema)
+            res = self._dispatch(TaskQuery(fn.attr, frontier=frontier))
             ns = [int(a) for a in (fn.args if name == "eq" else fn.args[:1])]
             keep = [u for u, c in zip(frontier, res.counts)
                     if any(_int_cmp(name, c, n) for n in ns)]
@@ -402,8 +414,7 @@ class Executor:
            self.schema.type_of(fn.attr) not in (TypeID.UID,):
             tid = self.schema.type_of(fn.attr)
             if name == "has" and tid == TypeID.UID:
-                root = process_task(self.snap, TaskQuery(fn.attr, func=("has", [])),
-                                    self.schema).dest_uids
+                root = self._dispatch(TaskQuery(fn.attr, func=("has", []))).dest_uids
                 return us.intersect_host(frontier, root)
             if name == "has":
                 # value predicate: vectorized presence over the frontier
@@ -411,16 +422,16 @@ class Executor:
                 # tablet scan + intersect
                 q = TaskQuery(fn.attr, frontier=frontier,
                               func=("has", []), lang=fn.lang)
-                return process_task(self.snap, q, self.schema).dest_uids
+                return self._dispatch(q).dest_uids
             if name in ("eq", "le", "lt", "ge", "gt") and tid not in (TypeID.UID,):
                 # value compare over the frontier (device value table / host)
                 q = TaskQuery(fn.attr, frontier=frontier,
                               func=(name, list(fn.args)), lang=fn.lang)
-                return process_task(self.snap, q, self.schema).dest_uids
+                return self._dispatch(q).dest_uids
             if name in ("uid_in", "checkpwd"):
                 q = TaskQuery(fn.attr, frontier=frontier,
                               func=(name, list(fn.args)), lang=fn.lang)
-                return process_task(self.snap, q, self.schema).dest_uids
+                return self._dispatch(q).dest_uids
         # index-backed functions: run at root, intersect with frontier
         root = self._run_root_func(fn)
         return us.intersect_host(frontier, root)
@@ -495,7 +506,18 @@ class Executor:
                 return got
         ordered = [int(u) for u in uids]
         for o in reversed(gq.order):
-            present = [(self._order_key(o, u), u) for u in ordered]
+            remote_keys = None
+            if not o.is_val and self.snap.pred(o.attr) is None:
+                # sort key lives on a remote tablet: fetch the values once
+                # through the dispatch seam (ProcessTaskOverNetwork)
+                res = self._dispatch(TaskQuery(
+                    o.attr, frontier=np.asarray(sorted(ordered), np.int64),
+                    lang=o.lang))
+                remote_keys = {
+                    u: sort_key(vals[0]) for u, vals in
+                    zip(sorted(ordered), res.value_matrix) if vals}
+            present = [((remote_keys.get(u) if remote_keys is not None
+                         else self._order_key(o, u)), u) for u in ordered]
             have = [(k, u) for k, u in present if k is not None]
             missing = [u for k, u in present if k is None]
             have.sort(key=lambda t: t[0], reverse=o.desc)

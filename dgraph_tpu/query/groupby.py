@@ -38,16 +38,17 @@ def process_groupby(ex, sg) -> None:
         pd = ex.snap.pred(attr)
         tid = ex.schema.type_of(attr)
         if tid == TypeID.UID or (pd is not None and pd.csr is not None):
-            res = process_task(ex.snap, TaskQuery(attr, frontier=uids), ex.schema)
+            res = ex._dispatch(TaskQuery(attr, frontier=uids))
             for u, targets in zip(uids, res.uid_matrix):
                 for t in targets:
                     col.setdefault(int(u), []).append(int(t))
-        elif pd is not None:
-            for u in uids:
-                v = (pd.lang_values.get(int(u), {}).get(lang) if lang
-                     else pd.host_values.get(int(u)))
-                if v is not None:
-                    col[int(u)] = v
+        else:
+            # value keys through the dispatch seam: the tablet may live on
+            # a remote group where ex.snap has no local arrays
+            res = ex._dispatch(TaskQuery(attr, frontier=uids, lang=lang))
+            for u, vals in zip(uids, res.value_matrix):
+                if vals:
+                    col[int(u)] = vals[0]
         columns.append((alias or attr, col))
 
     # build group map: key tuple -> member uids (uid attrs contribute each edge)

@@ -42,8 +42,8 @@ def recurse(ex, sg: SubGraph) -> None:
         # value/scalar children appear at every level
         for cgq in val_children:
             child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-            res = process_task(ex.snap, TaskQuery(cgq.attr, frontier=frontier,
-                                                  lang=cgq.lang), ex.schema)
+            res = ex._dispatch(TaskQuery(cgq.attr, frontier=frontier,
+                                                  lang=cgq.lang))
             child.value_matrix = res.value_matrix
             child.uid_matrix = res.uid_matrix
             child.counts = res.counts
@@ -53,8 +53,7 @@ def recurse(ex, sg: SubGraph) -> None:
             return out
         for cgq in uid_children:
             child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-            res = process_task(ex.snap, TaskQuery(cgq.attr, frontier=frontier),
-                               ex.schema)
+            res = ex._dispatch(TaskQuery(cgq.attr, frontier=frontier))
             edges += res.traversed_edges
             if edges > MAX_QUERY_EDGES:
                 raise QueryError("recurse exceeded edge budget (ErrTooBig)")

@@ -53,7 +53,7 @@ def _build_adjacency(ex, sg: SubGraph, src: int, dst: int):
                 facet_key = cgq.facets.keys[0][1]
             tq = TaskQuery(cgq.attr, frontier=np.sort(frontier),
                            facet_keys=[facet_key] if facet_key else [])
-            res = process_task(ex.snap, tq, ex.schema)
+            res = ex._dispatch(tq)
             edges += res.traversed_edges
             if edges > MAX_QUERY_EDGES:
                 raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
