@@ -96,16 +96,8 @@ class Cluster:
                 uid_map = mut.assign_uids(nq_set + nq_del, self.zero.uids)
                 edges = mut.to_edges(nq_set, uid_map, Op.SET) + \
                     mut.to_edges(nq_del, uid_map, Op.DEL)
-                by_group: dict[int, list] = {}
-                for e in edges:
-                    if e.attr == "*":
-                        # S * * expands against each group's OWN predicates —
-                        # the reference fans * deletes to every group
-                        # (populateMutationMap, worker/mutation.go:470)
-                        for g in range(len(self.stores)):
-                            by_group.setdefault(g, []).append(e)
-                        continue
-                    by_group.setdefault(self.group_of(e.attr), []).append(e)
+                by_group = mut.split_edges_by_group(
+                    edges, len(self.stores), self.group_of)
                 conflicts: list[bytes] = []
                 preds: set[str] = set()
                 for g, ge in sorted(by_group.items()):

@@ -24,6 +24,7 @@ except ImportError:              # pragma: no cover
 
 from ..protos import internal_pb2 as ipb
 from ..query.task import TaskQuery, TaskResult, process_task
+from ..storage.postings import DirectedEdge, Op
 from ..storage.store import _val_from_json, _val_to_json
 
 SERVICE = "dgraph_tpu.internal.Worker"
@@ -109,6 +110,27 @@ def decode_task(msg: ipb.TaskRequest) -> tuple[TaskQuery, int]:
         first=msg.first), msg.read_ts
 
 
+def encode_edge(e: DirectedEdge) -> ipb.Edge:
+    return ipb.Edge(
+        subject=e.subject, attr=e.attr, object_uid=e.object_uid,
+        value_json=json.dumps(_val_to_json(e.value))
+        if e.value is not None else "",
+        op=int(e.op), lang=e.lang,
+        facets_json=json.dumps([[k, _val_to_json(v)] for k, v in e.facets])
+        if e.facets else "")
+
+
+def decode_edge(m: ipb.Edge) -> DirectedEdge:
+    return DirectedEdge(
+        subject=m.subject, attr=m.attr, object_uid=m.object_uid,
+        value=_val_from_json(json.loads(m.value_json))
+        if m.value_json else None,
+        op=Op(m.op), lang=m.lang,
+        facets=tuple((k, _val_from_json(j))
+                     for k, j in json.loads(m.facets_json))
+        if m.facets_json else ())
+
+
 class WorkerService:
     """One group's task server: answers ServeTask against its own store's
     snapshot at the requested read_ts."""
@@ -147,6 +169,31 @@ class WorkerService:
             tablets=self.store.predicates(),
             max_commit_ts=self.store.max_seen_commit_ts)
 
+    def mutate(self, msg: ipb.MutateRequest, context) -> ipb.MutateResponse:
+        """Apply one txn's slice of edges on this group (MutateOverNetwork's
+        receiving side, worker/mutation.go:424) — buffered under start_ts,
+        decided later by Decide."""
+        from ..query import mutation as mut
+
+        edges = [decode_edge(e) for e in msg.edges]
+        touched, conflict, preds = mut.apply_mutations(
+            self.store, edges, msg.start_ts)
+        return ipb.MutateResponse(keys=touched, conflict_keys=conflict,
+                                  preds=sorted(preds))
+
+    def decide(self, msg: ipb.DecisionRequest,
+               context) -> ipb.DecisionResponse:
+        """Commit (commit_ts > 0) or abort this group's buffered layers
+        (CommitOverNetwork fan-out)."""
+        keys = list(msg.keys)
+        if msg.commit_ts:
+            self.store.commit(msg.start_ts, msg.commit_ts, keys)
+            with self._lock:
+                self._snap = None      # next read rebuilds past the commit
+        else:
+            self.store.abort(msg.start_ts, keys)
+        return ipb.DecisionResponse()
+
     def handler(self):
         def u(fn, req_cls, resp_cls):
             return grpc.unary_unary_rpc_method_handler(
@@ -157,6 +204,9 @@ class WorkerService:
                            ipb.TaskResponse),
             "Membership": u(self.membership, ipb.MembershipRequest,
                             ipb.MembershipResponse),
+            "Mutate": u(self.mutate, ipb.MutateRequest, ipb.MutateResponse),
+            "Decide": u(self.decide, ipb.DecisionRequest,
+                        ipb.DecisionResponse),
         })
 
 
@@ -187,12 +237,28 @@ class RemoteWorker:
             f"/{SERVICE}/Membership",
             request_serializer=ipb.MembershipRequest.SerializeToString,
             response_deserializer=ipb.MembershipResponse.FromString)
+        self._mutate = self.channel.unary_unary(
+            f"/{SERVICE}/Mutate",
+            request_serializer=ipb.MutateRequest.SerializeToString,
+            response_deserializer=ipb.MutateResponse.FromString)
+        self._decide = self.channel.unary_unary(
+            f"/{SERVICE}/Decide",
+            request_serializer=ipb.DecisionRequest.SerializeToString,
+            response_deserializer=ipb.DecisionResponse.FromString)
 
     def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
         return decode_result(self._serve(encode_task(q, read_ts)))
 
     def membership(self) -> ipb.MembershipResponse:
         return self._membership(ipb.MembershipRequest())
+
+    def mutate(self, start_ts: int, edges) -> ipb.MutateResponse:
+        return self._mutate(ipb.MutateRequest(
+            start_ts=start_ts, edges=[encode_edge(e) for e in edges]))
+
+    def decide(self, start_ts: int, commit_ts: int, keys) -> None:
+        self._decide(ipb.DecisionRequest(
+            start_ts=start_ts, commit_ts=commit_ts, keys=list(keys)))
 
     def close(self) -> None:
         self.channel.close()
@@ -224,3 +290,67 @@ class NetworkDispatcher:
             raise RuntimeError(
                 f"no connection to group {group} serving {attr!r}")
         return rw.process_task(q, read_ts)
+
+    # -- write fan-out (MutateOverNetwork / CommitOverNetwork) ---------------
+
+    def mutate_over_network(self, edges, start_ts: int, local_store):
+        """Split a txn's edges by owning group and apply on each — local
+        slice in-process, remote slices via the Mutate RPC
+        (worker/mutation.go:470 populateMutationMap + :424 proposeOrSend).
+        Returns (keys_by_group, conflict keys, touched preds); the caller
+        tracks conflicts in its oracle and later calls decide_over_network.
+
+        Partial failure aborts every slice already buffered (the same leak
+        guard the in-process cluster path has); writes to moving tablets
+        are rejected up front (the predicate-move fence)."""
+        from ..query import mutation as mut
+
+        for e in edges:
+            if self.zero.writes_blocked(e.attr) or (
+                    e.attr == "*" and self.zero.moving_tablets()):
+                raise RuntimeError(
+                    f"predicate {e.attr!r} is moving; retry")
+        by_group = mut.split_edges_by_group(
+            edges, self.zero.n_groups, self.zero.should_serve)
+        keys_by_group: dict[int, list[bytes]] = {}
+        conflicts: list[bytes] = []
+        preds: set[str] = set()
+        try:
+            for g, ge in sorted(by_group.items()):
+                if g == self.local_group:
+                    touched, conflict, p = mut.apply_mutations(
+                        local_store, ge, start_ts)
+                else:
+                    rw = self.remotes.get(g)
+                    if rw is None:
+                        raise RuntimeError(f"no connection to group {g}")
+                    resp = rw.mutate(start_ts, ge)
+                    touched = list(resp.keys)
+                    conflict = list(resp.conflict_keys)
+                    p = set(resp.preds)
+                keys_by_group[g] = touched
+                conflicts += conflict
+                preds |= p
+        except BaseException:
+            # abort the slices that DID buffer so they can't pin the
+            # oracle watermark / leak uncommitted layers
+            try:
+                self.decide_over_network(start_ts, 0, keys_by_group,
+                                         local_store)
+            except Exception:
+                pass
+            raise
+        return keys_by_group, conflicts, preds
+
+    def decide_over_network(self, start_ts: int, commit_ts: int,
+                            keys_by_group: dict, local_store) -> None:
+        """Fan the commit (commit_ts > 0) or abort decision to every group
+        that buffered a slice (CommitOverNetwork)."""
+        for g, keys in sorted(keys_by_group.items()):
+            if g == self.local_group:
+                if commit_ts:
+                    local_store.commit(start_ts, commit_ts, keys)
+                else:
+                    local_store.abort(start_ts, keys)
+            else:
+                self.remotes[g].decide(start_ts, commit_ts, keys)

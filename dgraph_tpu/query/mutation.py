@@ -139,6 +139,21 @@ def _validate_and_convert(store: Store, e: DirectedEdge) -> DirectedEdge:
                         facets=e.facets)
 
 
+def split_edges_by_group(edges, n_groups: int, owner_fn) -> dict[int, list]:
+    """populateMutationMap (worker/mutation.go:470): group a txn's edges by
+    owning tablet; `S * *` deletes fan to EVERY group (each expands against
+    its own predicates). Shared by the in-process cluster and the networked
+    fan-out so the two write paths can't drift."""
+    by_group: dict[int, list] = {}
+    for e in edges:
+        if e.attr == "*":
+            for g in range(n_groups):
+                by_group.setdefault(g, []).append(e)
+            continue
+        by_group.setdefault(owner_fn(e.attr), []).append(e)
+    return by_group
+
+
 def apply_mutations(store: Store, edges: list[DirectedEdge],
                     start_ts: int) -> tuple[list[bytes], list[bytes], set[str]]:
     """Buffer edges under start_ts with index/reverse/count maintenance.

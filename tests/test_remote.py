@@ -166,3 +166,129 @@ def test_unreachable_group_errors(network):
 def test_unknown_predicate_answers_empty(network):
     out = _run(network, '{ q(func: has(never_seen)) { uid } }')
     assert out == {}
+
+
+# -- write fan-out over the wire (MutateOverNetwork / CommitOverNetwork) ----
+
+@pytest.fixture
+def wnet():
+    """Fresh 2-group topology with a writable dispatcher."""
+    from dgraph_tpu.coord.zero import UidLease
+    g0 = _mk_store("name: string @index(exact) .",
+                   '<0x1> <name> "p1" .\n<0x2> <name> "p2" .')
+    g1 = _mk_store("age: int @index(int) .",
+                   '<0x1> <age> "21"^^<xs:int> .')
+    server, port = serve_worker(g1, "localhost:0")
+    zero = Zero(2)
+    zero.oracle.timestamps(8)   # move past seed commit ts
+    zero.move_tablet("name", 0)
+    zero.move_tablet("age", 1)
+    remote = RemoteWorker(f"localhost:{port}")
+    sch = g0.schema
+    for attr in g1.schema.predicates():
+        sch.set(g1.schema.get(attr))
+
+    def snap_fn(ts=None):
+        return build_snapshot(g0, read_ts=zero.oracle.read_ts())
+
+    disp = NetworkDispatcher(zero, 0, snap_fn, {1: remote}, sch)
+    yield disp, g0, zero
+    remote.close()
+    server.stop(0)
+
+
+def _dist_query(disp, zero, q):
+    ts = zero.oracle.read_ts()
+    ex = Executor(disp.local_snap_fn(), disp.schema,
+                  dispatch=lambda tq: disp.process_task(tq, ts))
+    return ex.execute(dql.parse(q))
+
+
+def _dist_mutate(disp, g0, zero, nquads, commit=True):
+    st = zero.oracle.new_txn()
+    edges = mut.to_edges(rdf.parse(nquads), {}, Op.SET)
+    keys_by_group, conflicts, preds = disp.mutate_over_network(
+        edges, st.start_ts, g0)
+    zero.oracle.track(st.start_ts, conflicts, sorted(preds))
+    if commit:
+        commit_ts = zero.oracle.commit(st.start_ts)
+        disp.decide_over_network(st.start_ts, commit_ts, keys_by_group, g0)
+    else:
+        zero.oracle.abort(st.start_ts)
+        disp.decide_over_network(st.start_ts, 0, keys_by_group, g0)
+    return st.start_ts
+
+
+def test_cross_group_write_commit(wnet):
+    disp, g0, zero = wnet
+    _dist_mutate(disp, g0, zero,
+                 '<0x2> <age> "44"^^<xs:int> .\n<0x3> <name> "p3" .')
+    out = _dist_query(disp, zero, '{ q(func: has(name), orderasc: name) '
+                                  '{ name age } }')
+    assert out["q"] == [{"name": "p1", "age": 21},
+                       {"name": "p2", "age": 44}, {"name": "p3"}]
+
+
+def test_cross_group_write_abort_invisible(wnet):
+    disp, g0, zero = wnet
+    _dist_mutate(disp, g0, zero, '<0x9> <age> "99"^^<xs:int> .',
+                 commit=False)
+    out = _dist_query(disp, zero, '{ q(func: ge(age, 90)) { uid } }')
+    assert out == {}
+
+
+def test_remote_conflict_detected(wnet):
+    from dgraph_tpu.coord.zero import TxnConflict
+    disp, g0, zero = wnet
+    st1, st2 = zero.oracle.new_txn(), zero.oracle.new_txn()
+    e = mut.to_edges(rdf.parse('<0x1> <age> "30"^^<xs:int> .'), {}, Op.SET)
+    k1, c1, p1 = disp.mutate_over_network(e, st1.start_ts, g0)
+    k2, c2, p2 = disp.mutate_over_network(
+        mut.to_edges(rdf.parse('<0x1> <age> "31"^^<xs:int> .'), {}, Op.SET),
+        st2.start_ts, g0)
+    zero.oracle.track(st1.start_ts, c1, sorted(p1))
+    zero.oracle.track(st2.start_ts, c2, sorted(p2))
+    cts = zero.oracle.commit(st1.start_ts)
+    disp.decide_over_network(st1.start_ts, cts, k1, g0)
+    with pytest.raises(TxnConflict):
+        zero.oracle.commit(st2.start_ts)
+    disp.decide_over_network(st2.start_ts, 0, k2, g0)
+    out = _dist_query(disp, zero, '{ q(func: uid(0x1)) { age } }')
+    assert out["q"][0]["age"] == 30
+
+
+def test_partial_failure_aborts_buffered_slices(wnet):
+    disp, g0, zero = wnet
+    disp.zero.move_tablet("phantom", 1)
+    saved = dict(disp.remotes)
+    disp.remotes.clear()     # group 1 unreachable
+    st = zero.oracle.new_txn()
+    try:
+        with pytest.raises(RuntimeError):
+            # name slice (local) buffers first, then phantom's group fails
+            disp.mutate_over_network(
+                mut.to_edges(rdf.parse(
+                    '<0x5> <name> "ghost" .\n<0x5> <phantom> "x" .'),
+                    {}, Op.SET), st.start_ts, g0)
+    finally:
+        disp.remotes.update(saved)
+    zero.oracle.abort(st.start_ts)
+    # the locally-buffered name layer was aborted: nothing leaks into reads
+    # and no uncommitted layer remains anywhere in the local store
+    out = _dist_query(disp, zero, '{ q(func: eq(name, "ghost")) { uid } }')
+    assert out == {}
+    assert not any(pl.has_uncommitted() for pl in g0.lists.values())
+
+
+def test_move_fence_blocks_networked_writes(wnet):
+    disp, g0, zero = wnet
+    zero.block_writes("age")
+    st = zero.oracle.new_txn()
+    try:
+        with pytest.raises(RuntimeError):
+            disp.mutate_over_network(
+                mut.to_edges(rdf.parse('<0x1> <age> "50"^^<xs:int> .'),
+                             {}, Op.SET), st.start_ts, g0)
+    finally:
+        zero.unblock_writes("age")
+        zero.oracle.abort(st.start_ts)
