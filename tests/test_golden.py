@@ -123,6 +123,18 @@ QUERIES: list[tuple[str, str]] = [
     ("multi_block", '{ a(func: eq(name, "director6")) { name }\n'
                     '  b(func: eq(name, "director7")) { name ~director(first: 2, '
                     'orderasc: name) { name rating } } }'),
+    # round-3 feature coverage
+    ("lang_chain", f'{{ q(func: uid(0x{F + 4:x}, 0x{F + 5:x}), orderasc: name) '
+                   '{ name@de:fr:. } }'),
+    ("uid_in_list", f'{{ q(func: has(genre)) @filter(uid_in(genre, '
+                    f'[0x{G + 4:x}])) {{ name }} }}'),
+    ("count_reverse_root", '{ q(func: ge(count(~genre), 15), orderasc: name) { name } }'),
+    ("math_cond", '{ var(func: has(rating)) { r as rating '
+                  'hi as math(cond(r >= 8.0, 1, 0)) }\n'
+                  '  q(func: has(rating), orderdesc: val(r), first: 4) '
+                  '{ name val(hi) } }'),
+    ("facet_not", f'{{ q(func: uid(0x{F + 6:x})) {{ starring '
+                  '@facets(NOT eq(billing, 1)) { name } } }'),
 ]
 
 
