@@ -148,7 +148,10 @@ def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
             vals = child.value_matrix[idx] if idx < len(child.value_matrix) else []
             if vals:
                 key = alias if not cgq.lang else f"{alias}@{cgq.lang}"
-                node[key] = _val_json(vals[0])
+                # [type] list predicates return a JSON array; single-valued
+                # ones a scalar (reference outputnode list handling)
+                node[key] = ([_val_json(v) for v in vals] if len(vals) > 1
+                             else _val_json(vals[0]))
     return node
 
 

@@ -336,9 +336,13 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
                     vals = [lv[lg]]
                     break
         elif pres:
-            sv = pd.host_values.get(int(u))
-            if sv is not None:
-                vals = [sv]
+            lv = pd.list_values.get(int(u))
+            if lv is not None:
+                vals = list(lv)        # [type] predicate: every value
+            else:
+                sv = pd.host_values.get(int(u))
+                if sv is not None:
+                    vals = [sv]
         res.value_matrix.append(vals)
     if fname in ("eq", "le", "lt", "ge", "gt"):
         # eq(pred, v1, v2, ...) matches ANY listed value (reference parses the

@@ -94,6 +94,9 @@ class PredData:
     num_values: jnp.ndarray | None = None        # float32[N] numeric mirror (NaN=non-numeric)
     num_values_host: np.ndarray | None = None    # float64[N] exact mirror (compares)
     host_values: dict[int, Val] = field(default_factory=dict)
+    # [type] list predicates: every value per subject (host_values keeps the
+    # first for single-value compare/sort paths)
+    list_values: dict[int, list[Val]] = field(default_factory=dict)
     lang_values: dict[int, dict[str, Val]] = field(default_factory=dict)
     facets: dict[tuple[int, int], tuple] = field(default_factory=dict)  # (subj,obj/slot)->facets
     indexes: dict[str, TokenIndex] = field(default_factory=dict)
@@ -234,6 +237,16 @@ def build_pred(store: Store, attr: str, read_ts: int,
         else:
             p0 = live.get(VALUE_UID)
             v = p0.value if p0 is not None else None
+            if v is None and entry is not None and entry.is_list:
+                # [type] list predicate: values live at fingerprint slots;
+                # surface the whole list plus the first as the compare/sort
+                # representative
+                lv = sorted((p.value for p in live.values()
+                             if p.value is not None and not p.lang),
+                            key=lambda x: str(x.value))
+                if lv:
+                    pd.list_values[subj] = lv
+                    v = lv[0]
             if v is not None:
                 pd.host_values[subj] = v
                 val_subjects.append(subj)

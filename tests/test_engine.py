@@ -488,3 +488,23 @@ def test_facet_filter_not_and_parens(env):
     }''')
     names = {x["name"] for x in out["q"][0]["friend"]}
     assert names == {"Rick Grimes", "Glenn Rhee"}
+
+
+def test_list_value_predicates():
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="nick: [string] @index(term) .\n"
+                        "name: string @index(exact) .")
+    n.mutate(set_json={"name": "Jay", "nick": ["jj", "jbird"]},
+             commit_now=True)
+    out, _ = n.query('{ q(func: eq(name, "Jay")) { nick } }')
+    assert out == {"q": [{"nick": ["jbird", "jj"]}]}
+    out, _ = n.query('{ q(func: anyofterms(nick, "jbird")) { name } }')
+    assert out == {"q": [{"name": "Jay"}]}
+    ju = n.query('{ q(func: eq(name, "Jay")) { uid } }')[0]["q"][0]["uid"]
+    n.mutate(del_nquads=f'<{ju}> <nick> "jj" .', commit_now=True)
+    out, _ = n.query('{ q(func: eq(name, "Jay")) { nick } }')
+    assert out == {"q": [{"nick": "jbird"}]}
+    n.mutate(del_nquads=f'<{ju}> <nick> * .', commit_now=True)
+    out, _ = n.query('{ q(func: has(nick)) { uid } }')
+    assert out == {}
