@@ -152,6 +152,18 @@ def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
                 # ones a scalar (reference outputnode list handling)
                 node[key] = ([_val_json(v) for v in vals] if len(vals) > 1
                              else _val_json(vals[0]))
+                # facets on the value edge: name|since etc.
+                vfac = (child.facet_matrix[idx]
+                        if child.facet_matrix
+                        and idx < len(child.facet_matrix) else [])
+                if vfac and vfac[0]:
+                    sel = dict((k, a) for a, k in
+                               (cgq.facets.keys if cgq.facets else []))
+                    for fk, fv in vfac[0]:
+                        if cgq.facets is not None and cgq.facets.keys \
+                                and fk not in sel:
+                            continue
+                        node[f"{cgq.attr}|{sel.get(fk, fk)}"] = _val_json(fv)
     return node
 
 

@@ -344,6 +344,13 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
                 if sv is not None:
                     vals = [sv]
         res.value_matrix.append(vals)
+    if q.facet_keys:
+        # facets on VALUE edges live at the untagged slot (subj, 0); lang
+        # slots carry their own (reference: facets on scalar postings)
+        from dgraph_tpu.storage.postings import lang_uid
+        slot = lang_uid(q.lang.split(":")[0]) if q.lang else 0
+        res.facet_matrix = [[pd.facets.get((int(u), slot), ())]
+                            for u in frontier]
     if fname in ("eq", "le", "lt", "ge", "gt"):
         # eq(pred, v1, v2, ...) matches ANY listed value (reference parses the
         # multi-value form on root and frontier paths alike)

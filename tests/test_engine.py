@@ -508,3 +508,17 @@ def test_list_value_predicates():
     n.mutate(del_nquads=f'<{ju}> <nick> * .', commit_now=True)
     out, _ = n.query('{ q(func: has(nick)) { uid } }')
     assert out == {}
+
+
+def test_value_edge_facets():
+    from dgraph_tpu.api.server import Node
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    n.mutate(set_nquads='_:a <name> "Fay" (since=2021-01-01T00:00:00, '
+                        'by="import") .', commit_now=True)
+    out, _ = n.query('{ q(func: eq(name, "Fay")) { name @facets } }')
+    row = out["q"][0]
+    assert row["name"] == "Fay" and row["name|by"] == "import"
+    assert row["name|since"].startswith("2021-01-01")
+    out, _ = n.query('{ q(func: eq(name, "Fay")) { name @facets(src: by) } }')
+    assert out["q"][0] == {"name": "Fay", "name|src": "import"}
