@@ -1,0 +1,58 @@
+"""Seeded grammar fuzz: parsers must either succeed or raise their own
+error type — never crash with anything else (the reference's lexer/parser
+fuzz posture; `go test -fuzz` analog, bounded for CI)."""
+
+import random
+
+import pytest
+
+from dgraph_tpu.query import dql, rdf
+
+N = 3000
+
+
+def test_dql_parser_never_crashes():
+    rng = random.Random(7)
+    frags = ['{', '}', '(', ')', 'q', 'func:', 'eq', 'name', '"x"', 'uid',
+             '0x1', '@filter', '@facets', 'orderasc:', 'val', 'as', 'v',
+             'math', '+', '<p>', '~', 'count', 'first:', '3', ',', ':', '@',
+             '.', 'le', '[', ']', 'upsert', 'mutation', 'set', '@if', 'len',
+             'shortest', 'from:', 'to:', 'expand', '_all_', '*', '/re/',
+             '$var', 'schema', 'pred:']
+    for _ in range(N):
+        s = " ".join(rng.choice(frags)
+                     for _ in range(rng.randint(1, 24)))
+        try:
+            dql.parse(s)
+        except (dql.ParseError, RecursionError):
+            pass
+
+
+def test_rdf_parser_never_crashes():
+    rng = random.Random(11)
+    frags = ['<0x1>', '_:a', '<name>', '"val"', '"v"@fr', '"3"^^<xs:int>',
+             '*', '.', '(', ')', 'k=1', 'k="s"', ',', '<', '>', '"', '\\',
+             '@', '^^', '<geo:geojson>', '# comment', 'uid(v)', 'val(x)',
+             '_:', '0x']
+    for _ in range(N):
+        s = " ".join(rng.choice(frags)
+                     for _ in range(rng.randint(1, 14)))
+        try:
+            rdf.parse(s)
+        except rdf.RDFError:
+            pass
+
+
+def test_schema_parser_never_crashes():
+    from dgraph_tpu.utils import schema as sch
+    rng = random.Random(13)
+    frags = ['name', ':', 'string', 'int', 'uid', '[', ']', '@index', '(',
+             ')', 'term', 'exact', ',', '@reverse', '@count', '@lang',
+             '@upsert', '.', '<p>', 'geo', 'password', 'bogus']
+    for _ in range(N):
+        s = " ".join(rng.choice(frags)
+                     for _ in range(rng.randint(1, 12)))
+        try:
+            sch.parse_schema(s)
+        except ValueError:      # schema errors are ValueError subclasses
+            pass
