@@ -260,3 +260,26 @@ def test_geojson_convert_roundtrip(tmp_path):
                         '{ name pop coastal } }')
     assert res == {"q": [{"name": "SF", "pop": 880000, "coastal": True}]}
     node.close()
+
+
+def test_export_roundtrip_list_values_and_value_facets(tmp_path):
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.loader.export import export_rdf
+    from dgraph_tpu.loader.live import live_load
+
+    n = Node(str(tmp_path / "a"))
+    n.alter(schema_text="nick: [string] @index(term) .\n"
+                        "name: string @index(exact) .")
+    n.mutate(set_nquads='_:a <name> "Jay" (src="x") .\n'
+                        '_:a <nick> "jj" .\n_:a <nick> "jbird" .',
+             commit_now=True)
+    out = str(tmp_path / "dump.rdf.gz")
+    export_rdf(n.store, out, schema_path=str(tmp_path / "s.txt"))
+    n2 = Node(str(tmp_path / "b"))
+    n2.alter(schema_text=(tmp_path / "s.txt").read_text())
+    live_load(n2, [out])
+    q, _ = n2.query('{ q(func: eq(name, "Jay")) { name @facets nick } }')
+    assert sorted(q["q"][0]["nick"]) == ["jbird", "jj"]
+    assert q["q"][0]["name|src"] == "x"
+    n.close()
+    n2.close()
