@@ -57,6 +57,7 @@ class TxnContext:
     overlay: tuple[int, dict] | None = None  # (version, {attr: PredData})
     inflight: int = 0          # mutations mid-apply; commit/abort wait on 0
     finishing: bool = False    # commit/abort started: reject new mutations
+    last_active: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -133,6 +134,10 @@ class Node:
     # committed/discarded) are reaped once this many accumulate, else they
     # pin the oracle's conflict-GC watermark forever
     MAX_IDLE_TXNS = 1024
+    # a pristine txn younger than this is never reaped: a slow-but-live
+    # client that opened via a query and mutates later must not get
+    # "unknown txn" just because 1024 other txns arrived in between
+    IDLE_TXN_GRACE_S = 60.0
 
     def new_txn(self) -> TxnContext:
         st = self.zero.oracle.new_txn()
@@ -140,13 +145,23 @@ class Node:
         with self._lock:
             self._txns[st.start_ts] = ctx
             if len(self._txns) > self.MAX_IDLE_TXNS:
-                # oldest pristine txns (no buffered writes) abort harmlessly:
-                # a later commit on one returns "unknown txn", same as the
-                # reference's expired-txn behavior
-                idle = sorted(ts for ts, c in self._txns.items()
-                              if not c.keys and not c.inflight
-                              and ts != st.start_ts)
-                for ts in idle[: len(idle) // 2]:
+                # pristine txns (no buffered writes) past the grace period
+                # abort harmlessly, oldest-activity first: a later commit on
+                # one returns "unknown txn", same as the reference's
+                # expired-txn behavior
+                cutoff = time.monotonic() - self.IDLE_TXN_GRACE_S
+                pristine = sorted(
+                    (ts for ts, c in self._txns.items()
+                     if not c.keys and not c.inflight and ts != st.start_ts),
+                    key=lambda ts: self._txns[ts].last_active)
+                idle = [ts for ts in pristine
+                        if self._txns[ts].last_active < cutoff]
+                if not idle and len(self._txns) > 4 * self.MAX_IDLE_TXNS:
+                    # burst pressure: >4x the soft bound opened inside one
+                    # grace window — the bound (it protects the oracle's
+                    # conflict-GC watermark) beats the grace period
+                    idle = pristine
+                for ts in idle[: max(len(idle) // 2, 1)]:
                     del self._txns[ts]
                     self.zero.oracle.abort(ts)
         return ctx
@@ -249,6 +264,7 @@ class Node:
             # see its uncommitted writes
             ctx = self._txns.get(start_ts) if start_ts is not None else None
             if ctx is not None:
+                ctx.last_active = time.monotonic()
                 # drain this txn's in-flight applies: the overlay build reads
                 # the uncommitted layer dicts a concurrent apply mutates
                 while ctx.inflight:
@@ -426,6 +442,7 @@ class Node:
                 # and orphan uncommitted layers (advisor r2 invariant, now
                 # kept WITHOUT serializing all mutations behind one lock)
                 ctx.inflight += 1
+                ctx.last_active = time.monotonic()
             applied = False
             try:
                 uid_map = mut.assign_uids(nquads_set + nquads_del,

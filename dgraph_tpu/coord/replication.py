@@ -146,7 +146,12 @@ class FollowerReader:
             ts = self.store.max_seen_commit_ts
             snap = self._snap if self._snap_version == ver else None
         if snap is None:
-            snap = build_snapshot(self.store, read_ts=ts + 1)
+            # read_ts = ts, NOT ts + 1: visibility is commit_ts <= read_ts,
+            # so ts already covers every record captured under the lock.
+            # ts + 1 raced with a concurrent apply landing at exactly ts + 1
+            # mid-build — part of that transaction could become visible and
+            # the torn snapshot would then be cached for this version.
+            snap = build_snapshot(self.store, read_ts=ts)
             with self._lock:
                 if self._snap_version < ver or self._snap is None:
                     self._snap, self._snap_version = snap, ver

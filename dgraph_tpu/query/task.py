@@ -74,7 +74,7 @@ class TaskResult:
 
 def rows_for_uids(csr: PredCSR, uids: np.ndarray) -> np.ndarray:
     """Map subject uids to CSR rows; missing subjects → sentinel."""
-    subjects = np.asarray(csr.subjects)
+    subjects, _ = csr.host_arrays()
     pos = np.searchsorted(subjects, uids)
     pos_c = np.clip(pos, 0, max(len(subjects) - 1, 0))
     ok = len(subjects) > 0 and subjects[pos_c] == uids
@@ -82,7 +82,13 @@ def rows_for_uids(csr: PredCSR, uids: np.ndarray) -> np.ndarray:
 
 
 def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np.ndarray], int]:
-    """uidMatrix for a frontier over one adjacency; device gather + host split."""
+    """uidMatrix for a frontier over one adjacency; device gather + host split.
+
+    Two-pass count-then-gather (SURVEY §7): the output capacity is the
+    frontier's exact degree sum (counted on the cached host indptr mirror),
+    rounded to a pow2 capacity class to bound jit recompiles — NOT the
+    predicate's total edge count. A 1-uid frontier on a 16M-edge predicate
+    allocates its own degree, not the whole edge array."""
     if len(uids) == 0 or csr is None:
         return [np.zeros(0, np.int64) for _ in range(len(uids))], 0
     if getattr(csr, "is_dist", False):
@@ -91,10 +97,14 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
         matrix, total = csr.expand_matrix(uids)
     else:
         rows = rows_for_uids(csr, uids)
-        cap = 1 << max(int(np.ceil(np.log2(max(csr.num_edges, 1) + 1))), 4)
+        _, indptr_h = csr.host_arrays()
+        rc = np.clip(rows, 0, max(len(indptr_h) - 2, 0))
+        deg = np.where(rows != us.SENTINEL32, indptr_h[rc + 1] - indptr_h[rc], 0)
+        need = int(deg.sum())
+        cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
         res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=cap)
         total = int(res.total)
-        if total > cap:  # capacity-class retry (cannot happen: cap >= num_edges)
+        if total > cap:  # capacity-class retry (cannot happen: cap >= degree sum)
             res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=total)
         targets = np.asarray(res.targets)[:total].astype(np.int64)
         counts = np.asarray(res.counts)[: len(uids)]
@@ -179,15 +189,25 @@ def _ineq_rows(ti: TokenIndex, op: str, token: bytes) -> list[int]:
     raise TaskError(f"bad inequality {op}")
 
 
+def _stored_values(pd: PredData, u: int) -> list[Val]:
+    """Every stored value of subject u: the full [type] list when present
+    (host_values holds only the first-by-sort representative — a match on
+    ANY element counts), else the scalar, else lang-tagged values. Shared by
+    all lossy-tokenizer post-filters (eq/ineq, regexp, geo)."""
+    vals = list(pd.list_values.get(u, ()))
+    if not vals:
+        sv = pd.host_values.get(u)
+        vals = [sv] if sv is not None else []
+    if not vals and u in pd.lang_values:
+        vals = list(pd.lang_values[u].values())
+    return [v for v in vals if v is not None]
+
+
 def _post_filter_compare(pd: PredData, uids: np.ndarray, op: str, v: Val) -> np.ndarray:
     """Exact re-check for lossy tokenizers (reference worker/task.go:837-919)."""
     keep = []
     for u in uids.tolist():
-        sv = pd.host_values.get(int(u))
-        vals = [sv] if sv is not None else []
-        if not vals and int(u) in pd.lang_values:
-            vals = list(pd.lang_values[int(u)].values())
-        if any(compare_vals(op, x, v) for x in vals if x is not None):
+        if any(compare_vals(op, x, v) for x in _stored_values(pd, int(u))):
             keep.append(u)
     return np.asarray(keep, dtype=np.int64)
 
@@ -286,8 +306,13 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
 
     if (fname in ("eq", "le", "lt", "ge", "gt") and not q.lang
             and pd.num_values_host is not None
+            and not schema.is_list(attr)
             and pd.type_id in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
                                TypeID.DATETIME)):
+        # num_values_host holds ONE representative value per subject, so the
+        # vector fast path is wrong for [type] list predicates (a match on
+        # any element counts) — those fall through to the all-values loop,
+        # which reads pd.list_values.
         # numeric compare on the exact float64 mirror: gather + compare per
         # frontier slot (the indexed-ineq fast path of tokens.go, but as one
         # vector op over the frontier). Exact for INT < 2^53, DATETIME
@@ -521,9 +546,7 @@ def _regexp_func(pd: PredData, schema, pattern: str, flags: str) -> np.ndarray:
         cands = _index_uids_for_rows(ti, list(range(nrows)))
     keep = []
     for u in cands.tolist():
-        sv = pd.host_values.get(int(u))
-        vals = [sv] if sv is not None else list(pd.lang_values.get(int(u), {}).values())
-        if any(v is not None and rx.search(str(v.value)) for v in vals):
+        if any(rx.search(str(v.value)) for v in _stored_values(pd, int(u))):
             keep.append(u)
     return np.asarray(keep, dtype=np.int64)
 
@@ -601,14 +624,13 @@ def _geo_func(pd: PredData, schema, fname: str, args: list) -> np.ndarray:
     cands = _index_uids_for_rows(ti, sorted(rows))
     keep = []
     for u in cands.tolist():
-        sv = pd.host_values.get(int(u))
-        if sv is None:
-            continue
-        stored = sv.value
-        ok = {"near": lambda: geomod.near(stored, g.coords if g.kind == "Point" else next(iter(g.points())), radius or 0.0),
-              "within": lambda: geomod.within(stored, g),
-              "contains": lambda: geomod.contains(stored, g),
-              "intersects": lambda: geomod.intersects(stored, g)}[fname]()
-        if ok:
-            keep.append(u)
+        for sv in _stored_values(pd, int(u)):
+            stored = sv.value
+            ok = {"near": lambda: geomod.near(stored, g.coords if g.kind == "Point" else next(iter(g.points())), radius or 0.0),
+                  "within": lambda: geomod.within(stored, g),
+                  "contains": lambda: geomod.contains(stored, g),
+                  "intersects": lambda: geomod.intersects(stored, g)}[fname]()
+            if ok:
+                keep.append(u)
+                break
     return np.asarray(keep, dtype=np.int64)

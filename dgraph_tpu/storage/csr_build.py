@@ -49,6 +49,7 @@ class PredCSR:
     subjects: jnp.ndarray   # int32[N] sorted
     indptr: jnp.ndarray     # int32[N+1]
     indices: jnp.ndarray    # int32[E] sorted within each row
+    _host: tuple | None = None   # lazy (subjects, indptr) host mirrors
 
     @property
     def num_subjects(self) -> int:
@@ -57,6 +58,13 @@ class PredCSR:
     @property
     def num_edges(self) -> int:
         return int(self.indices.shape[0])
+
+    def host_arrays(self) -> tuple:
+        """(subjects, indptr) as numpy — cached: frontier→row mapping and
+        degree counting run per expand and must not re-fetch from device."""
+        if self._host is None:
+            self._host = (np.asarray(self.subjects), np.asarray(self.indptr))
+        return self._host
 
 
 @dataclass

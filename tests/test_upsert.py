@@ -200,12 +200,22 @@ def test_upsert_explicit_txn_not_autocommitted(node):
 def test_idle_txn_reaping(node):
     node.MAX_IDLE_TXNS = 8
     first = node.new_txn()
+    # age past the grace period (ADVICE r3: young pristine txns are exempt
+    # so a slow-but-live client is never reaped — see test_advice_r3.py)
+    first.last_active -= node.IDLE_TXN_GRACE_S + 1
     for _ in range(16):
         node.new_txn()
-    # the earliest pristine txn was reaped; later commits on it fail cleanly
+    # the earliest stale pristine txn was reaped; later commits fail cleanly
     with pytest.raises(MutationError):
         node.commit(first.start_ts)
-    assert len(node._txns) <= 16
+    assert len(node._txns) <= 17
+
+
+def test_idle_txn_burst_pressure_overrides_grace(node):
+    node.MAX_IDLE_TXNS = 8
+    txns = [node.new_txn() for _ in range(4 * 8 + 2)]
+    # all young, but the hard bound (4x) kicked in: some were reaped
+    assert len(node._txns) < len(txns)
 
 
 def test_bodyless_named_block_still_errors(node):
