@@ -90,7 +90,9 @@ def main():
     g = pb.prep_pull(subjects, indptr, indices, num_nodes)
     seeds_mask = jnp.zeros(num_nodes, dtype=bool).at[jnp.asarray(seeds_np)].set(True)
 
-    run = lambda: pb.k_hop_pull_pallas(g, seeds_mask, hops=HOPS)
+    # seed list enables the hop-1 push fast path (direction-optimizing BFS)
+    run = lambda: pb.k_hop_pull_pallas(g, seeds_mask, hops=HOPS,
+                                       seed_uids=seeds_np)
     res = run()  # compile + warmup
     traversed = int(res.traversed)
 

@@ -37,6 +37,17 @@ def sentinel(dtype) -> np.generic:
     return np.asarray(np.iinfo(np.dtype(dtype)).max, dtype=dtype)[()]
 
 
+def host_rank_of(sorted_arr: np.ndarray, values: np.ndarray,
+                 miss: int) -> np.ndarray:
+    """Position of each value in a sorted host array, `miss` where absent
+    (reference algo/uidlist.go:395 IndexOf, vectorized). The shared helper
+    behind frontier→CSR-row mapping, rank compression, and seed mapping."""
+    pos = np.searchsorted(sorted_arr, values)
+    pos_c = np.clip(pos, 0, max(len(sorted_arr) - 1, 0))
+    ok = (len(sorted_arr) > 0) & (sorted_arr[pos_c] == values)
+    return np.where(ok, pos_c, miss)
+
+
 # ---------------------------------------------------------------------------
 # Construction / host interop
 # ---------------------------------------------------------------------------

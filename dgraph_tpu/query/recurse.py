@@ -5,11 +5,15 @@ level, spawning copies of the original children as the new frontier's
 SubGraphs (:157-164); loop prevention via a reach-set of (attr, from, to)
 edges (:129-141) unless `loop: true`; bounded by the 1e6 edge budget (:167).
 
-TPU shape: each level is one batched CSR expand per traversed predicate; the
-reach-set is a host-side visited-edge filter between device steps (the pure
-device SpMSpV variant with visited bitmaps lives in ops/traversal.py and is
-used by the benchmarks; this path keeps full output semantics — per-level
-nested results with value children).
+TPU shape: each level is one batched expand per traversed predicate. The
+reach-set is NOT a per-edge Python set: an edge of one predicate is exactly
+one CSR position, so "seen" is a bool mask over the edge array and a level's
+dedup is one vectorized gather + mask update over the cached host CSR mirror
+(r4; the old per-edge dict loop was the engine's recursion bottleneck). The
+pure-device node-visited variant (ops/traversal.k_hop, used by bench and
+dist) intentionally does NOT back this path: recurse's reach-set dedups
+EDGES, so a node reached again over a new edge must re-appear at the deeper
+level in the output tree — node-visited semantics would drop it.
 """
 
 from __future__ import annotations
@@ -22,6 +26,35 @@ from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID
 
 
+def _expand_dedup(csr, frontier: np.ndarray, seen: np.ndarray,
+                  allow_loop: bool) -> tuple[list[np.ndarray], int]:
+    """One level of expansion with first-traversal edge dedup, vectorized:
+    the frontier's CSR edge positions are gathered in one shot, previously
+    seen positions masked out, and the seen mask updated in place."""
+    from dgraph_tpu.ops import uidset as us
+
+    subjects, indptr, indices = csr.host_arrays()
+    rows = us.host_rank_of(subjects, frontier, -1)
+    ok = rows >= 0
+    rc = np.where(ok, rows, 0)
+    starts = np.where(ok, indptr[rc], 0).astype(np.int64)
+    ends = np.where(ok, indptr[rc + 1], 0).astype(np.int64)
+    counts = ends - starts
+    total = int(counts.sum())
+    offs = np.zeros(len(frontier) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offs[1:])
+    pos = np.repeat(starts - offs[:-1], counts) + np.arange(total)
+    if allow_loop:
+        fresh = np.ones(total, dtype=bool)
+    else:
+        fresh = ~seen[pos]
+        seen[pos] = True
+    targets = indices[pos].astype(np.int64)
+    matrix = [targets[offs[i]: offs[i + 1]][fresh[offs[i]: offs[i + 1]]]
+              for i in range(len(frontier))]
+    return matrix, total
+
+
 def recurse(ex, sg: SubGraph) -> None:
     gq = sg.gq
     spec = gq.recurse
@@ -32,8 +65,17 @@ def recurse(ex, sg: SubGraph) -> None:
                         and ex.snap.pred(c.attr).csr is not None)
                     or c.attr.startswith("~")]
     val_children = [c for c in gq.children if c not in uid_children]
-    seen_edges: set[tuple[str, int, int]] = set()
+    seen_masks: dict[str, np.ndarray] = {}     # child attr -> bool[E]
+    seen_edges: set[tuple[str, int, int]] = set()   # dist-CSR fallback only
     edges = 0
+
+    def _csr_for(cgq):
+        attr = cgq.attr
+        rev = attr.startswith("~")
+        pd = ex.snap.pred(attr[1:] if rev else attr)
+        if pd is None:
+            return None
+        return pd.rev_csr if rev else pd.csr
 
     def build_level(frontier: np.ndarray, remaining: int) -> list[SubGraph]:
         nonlocal edges
@@ -53,21 +95,34 @@ def recurse(ex, sg: SubGraph) -> None:
             return out
         for cgq in uid_children:
             child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-            res = ex._dispatch(TaskQuery(cgq.attr, frontier=frontier))
-            edges += res.traversed_edges
-            if edges > MAX_QUERY_EDGES:
-                raise QueryError("recurse exceeded edge budget (ErrTooBig)")
-            # loop prevention: drop edges already reached
-            matrix = []
-            for u, targets in zip(frontier, res.uid_matrix):
-                kept = []
-                for t in targets:
-                    ek = (cgq.attr, int(u), int(t))
-                    if not spec.allow_loop and ek in seen_edges:
-                        continue
-                    seen_edges.add(ek)
-                    kept.append(int(t))
-                matrix.append(np.asarray(kept, dtype=np.int64))
+            csr = _csr_for(cgq)
+            if csr is not None and not getattr(csr, "is_dist", False):
+                if cgq.attr not in seen_masks:
+                    seen_masks[cgq.attr] = np.zeros(csr.num_edges, dtype=bool)
+                matrix, total = _expand_dedup(
+                    csr, frontier, seen_masks[cgq.attr], spec.allow_loop)
+                edges += total
+                if edges > MAX_QUERY_EDGES:
+                    raise QueryError(
+                        "recurse exceeded edge budget (ErrTooBig)")
+            else:
+                # tablet-routed / missing CSR: expand over the wire, dedup
+                # on (attr, from, to) keys (reference recurse.go:129-141)
+                res = ex._dispatch(TaskQuery(cgq.attr, frontier=frontier))
+                edges += res.traversed_edges
+                if edges > MAX_QUERY_EDGES:
+                    raise QueryError(
+                        "recurse exceeded edge budget (ErrTooBig)")
+                matrix = []
+                for u, targets in zip(frontier, res.uid_matrix):
+                    kept = []
+                    for t in targets:
+                        ek = (cgq.attr, int(u), int(t))
+                        if not spec.allow_loop and ek in seen_edges:
+                            continue
+                        seen_edges.add(ek)
+                        kept.append(int(t))
+                    matrix.append(np.asarray(kept, dtype=np.int64))
             child.uid_matrix = matrix
             child.counts = [len(m) for m in matrix]
             child.dest_uids = (np.unique(np.concatenate(matrix))

@@ -7,11 +7,12 @@ expansion (expandOut :134-261); edge cost from a facet else 1.0 (getCost
 item; capped by QueryEdgeLimit returning ErrTooBig (:214); result
 materialized as a `_path_` block (:598).
 
-TPU shape: the expansion is batched CSR expands per predicate per level (the
-reference issued one ProcessGraph per level); the settled-cost relaxation for
-the *benchmark* path runs fully on device as iterative SpMSpV in
-ops/traversal.py. This module keeps exact k-path semantics (paths with
-facet-weighted costs, min/maxweight pruning).
+TPU shape: a single-predicate unweighted `shortest` runs FULLY ON DEVICE —
+ops/traversal.sssp iterated edge relaxation over the predicate's resident
+CSR, parent chain walked host-side afterwards (r4; replaces the reference's
+per-level expandOut + host Dijkstra for the common case). Facet-weighted
+costs, multi-predicate blocks, child filters, and k-shortest keep the exact
+host path: the expansion there is still batched CSR expands per level.
 """
 
 from __future__ import annotations
@@ -82,20 +83,79 @@ def _build_adjacency(ex, sg: SubGraph, src: int, dst: int):
     return adj
 
 
+def _device_csr(ex, sg: SubGraph):
+    """The single predicate CSR eligible for the device sssp path, or None.
+
+    Eligible: one uid child, no facet cost key, no child filter, no lang,
+    numpaths <= 1, predicate CSR resident on THIS device (tablet-routed
+    DistPredCSR falls back to the per-level wire expansion)."""
+    spec = sg.gq.shortest
+    if spec.numpaths > 1 or len(sg.gq.children) != 1:
+        return None
+    cgq = sg.gq.children[0]
+    if cgq.filter is not None or cgq.lang:
+        return None
+    if cgq.facets is not None and cgq.facets.keys:
+        return None
+    rev = cgq.attr.startswith("~")
+    pd = ex.snap.pred(cgq.attr[1:] if rev else cgq.attr)
+    if pd is None:
+        return None
+    csr = pd.rev_csr if rev else pd.csr
+    if csr is None or getattr(csr, "is_dist", False):
+        return None
+    return cgq.attr, csr
+
+
+def _device_shortest(attr: str, csr, src: int, dst: int, max_depth: int):
+    """Unweighted single-source shortest path as device edge relaxation
+    (ops/traversal.sssp — Bellman-Ford SpMSpV under jit), parent chain
+    walked on host. Work is bounded by iterations x E (the resident CSR),
+    so the reference's discovered-edge budget does not apply here."""
+    from dgraph_tpu.ops import traversal
+
+    subjects, indptr, indices = csr.host_arrays()
+    hi = max(int(subjects[-1]) if len(subjects) else 0,
+             int(indices.max()) if len(indices) else 0)
+    if src > hi or dst > hi:
+        return None              # endpoint outside this predicate's uid space
+    # pow2 capacity class: snapshot-to-snapshot uid growth must not retrace
+    num_nodes = 1 << max(int(np.ceil(np.log2(hi + 2))), 4)
+    res = traversal.sssp(csr.subjects, csr.indptr, csr.indices, None,
+                         src, num_nodes=num_nodes, max_iters=max_depth)
+    dist = float(np.asarray(res.dist[dst]))
+    if not np.isfinite(dist):
+        return None
+    parent = np.asarray(res.parent)
+    path = [dst]
+    while path[-1] != src:
+        p = int(parent[path[-1]])
+        if p < 0 or len(path) > max_depth + 1:
+            return None      # broken chain (cannot happen for finite dist)
+        path.append(p)
+    return (dist, path[::-1], [attr] * (len(path) - 1))
+
+
 def shortest_path(ex, sg: SubGraph) -> None:
     spec = sg.gq.shortest
     src = _resolve_end(ex, spec.from_)
     dst = _resolve_end(ex, spec.to)
+    max_depth = spec.depth if spec.depth > 0 else 64
     sg.paths = []
     if src == dst:
         sg.paths = [(0.0, [src], [])]
     else:
-        adj = _build_adjacency(ex, sg, src, dst)
-        if spec.numpaths <= 1:
-            p = _dijkstra(adj, src, dst)
+        dev = _device_csr(ex, sg)
+        if dev is not None:
+            p = _device_shortest(dev[0], dev[1], src, dst, max_depth)
             sg.paths = [p] if p is not None else []
         else:
-            sg.paths = _k_shortest(adj, src, dst, spec.numpaths)
+            adj = _build_adjacency(ex, sg, src, dst)
+            if spec.numpaths <= 1:
+                p = _dijkstra(adj, src, dst)
+                sg.paths = [p] if p is not None else []
+            else:
+                sg.paths = _k_shortest(adj, src, dst, spec.numpaths)
         sg.paths = [p for p in sg.paths
                     if spec.minweight <= p[0] <= spec.maxweight]
     uids = sorted({u for _c, path, _a in sg.paths for u in path})

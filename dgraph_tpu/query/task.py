@@ -74,11 +74,8 @@ class TaskResult:
 
 def rows_for_uids(csr: PredCSR, uids: np.ndarray) -> np.ndarray:
     """Map subject uids to CSR rows; missing subjects → sentinel."""
-    subjects, _ = csr.host_arrays()
-    pos = np.searchsorted(subjects, uids)
-    pos_c = np.clip(pos, 0, max(len(subjects) - 1, 0))
-    ok = len(subjects) > 0 and subjects[pos_c] == uids
-    return np.where(ok, pos_c, us.SENTINEL32).astype(np.int32)
+    subjects = csr.host_arrays()[0]
+    return us.host_rank_of(subjects, uids, us.SENTINEL32).astype(np.int32)
 
 
 def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np.ndarray], int]:
@@ -97,7 +94,7 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
         matrix, total = csr.expand_matrix(uids)
     else:
         rows = rows_for_uids(csr, uids)
-        _, indptr_h = csr.host_arrays()
+        indptr_h = csr.host_arrays()[1]
         rc = np.clip(rows, 0, max(len(indptr_h) - 2, 0))
         deg = np.where(rows != us.SENTINEL32, indptr_h[rc + 1] - indptr_h[rc], 0)
         need = int(deg.sum())

@@ -49,7 +49,7 @@ class PredCSR:
     subjects: jnp.ndarray   # int32[N] sorted
     indptr: jnp.ndarray     # int32[N+1]
     indices: jnp.ndarray    # int32[E] sorted within each row
-    _host: tuple | None = None   # lazy (subjects, indptr) host mirrors
+    _host: tuple | None = None   # lazy (subjects, indptr, indices) mirrors
 
     @property
     def num_subjects(self) -> int:
@@ -60,10 +60,12 @@ class PredCSR:
         return int(self.indices.shape[0])
 
     def host_arrays(self) -> tuple:
-        """(subjects, indptr) as numpy — cached: frontier→row mapping and
-        degree counting run per expand and must not re-fetch from device."""
+        """(subjects, indptr, indices) as numpy — cached: frontier→row
+        mapping, degree counting, and recurse edge-dedup run per expand and
+        must not re-fetch from device."""
         if self._host is None:
-            self._host = (np.asarray(self.subjects), np.asarray(self.indptr))
+            self._host = (np.asarray(self.subjects), np.asarray(self.indptr),
+                          np.asarray(self.indices))
         return self._host
 
 

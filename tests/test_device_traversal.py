@@ -1,0 +1,131 @@
+"""Device-path traversal in the real engine (VERDICT r3 item #2).
+
+- `shortest` with one unweighted predicate executes via ops/traversal.sssp
+  (device Bellman-Ford) and must return the same cost/path as the host
+  Dijkstra; facet costs and multi-predicate blocks keep the host path.
+- `@recurse` uses the vectorized CSR edge-position dedup; a node reached
+  again over a NEW edge must still re-appear at the deeper level (edge-level
+  reach-set semantics, query/recurse.go:129-141) — the reason node-visited
+  BFS cannot back this path.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import shortest as shortestmod
+
+
+@pytest.fixture()
+def chain_node():
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .\nnext: uid .\n"
+                        "alt: uid .\nweight: int .")
+    # unique shortest path 1 -> 2 -> 3 -> 4 plus a longer detour 1 -> 5 -> 6 -> 7 -> 4
+    quads = []
+    for a, b in [(1, 2), (2, 3), (3, 4), (1, 5), (5, 6), (6, 7), (7, 4)]:
+        quads.append(f"<0x{a:x}> <next> <0x{b:x}> .")
+    for u in range(1, 8):
+        quads.append(f'<0x{u:x}> <name> "n{u}" .')
+    n.mutate(set_nquads="\n".join(quads), commit_now=True)
+    return n
+
+
+def test_shortest_uses_device_sssp(chain_node, monkeypatch):
+    calls = []
+    from dgraph_tpu.ops import traversal
+    real = traversal.sssp
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(traversal, "sssp", spy)
+    out, _ = chain_node.query(
+        "{ p as shortest(from: 0x1, to: 0x4) { next } "
+        "  q(func: uid(p)) { name } }")
+    assert calls, "device sssp path was not taken"
+    assert [x["name"] for x in out["q"]] == ["n1", "n2", "n3", "n4"]
+    path = out["_path_"][0]
+    assert path["_weight_"] == 3.0
+    assert path["uid"] == "0x1"
+
+
+def test_shortest_device_matches_host(chain_node):
+    sgq = "{ p as shortest(from: 0x1, to: 0x4) { next } q(func: uid(p)) { name } }"
+    dev_out, _ = chain_node.query(sgq)
+
+    # force the host path by disabling eligibility
+    orig = shortestmod._device_csr
+    shortestmod._device_csr = lambda ex, sg: None
+    try:
+        host_out, _ = chain_node.query(sgq)
+    finally:
+        shortestmod._device_csr = orig
+    assert dev_out == host_out
+
+
+def test_shortest_unreachable_device(chain_node):
+    out, _ = chain_node.query(
+        "{ p as shortest(from: 0x4, to: 0x1) { next } q(func: uid(p)) { name } }")
+    assert out.get("q", []) == [] and "_path_" not in out
+
+
+def test_shortest_facet_cost_falls_back_to_host(monkeypatch):
+    n = Node()
+    n.alter(schema_text="road: uid .")
+    n.mutate(set_nquads="""
+        <0x1> <road> <0x2> (w=1) .
+        <0x2> <road> <0x3> (w=1) .
+        <0x1> <road> <0x3> (w=9) .
+    """, commit_now=True)
+    from dgraph_tpu.ops import traversal
+
+    def boom(*a, **kw):
+        raise AssertionError("device path must not run for facet costs")
+
+    monkeypatch.setattr(traversal, "sssp", boom)
+    out, _ = n.query(
+        "{ p as shortest(from: 0x1, to: 0x3) { road @facets(w) } "
+        "  q(func: uid(p)) { uid } }")
+    # weighted: the 2-hop w=1+1 path beats the direct w=9 edge
+    assert out["_path_"][0]["_weight_"] == 2.0
+    assert [x["uid"] for x in out["q"]] == ["0x1", "0x2", "0x3"]
+
+
+def test_recurse_edge_dedup_reappearing_node():
+    """Node 3 is reached at depth 1 (1->3) and AGAIN at depth 2 via the new
+    edge 2->3; edge-level dedup must show it at both levels."""
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .\nfollows: [uid] .")
+    n.mutate(set_nquads="""
+        <0x1> <follows> <0x2> .
+        <0x1> <follows> <0x3> .
+        <0x2> <follows> <0x3> .
+        <0x1> <name> "a" . <0x2> <name> "b" . <0x3> <name> "c" .
+    """, commit_now=True)
+    out, _ = n.query(
+        '{ q(func: uid(0x1)) @recurse(depth: 5) { name follows } }')
+    root = out["q"][0]
+    by_name = {c["name"]: c for c in root["follows"]}
+    assert set(by_name) == {"b", "c"}
+    # node c re-appears UNDER b (new edge 0x2->0x3), even though it was
+    # already reached directly from the root
+    assert [g["name"] for g in by_name["b"].get("follows", [])] == ["c"]
+
+
+def test_recurse_budget_still_enforced():
+    from dgraph_tpu.query import recurse as recmod
+
+    n = Node()
+    n.alter(schema_text="follows: [uid] .")
+    quads = [f"<0x{a:x}> <follows> <0x{b:x}> ."
+             for a in range(1, 30) for b in range(1, 30) if a != b]
+    n.mutate(set_nquads="\n".join(quads), commit_now=True)
+    old = recmod.MAX_QUERY_EDGES
+    recmod.MAX_QUERY_EDGES = 10
+    try:
+        with pytest.raises(Exception, match="ErrTooBig|edge budget"):
+            n.query('{ q(func: uid(0x1)) @recurse(depth: 10) { follows } }')
+    finally:
+        recmod.MAX_QUERY_EDGES = old

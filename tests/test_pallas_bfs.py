@@ -49,6 +49,12 @@ def run_both(subjects, indptr, indices, seed_uids, num_nodes, hops):
         subjects, indptr, indices, seed_uids, num_nodes, hops)
     np.testing.assert_array_equal(np.asarray(res.visited), h_visited)
     assert int(res.traversed) == h_traversed
+    # push fast path (explicit seed list) must agree with the mask-only run
+    res_p = pb.k_hop_pull_pallas(
+        g, seeds_mask, hops=hops,
+        seed_uids=np.asarray(seed_uids, dtype=np.int64))
+    np.testing.assert_array_equal(np.asarray(res_p.visited), h_visited)
+    assert int(res_p.traversed) == h_traversed
     return res
 
 
@@ -108,11 +114,17 @@ def test_chunk_boundary_num_nodes(rng, delta):
 
 
 def test_multi_chunk_bitmap(rng):
-    """3+ bitmap chunks with edges crossing chunk boundaries."""
+    """3+ bitmap chunks with edges crossing chunk boundaries. The chunk
+    space is SOURCE-RANK-compressed, so >= 2*NODES_PER_CHUNK distinct
+    sources are needed to exercise the multi-chunk path."""
     num_nodes = pb.NODES_PER_CHUNK * 2 + 123
-    src = rng.integers(0, num_nodes, size=20000)
+    n_edges = pb.NODES_PER_CHUNK * 2 + 40000
+    # every node appears as a source at least once -> Ns == num_nodes
+    src = np.concatenate([np.arange(num_nodes),
+                          rng.integers(0, num_nodes,
+                                       size=n_edges - num_nodes)])
     # half the edges deliberately cross into a different chunk
-    dst = (src + pb.NODES_PER_CHUNK + rng.integers(0, 100, size=20000)) % num_nodes
+    dst = (src + pb.NODES_PER_CHUNK + rng.integers(0, 100, size=n_edges)) % num_nodes
     order = np.lexsort((dst, src))
     src, dst = src[order], dst[order]
     subjects, counts = np.unique(src, return_counts=True)
@@ -205,3 +217,25 @@ def test_matches_xla_pull_path(rng):
     np.testing.assert_array_equal(np.asarray(res.visited),
                                   np.asarray(ref.visited))
     assert int(res.traversed) == int(ref.traversed)
+
+
+def test_duplicate_seed_uids_not_overcounted(rng):
+    """A repeated seed must not be expanded once per occurrence (review r4)."""
+    subjects = np.array([0, 1])
+    indptr = np.array([0, 1, 2])
+    indices = np.array([1, 2])
+    g = pb.prep_pull(subjects, indptr, indices, 4)
+    mask = jnp.zeros(4, dtype=bool).at[0].set(True)
+    res = pb.k_hop_pull_pallas(g, mask, hops=1, seed_uids=np.array([0, 0, 0]))
+    assert int(res.traversed) == 1
+
+
+def test_hops_zero_returns_seeds_as_frontier(rng):
+    subjects = np.array([0])
+    indptr = np.array([0, 1])
+    indices = np.array([1])
+    g = pb.prep_pull(subjects, indptr, indices, 4)
+    mask = jnp.zeros(4, dtype=bool).at[0].set(True)
+    res = pb.k_hop_pull_pallas(g, mask, hops=0)
+    np.testing.assert_array_equal(np.asarray(res.frontier), np.asarray(mask))
+    assert int(res.traversed) == 0
