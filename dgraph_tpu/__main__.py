@@ -114,14 +114,28 @@ def cmd_live(args) -> int:
 
 def cmd_worker(args) -> int:
     """Serve one group's tablets over the internal wire protocol
-    (the reference's worker gRPC on port 7080)."""
+    (the reference's worker gRPC on port 7080). With --zero it registers
+    with the cluster coordinator (worker/groups.go:62 StartRaftNodes's
+    connect step); replication roles arrive via the Promote RPC."""
     import time
 
     from dgraph_tpu.parallel.remote import serve_worker
     from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
 
     store = Store(args.postings)
+    if args.schema:
+        with open(args.schema) as f:
+            for e in parse_schema(f.read()):
+                store.set_schema(e)
     server, port = serve_worker(store, f"{args.host}:{args.port}")
+    if args.zero:
+        from dgraph_tpu.coord.zero_service import ZeroClient
+
+        zc = ZeroClient(args.zero)
+        group, rid = zc.connect(f"{args.host}:{port}", args.group)
+        zc.close()
+        print(f"worker joined group {group} as replica {rid}", flush=True)
     print(f"worker serving {len(store.predicates())} tablets on "
           f"{args.host}:{port}", flush=True)
     try:
@@ -132,6 +146,29 @@ def cmd_worker(args) -> int:
     finally:
         server.stop(0)
         store.close()
+    return 0
+
+
+def cmd_zero(args) -> int:
+    """Run the cluster coordinator as its own process (reference
+    `dgraph zero`, dgraph/cmd/zero/run.go:58): timestamp/uid leases, the
+    SSI oracle, and the tablet map over the internal protocol."""
+    import time
+
+    from dgraph_tpu.coord.zero import Zero
+    from dgraph_tpu.coord.zero_service import serve_zero
+
+    zero = Zero(n_groups=args.groups)
+    server, port = serve_zero(zero, f"{args.host}:{args.port}")
+    print(f"zero serving {args.groups} groups on {args.host}:{port}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop(0)
     return 0
 
 
@@ -221,7 +258,19 @@ def main(argv=None) -> int:
     wp.add_argument("--host", default="127.0.0.1")
     wp.add_argument("--port", type=int, default=7080)
     wp.add_argument("-p", "--postings", required=True)
+    wp.add_argument("--schema", default=None, help="schema file to apply")
+    wp.add_argument("--zero", default=None,
+                    help="zero address to register with (host:port)")
+    wp.add_argument("--group", type=int, default=-1,
+                    help="group to join (-1 = let zero assign)")
     wp.set_defaults(fn=cmd_worker)
+
+    zp = sub.add_parser("zero", help="run the cluster coordinator process")
+    zp.add_argument("--host", default="127.0.0.1")
+    zp.add_argument("--port", type=int, default=5080)
+    zp.add_argument("--groups", type=int, default=1,
+                    help="number of server groups to balance tablets over")
+    zp.set_defaults(fn=cmd_zero)
 
     cp = sub.add_parser("convert", help="GeoJSON -> RDF (.rdf.gz)")
     cp.add_argument("--geo", required=True, help="GeoJSON file (optionally .gz)")
@@ -230,7 +279,7 @@ def main(argv=None) -> int:
                     help="predicate for geometries")
     cp.set_defaults(fn=cmd_convert)
 
-    for sp_ in (sp, bp, ep, lp, cp, wp):
+    for sp_ in (sp, bp, ep, lp, cp, wp, zp):
         _apply_env_defaults(sp_)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,212 @@
+"""Zero as its own process: the coordinator's gRPC surface + client stub.
+
+Reference semantics: `dgraph zero` is a separate Raft-backed service
+(dgraph/cmd/zero/zero.go:328 Connect, oracle.go:276 commit, assign.go:65
+leases, protos/internal.proto:370-379 service Zero). This exposes the
+library Zero (coord/zero.py — oracle, uid lease, tablet map) over the
+internal wire protocol so worker and client processes coordinate through
+RPCs instead of shared memory. Single-instance (the library object IS the
+replicated state machine's apply target; multi-zero Raft is out of scope —
+the in-process quorum story lives in coord/replication.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+
+try:
+    import grpc
+except ImportError:              # pragma: no cover
+    grpc = None
+
+from ..protos import internal_pb2 as ipb
+from .zero import TxnConflict, TxnNotFound, Zero
+
+SERVICE = "dgraph_tpu.internal.Zero"
+
+
+class ZeroService:
+    """gRPC handlers over one Zero instance."""
+
+    def __init__(self, zero: Zero) -> None:
+        self.zero = zero
+        self._lock = threading.Lock()
+        self._members: dict[int, list[str]] = {}   # group -> member addrs
+
+    # -- membership ----------------------------------------------------------
+
+    def connect(self, msg: ipb.ZeroConnectRequest, ctx) -> ipb.ZeroConnectResponse:
+        """Assign a joining worker to a group (zero.go:328-434: fill groups
+        round-robin; an explicit group joins as another replica of it)."""
+        with self._lock:
+            if msg.group >= 0:
+                g = int(msg.group)
+            else:
+                sizes = {g: len(a) for g, a in self._members.items()}
+                for g in range(self.zero.n_groups):
+                    sizes.setdefault(g, 0)
+                g = min(sizes, key=lambda k: (sizes[k], k))
+            members = self._members.setdefault(g, [])
+            if msg.addr and msg.addr not in members:
+                members.append(msg.addr)
+            rid = members.index(msg.addr) if msg.addr in members else 0
+            return ipb.ZeroConnectResponse(group=g, replica_id=rid)
+
+    # -- leases --------------------------------------------------------------
+
+    def new_txn(self, msg: ipb.ZeroLeaseRequest, ctx) -> ipb.ZeroLeaseResponse:
+        return ipb.ZeroLeaseResponse(
+            first=self.zero.oracle.new_txn().start_ts)
+
+    def timestamps(self, msg: ipb.ZeroLeaseRequest, ctx) -> ipb.ZeroLeaseResponse:
+        return ipb.ZeroLeaseResponse(
+            first=self.zero.oracle.timestamps(max(1, int(msg.n))))
+
+    def assign_uids(self, msg: ipb.ZeroLeaseRequest, ctx) -> ipb.ZeroLeaseResponse:
+        first, _last = self.zero.uids.assign(max(1, int(msg.n)))
+        return ipb.ZeroLeaseResponse(first=first)
+
+    # -- oracle --------------------------------------------------------------
+
+    def commit_or_abort(self, msg: ipb.ZeroCommitRequest,
+                        ctx) -> ipb.ZeroCommitResponse:
+        """Track the txn's conflict keys then decide (oracle.go:276-320;
+        the client sends keys collected from every group's Mutate reply)."""
+        start_ts = int(msg.start_ts)
+        if msg.abort:
+            self.zero.oracle.abort(start_ts)
+            return ipb.ZeroCommitResponse(commit_ts=0, aborted=True)
+        try:
+            self.zero.oracle.track(start_ts, list(msg.conflict_keys),
+                                   list(msg.preds))
+            commit_ts = self.zero.oracle.commit(start_ts)
+            return ipb.ZeroCommitResponse(commit_ts=commit_ts, aborted=False)
+        except TxnConflict:
+            return ipb.ZeroCommitResponse(commit_ts=0, aborted=True)
+        except TxnNotFound as e:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+    # -- tablets -------------------------------------------------------------
+
+    def should_serve(self, msg: ipb.ZeroTabletRequest,
+                     ctx) -> ipb.ZeroTabletResponse:
+        if msg.read_only:
+            g = self.zero.tablets().get(msg.attr)
+            return ipb.ZeroTabletResponse(group=-1 if g is None else g)
+        return ipb.ZeroTabletResponse(group=self.zero.should_serve(msg.attr))
+
+    def state(self, _msg: ipb.ZeroStateRequest, ctx) -> ipb.ZeroStateResponse:
+        st = self.zero.state()
+        with self._lock:
+            for g, addrs in self._members.items():
+                st["groups"].setdefault(str(g), {})["members"] = list(addrs)
+        st["tabletMap"] = self.zero.tablets()
+        return ipb.ZeroStateResponse(state_json=json.dumps(st))
+
+    def handler(self):
+        def u(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+        return grpc.method_handlers_generic_handler(SERVICE, {
+            "Connect": u(self.connect, ipb.ZeroConnectRequest,
+                         ipb.ZeroConnectResponse),
+            "NewTxn": u(self.new_txn, ipb.ZeroLeaseRequest,
+                        ipb.ZeroLeaseResponse),
+            "Timestamps": u(self.timestamps, ipb.ZeroLeaseRequest,
+                            ipb.ZeroLeaseResponse),
+            "AssignUids": u(self.assign_uids, ipb.ZeroLeaseRequest,
+                            ipb.ZeroLeaseResponse),
+            "CommitOrAbort": u(self.commit_or_abort, ipb.ZeroCommitRequest,
+                               ipb.ZeroCommitResponse),
+            "ShouldServe": u(self.should_serve, ipb.ZeroTabletRequest,
+                             ipb.ZeroTabletResponse),
+            "State": u(self.state, ipb.ZeroStateRequest,
+                       ipb.ZeroStateResponse),
+        })
+
+
+def serve_zero(zero: Zero, addr: str = "localhost:0", max_workers: int = 8):
+    """Start the Zero gRPC server; returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((ZeroService(zero).handler(),))
+    port = server.add_insecure_port(addr)
+    if port == 0:
+        raise RuntimeError(f"could not bind zero listener on {addr}")
+    server.start()
+    return server, port
+
+
+class ZeroClient:
+    """Client stub for a remote Zero — mirrors the library surface the
+    dispatcher and write path consume (tablets/should_serve/oracle calls)."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        self.channel = grpc.insecure_channel(addr)
+
+        def u(name, req_cls, resp_cls):
+            return self.channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString)
+        self._connect = u("Connect", ipb.ZeroConnectRequest,
+                          ipb.ZeroConnectResponse)
+        self._new_txn = u("NewTxn", ipb.ZeroLeaseRequest, ipb.ZeroLeaseResponse)
+        self._timestamps = u("Timestamps", ipb.ZeroLeaseRequest,
+                             ipb.ZeroLeaseResponse)
+        self._assign_uids = u("AssignUids", ipb.ZeroLeaseRequest,
+                              ipb.ZeroLeaseResponse)
+        self._commit = u("CommitOrAbort", ipb.ZeroCommitRequest,
+                         ipb.ZeroCommitResponse)
+        self._should_serve = u("ShouldServe", ipb.ZeroTabletRequest,
+                               ipb.ZeroTabletResponse)
+        self._state = u("State", ipb.ZeroStateRequest, ipb.ZeroStateResponse)
+
+    def connect(self, addr: str, group: int = -1) -> tuple[int, int]:
+        r = self._connect(ipb.ZeroConnectRequest(addr=addr, group=group))
+        return r.group, r.replica_id
+
+    def new_txn(self) -> int:
+        return self._new_txn(ipb.ZeroLeaseRequest(n=1)).first
+
+    def timestamps(self, n: int = 1) -> int:
+        return self._timestamps(ipb.ZeroLeaseRequest(n=n)).first
+
+    def assign_uids(self, n: int) -> int:
+        return self._assign_uids(ipb.ZeroLeaseRequest(n=n)).first
+
+    def commit(self, start_ts: int, conflict_keys, preds) -> int:
+        """Returns commit_ts; raises TxnConflict on SSI abort."""
+        r = self._commit(ipb.ZeroCommitRequest(
+            start_ts=start_ts, conflict_keys=list(conflict_keys),
+            preds=sorted(preds)))
+        if r.aborted:
+            raise TxnConflict(f"txn {start_ts} aborted by oracle")
+        return r.commit_ts
+
+    def abort(self, start_ts: int) -> None:
+        self._commit(ipb.ZeroCommitRequest(start_ts=start_ts, abort=True))
+
+    def should_serve(self, attr: str) -> int:
+        return self._should_serve(ipb.ZeroTabletRequest(attr=attr)).group
+
+    def tablets(self) -> dict[str, int]:
+        return {a: g for a, g in json.loads(
+            self._state(ipb.ZeroStateRequest()).state_json)
+            .get("tabletMap", {}).items()}
+
+    def state(self) -> dict:
+        return json.loads(self._state(ipb.ZeroStateRequest()).state_json)
+
+    # move fences are server-side in this topology
+    def writes_blocked(self, _attr: str) -> bool:
+        return False
+
+    def moving_tablets(self) -> set:
+        return set()
+
+    def close(self) -> None:
+        self.channel.close()

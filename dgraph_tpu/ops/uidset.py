@@ -42,9 +42,12 @@ def host_rank_of(sorted_arr: np.ndarray, values: np.ndarray,
     """Position of each value in a sorted host array, `miss` where absent
     (reference algo/uidlist.go:395 IndexOf, vectorized). The shared helper
     behind frontier→CSR-row mapping, rank compression, and seed mapping."""
+    values = np.asarray(values)
+    if len(sorted_arr) == 0:
+        return np.full(values.shape, miss, dtype=np.int64)
     pos = np.searchsorted(sorted_arr, values)
-    pos_c = np.clip(pos, 0, max(len(sorted_arr) - 1, 0))
-    ok = (len(sorted_arr) > 0) & (sorted_arr[pos_c] == values)
+    pos_c = np.clip(pos, 0, len(sorted_arr) - 1)
+    ok = sorted_arr[pos_c] == values
     return np.where(ok, pos_c, miss)
 
 

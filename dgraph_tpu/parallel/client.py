@@ -1,0 +1,170 @@
+"""Cluster client: DQL + mutations against a multi-PROCESS cluster.
+
+Reference semantics: a dgo/api.Dgraph client talks to any server, which
+coordinates with Zero (timestamps, uid leases, commit decisions) and fans
+sub-queries/mutation slices to the owning groups over the internal
+protocol (edgraph/server.go + worker/*OverNetwork). Here the coordinator
+role runs client-side: every coordination hop — Zero lease/oracle RPCs,
+ServeTask/Mutate/Decide/Sort/Schema to group leaders — crosses a process
+boundary, none of it shared memory.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..coord.zero import TxnConflict
+from ..coord.zero_service import ZeroClient
+from ..query import dql
+from ..query import mutation as mut
+from ..query import rdf
+from ..query.engine import Executor
+from ..storage.csr_build import GraphSnapshot
+from ..storage.postings import Op
+from ..utils.schema import SchemaState, parse_schema
+from .remote import NetworkDispatcher, RemoteWorker
+
+
+class _LeaseAdapter:
+    """assign_uids() expects the UidLease surface; lease blocks over RPC."""
+
+    def __init__(self, zero: ZeroClient) -> None:
+        self.zero = zero
+        self._hwm = 0
+
+    def assign(self, n: int) -> tuple[int, int]:
+        first = self.zero.assign_uids(n)
+        return first, first + n - 1
+
+    def bump_to(self, uid: int) -> None:
+        # explicit client uids: lease past them so blank nodes can't collide
+        if uid > self._hwm:
+            self.zero.assign_uids(max(uid - self._hwm, 1))
+            self._hwm = uid
+
+
+class ClusterClient:
+    """Client of one Zero process + N group replica sets."""
+
+    def __init__(self, zero_addr: str,
+                 groups: dict[int, list[str]]) -> None:
+        """groups: group id -> replica worker addresses (leader discovered
+        via Status polling, re-discovered on failover)."""
+        self.zero = ZeroClient(zero_addr)
+        self.groups = {g: [RemoteWorker(a) for a in addrs]
+                       for g, addrs in groups.items()}
+        self._leases = _LeaseAdapter(self.zero)
+
+    # -- leadership ----------------------------------------------------------
+
+    def leader_of(self, g: int) -> RemoteWorker:
+        """Current leader of a group: the replica reporting leader=True
+        (single-replica groups lead themselves at term 0)."""
+        replicas = self.groups[g]
+        if len(replicas) == 1:
+            return replicas[0]
+        for rw in replicas:
+            try:
+                if rw.status().leader:
+                    return rw
+            except Exception:
+                continue
+        raise RuntimeError(f"group {g} has no live leader")
+
+    # -- schema --------------------------------------------------------------
+
+    def schema(self) -> SchemaState:
+        """Cluster schema via the Schema RPC from every group
+        (worker/schema.go:160 GetSchemaOverNetwork)."""
+        merged = SchemaState()
+        for g in self.groups:
+            try:
+                text = self.leader_of(g).schema()
+            except Exception:
+                continue
+            for e in parse_schema(text):
+                merged.set(e)
+        return merged
+
+    # -- writes --------------------------------------------------------------
+
+    def mutate(self, set_nquads: str = "", del_nquads: str = "",
+               retries: int = 5) -> dict[str, int]:
+        """One txn over the wire: Zero NewTxn → per-group Mutate → Zero
+        CommitOrAbort → per-group Decide. Leader failures retry after
+        re-discovery (the reference client's abort-retry loop)."""
+        nq_set = rdf.parse(set_nquads) if set_nquads else []
+        nq_del = rdf.parse(del_nquads) if del_nquads else []
+        last: Exception | None = None
+        for _attempt in range(retries):
+            try:
+                return self._mutate_once(nq_set, nq_del)
+            except TxnConflict:
+                raise
+            except Exception as e:       # leader died / NoQuorum: retry
+                last = e
+                time.sleep(0.1)
+        raise last if last else RuntimeError("mutate failed")
+
+    def _mutate_once(self, nq_set, nq_del) -> dict[str, int]:
+        start_ts = self.zero.new_txn()
+        uid_map = mut.assign_uids(nq_set + nq_del, self._leases)
+        edges = mut.to_edges(nq_set, uid_map, Op.SET) + \
+            mut.to_edges(nq_del, uid_map, Op.DEL)
+        by_group = mut.split_edges_by_group(
+            edges, len(self.groups), self.zero.should_serve)
+        keys_by_group: dict[int, list[bytes]] = {}
+        conflicts: list[bytes] = []
+        preds: set[str] = set()
+        try:
+            for g, ge in sorted(by_group.items()):
+                resp = self.leader_of(g).mutate(start_ts, ge)
+                keys_by_group[g] = list(resp.keys)
+                conflicts += list(resp.conflict_keys)
+                preds |= set(resp.preds)
+            commit_ts = self.zero.commit(start_ts, conflicts, preds)
+        except TxnConflict:
+            self._decide_all(start_ts, 0, keys_by_group)
+            raise
+        except BaseException:
+            self._decide_all(start_ts, 0, keys_by_group)
+            try:
+                self.zero.abort(start_ts)
+            except Exception:
+                pass
+            raise
+        self._decide_all(start_ts, commit_ts, keys_by_group)
+        return uid_map
+
+    def _decide_all(self, start_ts: int, commit_ts: int,
+                    keys_by_group: dict) -> None:
+        for g, keys in sorted(keys_by_group.items()):
+            try:
+                self.leader_of(g).decide(start_ts, commit_ts, keys)
+            except Exception:
+                if commit_ts:
+                    raise            # a lost commit decision must surface
+                # lost aborts are safe: layers stay buffered until reaped
+
+    # -- reads ---------------------------------------------------------------
+
+    def query(self, q: str, variables: dict | None = None) -> dict:
+        """DQL with every uid/value task dispatched over ServeTask — the
+        client holds NO local tablet (all-remote NetworkDispatcher)."""
+        read_ts = int(self.zero.state().get("maxTxnTs", 0))
+        schema = self.schema()
+        dispatcher = NetworkDispatcher(
+            self.zero, local_group=-1,
+            local_snap_fn=lambda ts: GraphSnapshot(ts),
+            remotes={g: self.leader_of(g) for g in self.groups},
+            schema=schema)
+        snap = GraphSnapshot(read_ts)
+        ex = Executor(snap, schema,
+                      dispatch=lambda tq: dispatcher.process_task(tq, read_ts))
+        return ex.execute(dql.parse(q, variables))
+
+    def close(self) -> None:
+        for rws in self.groups.values():
+            for rw in rws:
+                rw.close()
+        self.zero.close()

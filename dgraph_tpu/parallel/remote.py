@@ -131,11 +131,36 @@ def decode_edge(m: ipb.Edge) -> DirectedEdge:
         if m.facets_json else ())
 
 
+class StaleLeader(Exception):
+    """A deposed leader tried to ship records (term fencing)."""
+
+
+class NoQuorum(Exception):
+    """Not enough live replicas acked an append."""
+
+
 class WorkerService:
     """One group's task server: answers ServeTask against its own store's
-    snapshot at the requested read_ts."""
+    snapshot at the requested read_ts.
+
+    Replication role (worker/draft.go + conn/node.go, process form): a
+    worker starts as a bare store; `Promote(term, peers)` makes it the
+    group leader — every WAL record its store writes is shipped to the
+    peers' Append RPC and acked by a quorum before the local append
+    proceeds (proposeAndWait). Shipping uses a PER-TERM session sequence
+    (not file record counts, which local checkpoint compaction rewrites):
+    followers accept records in session order, a lagging peer is re-fed
+    from a bounded in-memory buffer (Raft's per-peer nextIndex), and a
+    leader that cannot reach a quorum steps down — it must not keep
+    minting indexes its group never accepted. Election is
+    control-plane-driven (Zero/systest promotes the live replica with the
+    highest (max_commit_ts, log_len) — Raft's up-to-date rule)."""
+
+    SHIP_BUFFER = 4096       # catch-up window (records) for lagging peers
 
     def __init__(self, store) -> None:
+        import collections
+        import os
         import threading
 
         from ..storage.csr_build import build_snapshot
@@ -145,6 +170,34 @@ class WorkerService:
         self._lock = threading.Lock()
         self._snap = None
         self._snap_ts = -1
+        # replication role. _rlock guards follower-side state ONLY; the
+        # leader-side _ship path deliberately takes no service lock (it runs
+        # under the store lock — taking _rlock there would ABBA-deadlock
+        # against append(), which takes _rlock then the store lock).
+        self._rlock = threading.RLock()
+        self.is_leader = False
+        self.peers: list["RemoteWorker"] = []
+        self._peer_seq: dict[int, int] = {}      # peer idx -> acked seq
+        self._session_seq = 0                    # this term's shipped count
+        self._last_seq = 0                       # follower: applied seq
+        self._buffer = collections.deque(maxlen=self.SHIP_BUFFER)
+        self._pool = None                        # ship executor
+        self._term_path = (os.path.join(store.dir, "term")
+                           if store.dir else None)
+        self.term = 0
+        if self._term_path and os.path.exists(self._term_path):
+            with open(self._term_path) as f:
+                self.term = int(f.read().strip() or 0)
+
+    def _set_term(self, term: int) -> None:
+        self.term = term
+        if self._term_path:
+            with open(self._term_path, "w") as f:
+                f.write(str(term))
+
+    def _step_down(self) -> None:
+        self.is_leader = False
+        self.store.wal_sink = None
 
     def _snapshot(self, read_ts: int):
         # visibility is commit_ts <= read_ts, so build at eff exactly
@@ -175,6 +228,9 @@ class WorkerService:
         decided later by Decide."""
         from ..query import mutation as mut
 
+        if self.term > 0 and not self.is_leader:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not leader (term {self.term})")
         edges = [decode_edge(e) for e in msg.edges]
         touched, conflict, preds = mut.apply_mutations(
             self.store, edges, msg.start_ts)
@@ -185,6 +241,9 @@ class WorkerService:
                context) -> ipb.DecisionResponse:
         """Commit (commit_ts > 0) or abort this group's buffered layers
         (CommitOverNetwork fan-out)."""
+        if self.term > 0 and not self.is_leader:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not leader (term {self.term})")
         keys = list(msg.keys)
         if msg.commit_ts:
             self.store.commit(msg.start_ts, msg.commit_ts, keys)
@@ -193,6 +252,155 @@ class WorkerService:
         else:
             self.store.abort(msg.start_ts, keys)
         return ipb.DecisionResponse()
+
+    # -- replication (leader ship / follower append) --------------------------
+
+    def promote(self, msg: ipb.PromoteRequest, context) -> ipb.PromoteResponse:
+        """Become this group's leader at `term`, shipping to `peers`."""
+        from concurrent import futures as _futures
+
+        with self._rlock:
+            if msg.term < self.term:
+                return ipb.PromoteResponse(ok=False, term=self.term)
+            self._set_term(int(msg.term))
+            for p in self.peers:
+                p.close()
+            self.peers = [RemoteWorker(a) for a in msg.peers]
+            self._peer_seq = {i: 0 for i in range(len(self.peers))}
+            self._session_seq = 0
+            self._buffer.clear()
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            self._pool = _futures.ThreadPoolExecutor(
+                max_workers=max(len(self.peers), 1))
+            self.is_leader = True
+            self.store.wal_sink = self._ship
+            return ipb.PromoteResponse(ok=True, term=self.term)
+
+    def _ship_to_peer(self, i: int, p: "RemoteWorker",
+                      records: list[tuple[int, bytes]]) -> bool:
+        """Bring one peer up to the latest seq: re-feed anything it is
+        missing from the buffer, then the new record. Returns True when the
+        peer acked through the final seq; StaleLeader propagates."""
+        want = self._peer_seq.get(i, 0) + 1
+        for seq, data in records:
+            if seq < want:
+                continue
+            try:
+                r = p.append(self.term, seq, data)
+            except Exception:
+                return False            # dead peer
+            if not r.ok:
+                if r.term > self.term:
+                    raise StaleLeader(
+                        f"peer at term {r.term} > {self.term}")
+                # genuine gap beyond the buffer window: stays lagging
+                # until the control plane rejoins it with a snapshot
+                return False
+            self._peer_seq[i] = seq
+        return self._peer_seq.get(i, 0) == records[-1][0]
+
+    def _ship(self, data: bytes, sync: bool) -> None:
+        """Deliver one WAL record to all peers concurrently; quorum counts
+        the leader itself. Runs under the store lock (records reach
+        followers in exactly the leader's order) but takes NO service lock
+        — see __init__. A leader that cannot assemble a quorum steps down
+        before raising: continuing to mint sequence numbers its group never
+        accepted would fork the log."""
+        self._session_seq += 1
+        seq = self._session_seq
+        self._buffer.append((seq, data))
+        records = list(self._buffer)
+        peers = list(self.peers)
+        futs = [self._pool.submit(self._ship_to_peer, i, p, records)
+                for i, p in enumerate(peers)]
+        acks, stale = 1, None
+        for f in futs:
+            try:
+                if f.result():
+                    acks += 1
+            except StaleLeader as e:
+                stale = e
+        if stale is not None:
+            self._step_down()
+            raise stale
+        quorum = (len(peers) + 1) // 2 + 1
+        if acks < quorum:
+            self._step_down()
+            raise NoQuorum(
+                f"{acks}/{len(peers) + 1} acks < quorum {quorum}")
+
+    def append(self, msg: ipb.AppendRequest, context) -> ipb.AppendResponse:
+        """Follower side: fence term, enforce session order, make the
+        record durable and live (store.append_replica_record)."""
+        with self._rlock:
+            if msg.term < self.term:
+                return ipb.AppendResponse(ok=False, term=self.term,
+                                          log_len=self._last_seq)
+            if msg.term > self.term:
+                self._set_term(int(msg.term))
+                self._step_down()
+                self._last_seq = 0      # new leader, new session sequence
+            if msg.index != self._last_seq + 1:
+                if msg.index <= self._last_seq:
+                    # duplicate re-feed (leader catch-up overlap): ack it
+                    return ipb.AppendResponse(ok=True, term=self.term,
+                                              log_len=self._last_seq)
+                return ipb.AppendResponse(ok=False, term=self.term,
+                                          log_len=self._last_seq)
+            self.store.append_replica_record(bytes(msg.data))
+            self._last_seq = int(msg.index)
+            with self._lock:
+                self._snap = None       # reads must see the applied record
+            return ipb.AppendResponse(ok=True, term=self.term,
+                                      log_len=self._last_seq)
+
+    def status(self, _msg: ipb.StatusRequest, context) -> ipb.StatusResponse:
+        import os
+
+        size = 0
+        if self.store.dir:
+            wal = os.path.join(self.store.dir, "wal.log")
+            snap = os.path.join(self.store.dir, "snapshot.bin")
+            size = sum(os.path.getsize(p) for p in (wal, snap)
+                       if os.path.exists(p))
+        return ipb.StatusResponse(
+            term=self.term, log_len=self.store.wal_record_count,
+            leader=self.is_leader,
+            max_commit_ts=self.store.max_seen_commit_ts,
+            tablets=self.store.predicates(), tablet_bytes=size)
+
+    # -- distributed sort + schema (worker/sort.go:50, worker/schema.go:160) --
+
+    def sort(self, msg: ipb.SortRequest, context) -> ipb.SortResponse:
+        """Order the candidate uids by this tablet's value order — the
+        owner-side of SortOverNetwork (index walk when a sortable index
+        exists, value sort otherwise)."""
+        from ..query import dql
+        from ..query.engine import Executor
+
+        snap = self._snapshot(msg.read_ts)
+        ex = Executor(snap, self.store.schema)
+        o = dql.Order(attr=msg.attr, desc=msg.desc, lang=msg.lang)
+        uids = _uids_from_bytes(msg.uids)
+        got = None
+        if not msg.lang and msg.need:
+            got = ex._sort_with_index(o, uids, int(msg.need))
+        if got is None:
+            present = [(ex._order_key(o, int(u)), int(u)) for u in uids]
+            have = [(k, u) for k, u in present if k is not None]
+            missing = [u for k, u in present if k is None]
+            have.sort(key=lambda t: t[0], reverse=msg.desc)
+            got = np.asarray([u for _, u in have] + missing, dtype=np.int64)
+        return ipb.SortResponse(uids=_uids_to_bytes(got))
+
+    def schema(self, msg: ipb.SchemaRequest, context) -> ipb.SchemaResponse:
+        """Served tablets' schema entries as schema text lines (the
+        GetSchemaOverNetwork payload; text round-trips parse_schema)."""
+        want = set(msg.preds)
+        lines = [str(e) for e in self.store.schema.entries()
+                 if not want or e.predicate in want]
+        return ipb.SchemaResponse(schema_json=json.dumps(lines))
 
     def handler(self):
         def u(fn, req_cls, resp_cls):
@@ -207,6 +415,12 @@ class WorkerService:
             "Mutate": u(self.mutate, ipb.MutateRequest, ipb.MutateResponse),
             "Decide": u(self.decide, ipb.DecisionRequest,
                         ipb.DecisionResponse),
+            "Append": u(self.append, ipb.AppendRequest, ipb.AppendResponse),
+            "Promote": u(self.promote, ipb.PromoteRequest,
+                         ipb.PromoteResponse),
+            "Status": u(self.status, ipb.StatusRequest, ipb.StatusResponse),
+            "Sort": u(self.sort, ipb.SortRequest, ipb.SortResponse),
+            "Schema": u(self.schema, ipb.SchemaRequest, ipb.SchemaResponse),
         })
 
 
@@ -245,6 +459,50 @@ class RemoteWorker:
             f"/{SERVICE}/Decide",
             request_serializer=ipb.DecisionRequest.SerializeToString,
             response_deserializer=ipb.DecisionResponse.FromString)
+        self._append = self.channel.unary_unary(
+            f"/{SERVICE}/Append",
+            request_serializer=ipb.AppendRequest.SerializeToString,
+            response_deserializer=ipb.AppendResponse.FromString)
+        self._promote = self.channel.unary_unary(
+            f"/{SERVICE}/Promote",
+            request_serializer=ipb.PromoteRequest.SerializeToString,
+            response_deserializer=ipb.PromoteResponse.FromString)
+        self._status = self.channel.unary_unary(
+            f"/{SERVICE}/Status",
+            request_serializer=ipb.StatusRequest.SerializeToString,
+            response_deserializer=ipb.StatusResponse.FromString)
+        self._sort = self.channel.unary_unary(
+            f"/{SERVICE}/Sort",
+            request_serializer=ipb.SortRequest.SerializeToString,
+            response_deserializer=ipb.SortResponse.FromString)
+        self._schema = self.channel.unary_unary(
+            f"/{SERVICE}/Schema",
+            request_serializer=ipb.SchemaRequest.SerializeToString,
+            response_deserializer=ipb.SchemaResponse.FromString)
+
+    def append(self, term: int, index: int, data: bytes,
+               timeout: float = 5.0) -> ipb.AppendResponse:
+        return self._append(ipb.AppendRequest(term=term, index=index,
+                                              data=data), timeout=timeout)
+
+    def promote(self, term: int, peers: list[str]) -> ipb.PromoteResponse:
+        return self._promote(ipb.PromoteRequest(term=term, peers=peers))
+
+    def status(self, timeout: float = 3.0) -> ipb.StatusResponse:
+        return self._status(ipb.StatusRequest(), timeout=timeout)
+
+    def sort(self, attr: str, uids, desc: bool, lang: str, read_ts: int,
+             need: int = 0) -> np.ndarray:
+        r = self._sort(ipb.SortRequest(
+            attr=attr, uids=_uids_to_bytes(uids), desc=desc, lang=lang,
+            read_ts=read_ts, need=need))
+        return _uids_from_bytes(r.uids)
+
+    def schema(self, preds=()) -> str:
+        """Schema text of the served tablets (parse with parse_schema)."""
+        lines = json.loads(
+            self._schema(ipb.SchemaRequest(preds=list(preds))).schema_json)
+        return "\n".join(lines)
 
     def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
         return decode_result(self._serve(encode_task(q, read_ts)))
@@ -290,6 +548,32 @@ class NetworkDispatcher:
             raise RuntimeError(
                 f"no connection to group {group} serving {attr!r}")
         return rw.process_task(q, read_ts)
+
+    def sort_over_network(self, attr: str, uids, desc: bool, lang: str,
+                          read_ts: int, need: int = 0):
+        """Route an order-by to the attr's owning group (worker/sort.go:50
+        SortOverNetwork): the owner walks its sortable index (bounded) or
+        value-sorts, returning the candidates reordered."""
+        group = self.zero.tablets().get(attr)
+        if group is None or group == self.local_group:
+            return None              # local/unknown: caller sorts locally
+        rw = self.remotes.get(group)
+        if rw is None:
+            raise RuntimeError(f"no connection to group {group} for sort")
+        return rw.sort(attr, uids, desc, lang, read_ts, need)
+
+    def schema_over_network(self, preds=()):
+        """Merged schema text from every reachable group
+        (worker/schema.go:160 GetSchemaOverNetwork)."""
+        parts = []
+        for g, rw in sorted(self.remotes.items()):
+            try:
+                t = rw.schema(preds)
+            except Exception:
+                continue
+            if t:
+                parts.append(t)
+        return "\n".join(parts)
 
     # -- write fan-out (MutateOverNetwork / CommitOverNetwork) ---------------
 

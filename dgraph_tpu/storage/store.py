@@ -95,6 +95,11 @@ class Store:
         # read-through; here clean predicates reuse device arrays)
         self.pred_commit_ts: dict[str, int] = {}
         self.snapshot_ts = 0  # commits at/below this are folded into bases
+        # records currently in wal.log (an up-to-dateness signal for
+        # elections; NOT the replication ship index — that is a per-term
+        # session sequence, parallel/remote.py — because checkpoint
+        # compaction rewrites this file)
+        self.wal_record_count = 0
         if dirpath:
             os.makedirs(dirpath, exist_ok=True)
             self._load()
@@ -269,18 +274,22 @@ class Store:
     wal_sink = None
 
     def _wal_write(self, rec: dict, sync: bool = False) -> None:
-        if self._wal is None:
-            return
+        if self._wal is None and self.wal_sink is None:
+            return    # in-memory, unreplicated: records have nowhere to go
         data = json.dumps(rec, separators=(",", ":")).encode("utf-8")
         with self._lock:
             # ship under the same lock as the local append so followers see
-            # records in exactly the leader's log order
+            # records in exactly the leader's log order (replication is
+            # independent of local durability: an in-memory leader still
+            # ships — its quorum of follower fsyncs IS the durability)
             if self.wal_sink is not None:
                 self.wal_sink(data, sync)
-            self._wal.write(_U32.pack(len(data)) + data)
-            if sync:
-                self._wal.flush()
-                os.fsync(self._wal.fileno())
+            if self._wal is not None:
+                self._wal.write(_U32.pack(len(data)) + data)
+                self.wal_record_count += 1
+                if sync:
+                    self._wal.flush()
+                    os.fsync(self._wal.fileno())
 
     def _replay_wal(self, path: str) -> None:
         if not os.path.exists(path):
@@ -295,6 +304,22 @@ class Store:
                 break  # torn tail write — ignore (crash mid-append)
             self.apply_record(json.loads(raw[off : off + n]))
             off += n
+            self.wal_record_count += 1
+
+    def append_replica_record(self, data: bytes, sync: bool = True) -> None:
+        """Follower-side replication apply: one shipped WAL record becomes
+        durable in this replica's own log AND live in memory, atomically
+        under the store lock (the worker/draft.go:485-624 store-then-apply
+        order, collapsed because the record is already quorum-ordered by
+        the leader)."""
+        with self._lock:
+            if self._wal is not None:
+                self._wal.write(_U32.pack(len(data)) + data)
+                if sync:
+                    self._wal.flush()
+                    os.fsync(self._wal.fileno())
+            self._apply_record_locked(json.loads(data))
+            self.wal_record_count += 1
 
     def apply_record(self, rec: dict) -> None:
         """Apply one WAL record to in-memory state — replay on restart, and
@@ -362,6 +387,7 @@ class Store:
                 self._wal.close()
             wal_path = os.path.join(self.dir, "wal.log")
             self._wal = open(wal_path + ".tmp", "ab")
+            self.wal_record_count = 0   # re-counted by the rewrites below
             for kb in sorted(self.lists):
                 pl = self.lists[kb]
                 for sts, layer in pl.uncommitted.items():

@@ -292,3 +292,123 @@ def test_move_fence_blocks_networked_writes(wnet):
     finally:
         zero.unblock_writes("age")
         zero.oracle.abort(st.start_ts)
+
+
+# -- replication protocol (Append/Promote/Status; worker/draft.go analog) ----
+
+def _mk_replica_trio():
+    """Leader + 2 follower WorkerServices with live gRPC servers."""
+    from concurrent import futures as _f
+
+    from dgraph_tpu.parallel.remote import WorkerService
+
+    svcs, servers, addrs = [], [], []
+    for _ in range(3):
+        store = Store()
+        for e in parse_schema("v: int ."):
+            store.set_schema(e)
+        svc = WorkerService(store)
+        server = grpc.server(_f.ThreadPoolExecutor(max_workers=4))
+        server.add_generic_rpc_handlers((svc.handler(),))
+        port = server.add_insecure_port("localhost:0")
+        server.start()
+        svcs.append(svc)
+        servers.append(server)
+        addrs.append(f"localhost:{port}")
+    return svcs, servers, addrs
+
+
+def _write_edge(leader_addr, uid, val, ts):
+    rw = RemoteWorker(leader_addr)
+    from dgraph_tpu.storage.postings import DirectedEdge
+
+    resp = rw.mutate(ts, [DirectedEdge(uid, "v", value=Val(TypeID.INT, val))])
+    rw.decide(ts, ts + 1, list(resp.keys))
+    rw.close()
+
+
+def test_lagging_peer_catches_up_from_buffer():
+    """A transiently-failing follower is re-fed missed records from the
+    leader's buffer on the next ship (per-peer nextIndex semantics)."""
+    svcs, servers, addrs = _mk_replica_trio()
+    leader, fa, fb = svcs
+    rw = RemoteWorker(addrs[0])
+    assert rw.promote(1, [addrs[1], addrs[2]]).ok
+
+    # make peer B's transport fail for the next ship only
+    pb = leader.peers[1]
+    real_append = pb.append
+    fails = {"n": 2}     # one txn = mutation record + commit record ships
+
+    def flaky(*a, **kw):
+        if fails["n"]:
+            fails["n"] -= 1
+            raise RuntimeError("transient transport fault")
+        return real_append(*a, **kw)
+
+    pb.append = flaky
+    _write_edge(addrs[0], 1, 10, ts=10)   # B misses these records
+    assert fa.store.max_seen_commit_ts == 11
+    assert fb.store.max_seen_commit_ts == 0
+
+    _write_edge(addrs[0], 2, 20, ts=20)   # next ship re-feeds B everything
+    assert fb.store.max_seen_commit_ts == 21
+    assert fb._last_seq == leader._session_seq
+    rw.close()
+    for s in servers:
+        s.stop(0)
+
+
+def test_leader_steps_down_without_quorum():
+    """NoQuorum steps the leader down: it must not keep minting sequence
+    numbers its group never accepted (log-fork guard)."""
+    import pytest as _pytest
+
+    from dgraph_tpu.parallel.remote import NoQuorum
+
+    svcs, servers, addrs = _mk_replica_trio()
+    leader = svcs[0]
+    rw = RemoteWorker(addrs[0])
+    assert rw.promote(1, [addrs[1], addrs[2]]).ok
+    servers[1].stop(0)
+    servers[2].stop(0)
+
+    from dgraph_tpu.storage.postings import DirectedEdge
+    from dgraph_tpu.query import mutation as mut
+
+    with _pytest.raises(NoQuorum):
+        mut.apply_mutations(leader.store,
+                            [DirectedEdge(1, "v", value=Val(TypeID.INT, 1))],
+                            5)
+    assert not leader.is_leader
+    assert leader.store.wal_sink is None
+    rw.close()
+    servers[0].stop(0)
+
+
+def test_stale_leader_fenced_by_term():
+    """A deposed leader's ship is rejected once a peer saw a higher term."""
+    from dgraph_tpu.parallel.remote import StaleLeader
+
+    svcs, servers, addrs = _mk_replica_trio()
+    l1, l2, f = svcs
+    rw1, rw2 = RemoteWorker(addrs[0]), RemoteWorker(addrs[1])
+    assert rw1.promote(1, [addrs[1], addrs[2]]).ok
+    _write_edge(addrs[0], 1, 1, ts=2)
+    # replica 1 takes over at term 2 (shares follower addrs[2])
+    assert rw2.promote(2, [addrs[2]]).ok
+    _write_edge(addrs[1], 2, 2, ts=6)
+
+    from dgraph_tpu.storage.postings import DirectedEdge
+    from dgraph_tpu.query import mutation as mut
+    import pytest as _pytest
+
+    with _pytest.raises(StaleLeader):
+        mut.apply_mutations(l1.store,
+                            [DirectedEdge(3, "v", value=Val(TypeID.INT, 3))],
+                            9)
+    assert not l1.is_leader
+    rw1.close()
+    rw2.close()
+    for s in servers:
+        s.stop(0)
