@@ -153,13 +153,29 @@ def cmd_zero(args) -> int:
     """Run the cluster coordinator as its own process (reference
     `dgraph zero`, dgraph/cmd/zero/run.go:58): timestamp/uid leases, the
     SSI oracle, and the tablet map over the internal protocol."""
+    import threading
     import time
 
     from dgraph_tpu.coord.zero import Zero
-    from dgraph_tpu.coord.zero_service import serve_zero
+    from dgraph_tpu.coord.zero_service import (ZeroOps, serve_zero,
+                                               serve_zero_http)
 
     zero = Zero(n_groups=args.groups)
-    server, port = serve_zero(zero, f"{args.host}:{args.port}")
+    server, port, svc = serve_zero(zero, f"{args.host}:{args.port}")
+    ops = ZeroOps(svc)
+    httpd, hport = serve_zero_http(svc, ops, args.host, args.http_port)
+    print(f"zero ops HTTP on {args.host}:{hport}", flush=True)
+    if args.rebalance_interval > 0:
+        def loop():
+            while True:
+                time.sleep(args.rebalance_interval)
+                try:
+                    out = ops.rebalance_once()
+                    if out:
+                        print(f"rebalanced: {out}", flush=True)
+                except Exception as e:       # noqa: BLE001 — next tick retries
+                    print(f"rebalance error: {e}", flush=True)
+        threading.Thread(target=loop, daemon=True).start()
     print(f"zero serving {args.groups} groups on {args.host}:{port}",
           flush=True)
     try:
@@ -168,6 +184,7 @@ def cmd_zero(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        httpd.shutdown()
         server.stop(0)
     return 0
 
@@ -268,8 +285,14 @@ def main(argv=None) -> int:
     zp = sub.add_parser("zero", help="run the cluster coordinator process")
     zp.add_argument("--host", default="127.0.0.1")
     zp.add_argument("--port", type=int, default=5080)
+    zp.add_argument("--http_port", type=int, default=0,
+                    help="ops HTTP port: /state /moveTablet /removeNode "
+                         "(0 = ephemeral)")
     zp.add_argument("--groups", type=int, default=1,
                     help="number of server groups to balance tablets over")
+    zp.add_argument("--rebalance_interval", type=float, default=0,
+                    help="seconds between automatic tablet rebalance ticks "
+                         "(0 = off)")
     zp.set_defaults(fn=cmd_zero)
 
     cp = sub.add_parser("convert", help="GeoJSON -> RDF (.rdf.gz)")

@@ -209,6 +209,44 @@ class Cluster:
         finally:
             self.zero.unblock_writes(attr)
 
+    # -- auto-rebalance (dgraph/cmd/zero/tablet.go:60-74) ---------------------
+
+    def rebalance_once(self) -> dict | None:
+        """One pass of the reference's rebalance tick (decision logic shared
+        with the Zero process: coord/zero.choose_rebalance_move). Returns
+        the move stats or None."""
+        from dgraph_tpu.coord.zero import choose_rebalance_move
+
+        sizes = {g: self.stores[g].tablet_sizes()
+                 for g in range(len(self.stores))}
+        pick = choose_rebalance_move(sizes,
+                                     blocked=self.zero.moving_tablets())
+        if pick is None:
+            return None
+        attr, src, dst, sz = pick
+        stats = self.move_predicate(attr, dst)
+        stats.update(tablet=attr, src=src, dst=dst, bytes=sz)
+        return stats
+
+    def start_rebalancer(self, interval_s: float = 8.0) -> None:
+        """Background rebalance tick (the --rebalance_interval loop)."""
+        import time as _time
+
+        def loop():
+            while not self._stop_rebalance.is_set():
+                try:
+                    self.rebalance_once()
+                except Exception:
+                    pass                   # next tick retries
+                self._stop_rebalance.wait(interval_s)
+
+        self._stop_rebalance = threading.Event()
+        self._rebalance_thread = threading.Thread(target=loop, daemon=True)
+        self._rebalance_thread.start()
+
     def close(self) -> None:
+        ev = getattr(self, "_stop_rebalance", None)
+        if ev is not None:
+            ev.set()
         for s in self.stores:
             s.close()

@@ -213,6 +213,33 @@ class UidLease:
             return self._next - 1
 
 
+REBALANCE_RATIO = 0.85   # tablet.go:60-74: move only while the smallest
+                         # group serves < 85% of the largest (anti-ping-pong)
+
+
+def choose_rebalance_move(sizes: dict[int, dict[str, int]],
+                          ratio: float = REBALANCE_RATIO,
+                          blocked: set | frozenset = frozenset()):
+    """One rebalance decision (dgraph/cmd/zero/tablet.go:60-74 + :156
+    chooseTablet): compare the largest- and smallest-serving groups; if
+    imbalanced past `ratio`, pick the largest source tablet that fits half
+    the gap. Returns (attr, src_group, dst_group, size) or None. Shared by
+    the in-process Cluster and the Zero-process rebalancer so the two
+    planes cannot drift."""
+    totals = {g: sum(t.values()) for g, t in sizes.items()}
+    if len(totals) < 2:
+        return None
+    src = max(totals, key=lambda g: totals[g])
+    dst = min(totals, key=lambda g: totals[g])
+    if src == dst or totals[dst] >= ratio * totals[src]:
+        return None
+    gap = (totals[src] - totals[dst]) / 2
+    for attr, sz in sorted(sizes[src].items(), key=lambda kv: -kv[1]):
+        if sz <= gap and attr not in blocked:
+            return attr, src, dst, sz
+    return None
+
+
 class Zero:
     """The coordinator facade: oracle + uid lease + tablet map.
 

@@ -128,15 +128,200 @@ class ZeroService:
         })
 
 
+class MoveError(Exception):
+    pass
+
+
+class ZeroOps:
+    """Cluster operations driven FROM Zero: tablet moves over the wire and
+    the automatic rebalance tick (dgraph/cmd/zero/tablet.go:60-74; move
+    protocol worker/predicate_move.go:86-177)."""
+
+    def __init__(self, svc: ZeroService) -> None:
+        self.svc = svc
+        self.zero = svc.zero
+        self._move_lock = threading.Lock()
+
+    def _leader_of(self, group: int):
+        from ..parallel.remote import RemoteWorker
+
+        with self.svc._lock:
+            addrs = list(self.svc._members.get(group, ()))
+        if not addrs:
+            raise MoveError(f"group {group} has no members")
+        if len(addrs) == 1:
+            return RemoteWorker(addrs[0])
+        for a in addrs:
+            rw = RemoteWorker(a)
+            try:
+                if rw.status().leader:
+                    return rw
+            except Exception:
+                pass
+            rw.close()
+        raise MoveError(f"group {group} has no live leader")
+
+    def move_tablet(self, attr: str, dst_group: int) -> dict:
+        """The 7-step move over the internal protocol: block writes → abort
+        open txns touching the tablet → snapshot-stream its records to the
+        destination leader → commit → flip the map → delete at the source.
+        Buffered layers of aborted txns on workers are reaped by their own
+        decide/abort paths; a mid-stream failure leaves the source
+        authoritative (the copy rides an uncommitted txn)."""
+        import base64
+
+        with self._move_lock:
+            src_group = self.zero.tablets().get(attr)
+            if src_group is None:
+                raise MoveError(f"tablet {attr!r} is not served")
+            if src_group == dst_group:
+                return {"moved_records": 0, "tablet": attr}
+            src = self._leader_of(src_group)
+            try:
+                dst = self._leader_of(dst_group)
+            except BaseException:
+                src.close()
+                raise
+            self.zero.block_writes(attr)
+            try:
+                aborted = 0
+                for ts in self.zero.oracle.pending_on(attr):
+                    self.zero.oracle.abort(ts)
+                    aborted += 1
+                read_ts = self.zero.oracle.read_ts()
+                move_st = self.zero.oracle.new_txn()
+                keys_b64 = []
+                try:
+                    resp = src.predicate_data(attr, read_ts,
+                                              move_st.start_ts)
+                    keys_b64 = [base64.b64encode(bytes(k)).decode()
+                                for k in resp.keys]
+                    dst.ingest_records(list(resp.records))
+                    commit_ts = self.zero.oracle.commit(move_st.start_ts)
+                    crec = json.dumps(
+                        {"t": "c", "s": move_st.start_ts, "ts": commit_ts,
+                         "k": keys_b64}, separators=(",", ":")).encode()
+                    dst.ingest_records([crec])
+                except BaseException:
+                    # mid-stream failure (incl. a lost commit record): the
+                    # map never flipped, so the source stays authoritative.
+                    # Reap the partial copy buffered on dst — otherwise
+                    # each retried move stacks another full tablet copy —
+                    # and release the move txn at the oracle (a no-conflict
+                    # txn, so a post-commit abort record is still safe: the
+                    # tablet's data was never exposed under dst's map).
+                    try:
+                        arec = json.dumps(
+                            {"t": "a", "s": move_st.start_ts,
+                             "k": keys_b64},
+                            separators=(",", ":")).encode()
+                        dst.ingest_records([arec])
+                    except Exception:
+                        pass
+                    self.zero.oracle.abort(move_st.start_ts)
+                    raise
+                self.zero.move_tablet(attr, dst_group)
+                src.delete_predicate(attr)
+                return {"moved_records": len(resp.records),
+                        "aborted_txns": aborted, "tablet": attr,
+                        "src": src_group, "dst": dst_group}
+            finally:
+                self.zero.unblock_writes(attr)
+                src.close()
+                dst.close()
+
+    def rebalance_once(self) -> dict | None:
+        """One tick: size reports from every group's leader feed the shared
+        decision (coord/zero.choose_rebalance_move), then move_tablet."""
+        from .zero import choose_rebalance_move
+
+        sizes: dict[int, dict[str, int]] = {}
+        with self.svc._lock:
+            groups = list(self.svc._members)
+        for g in groups:
+            try:
+                rw = self._leader_of(g)
+            except MoveError:
+                continue
+            try:
+                sizes[g] = {a: int(s) for a, s in json.loads(
+                    rw.status().tablet_sizes_json or "{}").items()}
+            finally:
+                rw.close()
+        pick = choose_rebalance_move(sizes,
+                                     blocked=self.zero.moving_tablets())
+        if pick is None:
+            return None
+        attr, _src, dst, sz = pick
+        out = self.move_tablet(attr, dst)
+        out["bytes"] = sz
+        return out
+
+    def remove_node(self, group: int, addr: str) -> bool:
+        """Drop a member from the membership registry (zero /removeNode,
+        http.go:38-128); its replicas stop being move/leader candidates."""
+        with self.svc._lock:
+            members = self.svc._members.get(group, [])
+            if addr in members:
+                members.remove(addr)
+                return True
+        return False
+
+
+def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
+                    port: int = 0):
+    """Zero's ops HTTP endpoints (dgraph/cmd/zero/http.go:38-130):
+    GET /state, GET /moveTablet?tablet=X&group=N,
+    GET /removeNode?group=N&addr=A. Returns (server, bound_port)."""
+    import http.server
+    import urllib.parse
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):     # noqa: N802 — quiet
+            pass
+
+        def _reply(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):              # noqa: N802 — http.server API
+            u = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(u.query)
+            try:
+                if u.path == "/state":
+                    self._reply(200, json.loads(svc.state(
+                        ipb.ZeroStateRequest(), None).state_json))
+                elif u.path == "/moveTablet":
+                    out = ops.move_tablet(q["tablet"][0],
+                                          int(q["group"][0]))
+                    self._reply(200, out)
+                elif u.path == "/removeNode":
+                    ok = ops.remove_node(int(q["group"][0]), q["addr"][0])
+                    self._reply(200 if ok else 404, {"removed": ok})
+                else:
+                    self._reply(404, {"error": f"unknown path {u.path}"})
+            except Exception as e:      # noqa: BLE001 — ops surface
+                self._reply(500, {"error": str(e)})
+
+    httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
 def serve_zero(zero: Zero, addr: str = "localhost:0", max_workers: int = 8):
-    """Start the Zero gRPC server; returns (server, bound_port)."""
+    """Start the Zero gRPC server; returns (server, bound_port, service)."""
+    svc = ZeroService(zero)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((ZeroService(zero).handler(),))
+    server.add_generic_rpc_handlers((svc.handler(),))
     port = server.add_insecure_port(addr)
     if port == 0:
         raise RuntimeError(f"could not bind zero listener on {addr}")
     server.start()
-    return server, port
+    return server, port, svc
 
 
 class ZeroClient:

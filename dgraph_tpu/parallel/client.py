@@ -43,29 +43,71 @@ class _LeaseAdapter:
             self._hwm = uid
 
 
+class _CachedZero:
+    """ZeroClient wrapper with a TTL'd tablet map: the dispatcher consults
+    tablets() per task, and a State RPC per task would make every k-hop
+    query pay k full-membership round trips."""
+
+    TTL = 1.0
+
+    def __init__(self, zero: ZeroClient) -> None:
+        self._zero = zero
+        self._tablets: dict | None = None
+        self._at = 0.0
+
+    def tablets(self) -> dict[str, int]:
+        now = time.monotonic()
+        if self._tablets is None or now - self._at > self.TTL:
+            self._tablets = self._zero.tablets()
+            self._at = now
+        return self._tablets
+
+    def invalidate(self) -> None:
+        self._tablets = None
+
+    def __getattr__(self, name):
+        return getattr(self._zero, name)
+
+
 class ClusterClient:
     """Client of one Zero process + N group replica sets."""
+
+    # leader/schema caches: failover re-discovers on the mutate retry path
+    CACHE_TTL = 1.0
 
     def __init__(self, zero_addr: str,
                  groups: dict[int, list[str]]) -> None:
         """groups: group id -> replica worker addresses (leader discovered
         via Status polling, re-discovered on failover)."""
-        self.zero = ZeroClient(zero_addr)
+        self.zero = _CachedZero(ZeroClient(zero_addr))
         self.groups = {g: [RemoteWorker(a) for a in addrs]
                        for g, addrs in groups.items()}
         self._leases = _LeaseAdapter(self.zero)
+        self._leaders: dict[int, tuple[float, RemoteWorker]] = {}
+        self._schema: tuple[float, SchemaState] | None = None
+
+    def _invalidate(self) -> None:
+        self._leaders.clear()
+        self._schema = None
+        self.zero.invalidate()
 
     # -- leadership ----------------------------------------------------------
 
     def leader_of(self, g: int) -> RemoteWorker:
         """Current leader of a group: the replica reporting leader=True
-        (single-replica groups lead themselves at term 0)."""
+        (single-replica groups lead themselves at term 0). Cached briefly —
+        the mutate retry path invalidates on failure."""
         replicas = self.groups[g]
         if len(replicas) == 1:
             return replicas[0]
+        now = time.monotonic()
+        hit = self._leaders.get(g)
+        if hit is not None and now - hit[0] <= self.CACHE_TTL:
+            return hit[1]
         for rw in replicas:
             try:
                 if rw.status().leader:
+                    self._leaders[g] = (now, rw)
                     return rw
             except Exception:
                 continue
@@ -75,7 +117,10 @@ class ClusterClient:
 
     def schema(self) -> SchemaState:
         """Cluster schema via the Schema RPC from every group
-        (worker/schema.go:160 GetSchemaOverNetwork)."""
+        (worker/schema.go:160 GetSchemaOverNetwork); cached briefly."""
+        now = time.monotonic()
+        if self._schema is not None and now - self._schema[0] <= self.CACHE_TTL:
+            return self._schema[1]
         merged = SchemaState()
         for g in self.groups:
             try:
@@ -84,6 +129,7 @@ class ClusterClient:
                 continue
             for e in parse_schema(text):
                 merged.set(e)
+        self._schema = (now, merged)
         return merged
 
     # -- writes --------------------------------------------------------------
@@ -103,6 +149,7 @@ class ClusterClient:
                 raise
             except Exception as e:       # leader died / NoQuorum: retry
                 last = e
+                self._invalidate()       # re-discover leaders + tablet map
                 time.sleep(0.1)
         raise last if last else RuntimeError("mutate failed")
 
@@ -134,6 +181,7 @@ class ClusterClient:
                 pass
             raise
         self._decide_all(start_ts, commit_ts, keys_by_group)
+        self._invalidate()    # new tablets / inferred schema become visible
         return uid_map
 
     def _decide_all(self, start_ts: int, commit_ts: int,
@@ -150,7 +198,18 @@ class ClusterClient:
 
     def query(self, q: str, variables: dict | None = None) -> dict:
         """DQL with every uid/value task dispatched over ServeTask — the
-        client holds NO local tablet (all-remote NetworkDispatcher)."""
+        client holds NO local tablet (all-remote NetworkDispatcher). A
+        transport failure (e.g. cached leader died) invalidates the
+        leader/tablet caches and retries once against fresh discovery."""
+        for attempt in (0, 1):
+            try:
+                return self._query_once(q, variables)
+            except Exception:
+                if attempt:
+                    raise
+                self._invalidate()
+
+    def _query_once(self, q: str, variables: dict | None) -> dict:
         read_ts = int(self.zero.state().get("maxTxnTs", 0))
         schema = self.schema()
         dispatcher = NetworkDispatcher(

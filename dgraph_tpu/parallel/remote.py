@@ -182,6 +182,7 @@ class WorkerService:
         self._last_seq = 0                       # follower: applied seq
         self._buffer = collections.deque(maxlen=self.SHIP_BUFFER)
         self._pool = None                        # ship executor
+        self._ship_lock = threading.Lock()       # _ship <-> promote only
         self._term_path = (os.path.join(store.dir, "term")
                            if store.dir else None)
         self.term = 0
@@ -256,26 +257,35 @@ class WorkerService:
     # -- replication (leader ship / follower append) --------------------------
 
     def promote(self, msg: ipb.PromoteRequest, context) -> ipb.PromoteResponse:
-        """Become this group's leader at `term`, shipping to `peers`."""
+        """Become this group's leader at `term`, shipping to `peers`.
+
+        The term must STRICTLY increase: followers key their session
+        sequence on the term, so a same-term re-promote would restart the
+        leader's sequence at 1 while followers are at N — every shipped
+        record up to N would be acked as a "duplicate" without being
+        applied, and a later failover would lose acked writes."""
         from concurrent import futures as _futures
 
         with self._rlock:
-            if msg.term < self.term:
+            if msg.term <= self.term:
                 return ipb.PromoteResponse(ok=False, term=self.term)
-            self._set_term(int(msg.term))
-            for p in self.peers:
-                p.close()
-            self.peers = [RemoteWorker(a) for a in msg.peers]
-            self._peer_seq = {i: 0 for i in range(len(self.peers))}
-            self._session_seq = 0
-            self._buffer.clear()
-            if self._pool is not None:
-                self._pool.shutdown(wait=False)
-            self._pool = _futures.ThreadPoolExecutor(
-                max_workers=max(len(self.peers), 1))
-            self.is_leader = True
-            self.store.wal_sink = self._ship
-            return ipb.PromoteResponse(ok=True, term=self.term)
+            # serialize against an in-flight _ship before touching the pool,
+            # peers, or sequence state it is using
+            with self._ship_lock:
+                self._set_term(int(msg.term))
+                for p in self.peers:
+                    p.close()
+                self.peers = [RemoteWorker(a) for a in msg.peers]
+                self._peer_seq = {i: 0 for i in range(len(self.peers))}
+                self._session_seq = 0
+                self._buffer.clear()
+                if self._pool is not None:
+                    self._pool.shutdown(wait=False)
+                self._pool = _futures.ThreadPoolExecutor(
+                    max_workers=max(len(self.peers), 1))
+                self.is_leader = True
+                self.store.wal_sink = self._ship
+                return ipb.PromoteResponse(ok=True, term=self.term)
 
     def _ship_to_peer(self, i: int, p: "RemoteWorker",
                       records: list[tuple[int, bytes]]) -> bool:
@@ -304,31 +314,34 @@ class WorkerService:
         """Deliver one WAL record to all peers concurrently; quorum counts
         the leader itself. Runs under the store lock (records reach
         followers in exactly the leader's order) but takes NO service lock
-        — see __init__. A leader that cannot assemble a quorum steps down
-        before raising: continuing to mint sequence numbers its group never
-        accepted would fork the log."""
-        self._session_seq += 1
-        seq = self._session_seq
-        self._buffer.append((seq, data))
-        records = list(self._buffer)
-        peers = list(self.peers)
-        futs = [self._pool.submit(self._ship_to_peer, i, p, records)
-                for i, p in enumerate(peers)]
-        acks, stale = 1, None
-        for f in futs:
-            try:
-                if f.result():
-                    acks += 1
-            except StaleLeader as e:
-                stale = e
-        if stale is not None:
-            self._step_down()
-            raise stale
-        quorum = (len(peers) + 1) // 2 + 1
-        if acks < quorum:
-            self._step_down()
-            raise NoQuorum(
-                f"{acks}/{len(peers) + 1} acks < quorum {quorum}")
+        (_rlock) — see __init__. The dedicated _ship_lock (a leaf shared
+        only with promote()) keeps a concurrent Promote from swapping the
+        pool/peers/sequence state mid-ship. A leader that cannot assemble a
+        quorum steps down before raising: continuing to mint sequence
+        numbers its group never accepted would fork the log."""
+        with self._ship_lock:
+            self._session_seq += 1
+            seq = self._session_seq
+            self._buffer.append((seq, data))
+            records = list(self._buffer)
+            peers = list(self.peers)
+            futs = [self._pool.submit(self._ship_to_peer, i, p, records)
+                    for i, p in enumerate(peers)]
+            acks, stale = 1, None
+            for f in futs:
+                try:
+                    if f.result():
+                        acks += 1
+                except StaleLeader as e:
+                    stale = e
+            if stale is not None:
+                self._step_down()
+                raise stale
+            quorum = (len(peers) + 1) // 2 + 1
+            if acks < quorum:
+                self._step_down()
+                raise NoQuorum(
+                    f"{acks}/{len(peers) + 1} acks < quorum {quorum}")
 
     def append(self, msg: ipb.AppendRequest, context) -> ipb.AppendResponse:
         """Follower side: fence term, enforce session order, make the
@@ -355,20 +368,31 @@ class WorkerService:
             return ipb.AppendResponse(ok=True, term=self.term,
                                       log_len=self._last_seq)
 
+    _SIZES_TTL = 5.0   # Status doubles as the hot leader-discovery probe;
+                       # the O(all keys) size walk refreshes on this cadence
+
     def status(self, _msg: ipb.StatusRequest, context) -> ipb.StatusResponse:
         import os
+        import time
 
-        size = 0
-        if self.store.dir:
-            wal = os.path.join(self.store.dir, "wal.log")
-            snap = os.path.join(self.store.dir, "snapshot.bin")
-            size = sum(os.path.getsize(p) for p in (wal, snap)
-                       if os.path.exists(p))
+        now = time.monotonic()
+        cached = getattr(self, "_sizes_cache", None)
+        if cached is None or now - cached[0] > self._SIZES_TTL:
+            size = 0
+            if self.store.dir:
+                wal = os.path.join(self.store.dir, "wal.log")
+                snap = os.path.join(self.store.dir, "snapshot.bin")
+                size = sum(os.path.getsize(p) for p in (wal, snap)
+                           if os.path.exists(p))
+            cached = (now, size,
+                      json.dumps(self.store.tablet_sizes()))
+            self._sizes_cache = cached
         return ipb.StatusResponse(
             term=self.term, log_len=self.store.wal_record_count,
             leader=self.is_leader,
             max_commit_ts=self.store.max_seen_commit_ts,
-            tablets=self.store.predicates(), tablet_bytes=size)
+            tablets=self.store.predicates(), tablet_bytes=cached[1],
+            tablet_sizes_json=cached[2])
 
     # -- distributed sort + schema (worker/sort.go:50, worker/schema.go:160) --
 
@@ -402,6 +426,62 @@ class WorkerService:
                  if not want or e.predicate in want]
         return ipb.SchemaResponse(schema_json=json.dumps(lines))
 
+    # -- predicate move (worker/predicate_move.go) ----------------------------
+
+    def predicate_data(self, msg: ipb.PredicateDataRequest,
+                       context) -> ipb.PredicateDataResponse:
+        """Source side: stream every key of the predicate at read_ts as WAL
+        'm' records under the move txn (movePredicateHelper :86-177)."""
+        import base64
+
+        from ..storage import keys as K
+        from ..storage.store import posting_to_json
+
+        records, keys = [], []
+        for kind in (K.KeyKind.DATA, K.KeyKind.REVERSE,
+                     K.KeyKind.INDEX, K.KeyKind.COUNT):
+            for kb in self.store.keys_of(kind, msg.attr):
+                pl = self.store.lists.get(kb)
+                if pl is None:
+                    continue
+                for p in pl.postings(msg.read_ts):
+                    records.append(json.dumps(
+                        {"t": "m", "s": int(msg.start_ts),
+                         "k": base64.b64encode(kb).decode(),
+                         "p": posting_to_json(p)},
+                        separators=(",", ":")).encode())
+                keys.append(kb)
+        entry = self.store.schema.get(msg.attr)
+        if entry is not None:
+            records.append(json.dumps({"t": "s", "line": str(entry)},
+                                      separators=(",", ":")).encode())
+        return ipb.PredicateDataResponse(records=records, keys=keys)
+
+    def ingest_records(self, msg: ipb.IngestRequest,
+                       context) -> ipb.IngestResponse:
+        """Destination side (ReceivePredicate): records flow through the
+        WAL path, so a replicated leader ships them to its own quorum."""
+        if self.term > 0 and not self.is_leader:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not leader (term {self.term})")
+        for data in msg.records:
+            self.store.ingest_record(json.loads(bytes(data)))
+        with self._lock:
+            self._snap = None
+        return ipb.IngestResponse()
+
+    def delete_predicate(self, msg: ipb.DeletePredicateRequest,
+                         context) -> ipb.DeletePredicateResponse:
+        """Source cleanup after the map flip (the move's step 5; WAL-logged
+        so this leader's replicas follow)."""
+        if self.term > 0 and not self.is_leader:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not leader (term {self.term})")
+        self.store.delete_predicate(msg.attr)
+        with self._lock:
+            self._snap = None
+        return ipb.DeletePredicateResponse()
+
     def handler(self):
         def u(fn, req_cls, resp_cls):
             return grpc.unary_unary_rpc_method_handler(
@@ -421,6 +501,13 @@ class WorkerService:
             "Status": u(self.status, ipb.StatusRequest, ipb.StatusResponse),
             "Sort": u(self.sort, ipb.SortRequest, ipb.SortResponse),
             "Schema": u(self.schema, ipb.SchemaRequest, ipb.SchemaResponse),
+            "PredicateData": u(self.predicate_data, ipb.PredicateDataRequest,
+                               ipb.PredicateDataResponse),
+            "IngestRecords": u(self.ingest_records, ipb.IngestRequest,
+                               ipb.IngestResponse),
+            "DeletePredicate": u(self.delete_predicate,
+                                 ipb.DeletePredicateRequest,
+                                 ipb.DeletePredicateResponse),
         })
 
 
@@ -479,6 +566,18 @@ class RemoteWorker:
             f"/{SERVICE}/Schema",
             request_serializer=ipb.SchemaRequest.SerializeToString,
             response_deserializer=ipb.SchemaResponse.FromString)
+        self._predicate_data = self.channel.unary_unary(
+            f"/{SERVICE}/PredicateData",
+            request_serializer=ipb.PredicateDataRequest.SerializeToString,
+            response_deserializer=ipb.PredicateDataResponse.FromString)
+        self._ingest = self.channel.unary_unary(
+            f"/{SERVICE}/IngestRecords",
+            request_serializer=ipb.IngestRequest.SerializeToString,
+            response_deserializer=ipb.IngestResponse.FromString)
+        self._delete_pred = self.channel.unary_unary(
+            f"/{SERVICE}/DeletePredicate",
+            request_serializer=ipb.DeletePredicateRequest.SerializeToString,
+            response_deserializer=ipb.DeletePredicateResponse.FromString)
 
     def append(self, term: int, index: int, data: bytes,
                timeout: float = 5.0) -> ipb.AppendResponse:
@@ -503,6 +602,17 @@ class RemoteWorker:
         lines = json.loads(
             self._schema(ipb.SchemaRequest(preds=list(preds))).schema_json)
         return "\n".join(lines)
+
+    def predicate_data(self, attr: str, read_ts: int,
+                       start_ts: int) -> "ipb.PredicateDataResponse":
+        return self._predicate_data(ipb.PredicateDataRequest(
+            attr=attr, read_ts=read_ts, start_ts=start_ts))
+
+    def ingest_records(self, records) -> None:
+        self._ingest(ipb.IngestRequest(records=list(records)))
+
+    def delete_predicate(self, attr: str) -> None:
+        self._delete_pred(ipb.DeletePredicateRequest(attr=attr))
 
     def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
         return decode_result(self._serve(encode_task(q, read_ts)))

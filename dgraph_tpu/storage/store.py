@@ -143,6 +143,23 @@ class Store:
             return sorted({attr for (kind, attr) in self.by_pred
                            if kind == int(K.KeyKind.DATA)})
 
+    def tablet_sizes(self) -> dict[str, int]:
+        """Approximate bytes served per predicate, across every key space it
+        owns (the size reports a group streams to Zero for rebalancing —
+        worker/groups.go:454-549 periodicMembershipUpdate)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            items = [(attr, list(keys))
+                     for (_kind, attr), keys in self.by_pred.items()]
+        for attr, keys in items:
+            n = out.get(attr, 0)
+            for kb in keys:
+                pl = self.lists.get(kb)
+                if pl is not None:
+                    n += 64 + pl.approx_bytes()
+            out[attr] = n
+        return out
+
     # -- write path ---------------------------------------------------------
 
     def add_mutation(self, start_ts: int, key: K.Key, p: Posting) -> None:
@@ -305,6 +322,14 @@ class Store:
             self.apply_record(json.loads(raw[off : off + n]))
             off += n
             self.wal_record_count += 1
+
+    def ingest_record(self, rec: dict, sync: bool = False) -> None:
+        """Write-and-apply one record through the normal WAL path — the
+        receiving side of a predicate move (worker/predicate_move.go:187
+        batches received KVs into proposals; here the records ARE proposals,
+        so a replicated leader ships them to its quorum automatically)."""
+        self._wal_write(rec, sync=sync)
+        self.apply_record(rec)
 
     def append_replica_record(self, data: bytes, sync: bool = True) -> None:
         """Follower-side replication apply: one shipped WAL record becomes

@@ -157,3 +157,60 @@ def test_failed_mutation_aborts_oracle_txn(cluster):
         cluster.mutate(set_nquads='<0x1> <age> "77" .')
     cluster.zero.unblock_writes("age")
     assert cluster.zero.oracle.pending_count() == before
+
+
+# -- auto-rebalance (dgraph/cmd/zero/tablet.go:60-74) ------------------------
+
+def test_rebalance_moves_tablet_from_skewed_group(tmp_path):
+    from dgraph_tpu.coord.cluster import Cluster
+
+    c = Cluster(n_groups=2)
+    c.alter("name: string @index(exact) .\nbig: string .\nsmall: int .")
+    # force a skew: both heavy tablets on group 0
+    c.zero.move_tablet("name", 0)
+    c.zero.move_tablet("big", 0)
+    c.zero.move_tablet("small", 1)
+    c.mutate(set_nquads="\n".join(
+        f'_:n{i} <name> "person{i}" .\n_:n{i} <big> "{"x" * 200}" .'
+        for i in range(40)) + '\n_:n0 <small> "1"^^<xs:int> .')
+
+    sizes = {g: sum(c.stores[g].tablet_sizes().values()) for g in (0, 1)}
+    assert sizes[0] > sizes[1] / 0.85
+
+    moved = c.rebalance_once()
+    assert moved is not None and moved["src"] == 0 and moved["dst"] == 1
+    # the map flipped and queries stay correct THROUGH the move
+    assert c.zero.tablets()[moved["tablet"]] == 1
+    out = c.query('{ q(func: eq(name, "person3")) { name big } }')
+    assert out["q"][0]["name"] == "person3"
+    assert len(out["q"][0]["big"]) == 200
+
+    # balanced enough now: a second tick is a no-op or improves further
+    again = c.rebalance_once()
+    if again is not None:
+        assert again["tablet"] != moved["tablet"]
+    c.close()
+
+
+def test_rebalancer_background_loop(tmp_path):
+    import time as _t
+
+    from dgraph_tpu.coord.cluster import Cluster
+
+    c = Cluster(n_groups=2)
+    c.alter("name: string @index(exact) .\nbig: string .")
+    c.zero.move_tablet("name", 0)
+    c.zero.move_tablet("big", 0)
+    c.mutate(set_nquads="\n".join(
+        f'_:n{i} <name> "p{i}" .\n_:n{i} <big> "{"y" * 150}" .'
+        for i in range(30)))
+    c.start_rebalancer(interval_s=0.1)
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        if len(set(c.zero.tablets().values())) == 2:
+            break
+        _t.sleep(0.05)
+    assert len(set(c.zero.tablets().values())) == 2, c.zero.tablets()
+    out = c.query('{ q(func: eq(name, "p7")) { name big } }')
+    assert out["q"][0]["name"] == "p7"
+    c.close()

@@ -228,3 +228,100 @@ def test_cross_group_processes(tmp_path, procs):
     assert schema.get("balance") is not None
     assert schema.get("follows") is not None
     client.close()
+
+
+def test_process_move_tablet_and_rebalance(tmp_path, procs):
+    """Tablet move OVER THE WIRE driven from the zero process: HTTP
+    /moveTablet streams the predicate to the destination leader, flips the
+    map, deletes at the source; /state reflects it; queries stay correct.
+    A skewed cluster then auto-rebalances (tablet.go:60-74)."""
+    import json as _json
+    import urllib.request
+
+    # zero with ops HTTP + fast rebalance tick; spawn manually to capture
+    # BOTH ports (http + grpc)
+    env_extra = ["zero", "--port", "0", "--groups", "2",
+                 "--rebalance_interval", "1"]
+    import os as _os, re as _re, subprocess as _sp, sys as _sys, time as _time
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    p = _sp.Popen([_sys.executable, "-m", "dgraph_tpu"] + env_extra,
+                  stdout=_sp.PIPE, stderr=_sp.STDOUT, text=True, env=env,
+                  cwd="/root/repo")
+    procs(p)
+    http_port = grpc_port = None
+    deadline = _time.time() + 60
+    while _time.time() < deadline and (http_port is None or grpc_port is None):
+        line = p.stdout.readline()
+        m = _re.search(r"ops HTTP on [\w.]+:(\d+)", line or "")
+        if m:
+            http_port = int(m.group(1))
+        m = _re.search(r"zero serving .* on [\w.]+:(\d+)", line or "")
+        if m:
+            grpc_port = int(m.group(1))
+    assert http_port and grpc_port
+
+    sf = _write_schema(tmp_path)
+    groups = {}
+    for g in range(2):
+        wp, wport = _spawn(tmp_path, [
+            "worker", "--port", "0", "-p", str(tmp_path / f"mg{g}"),
+            "--schema", sf, "--zero", f"127.0.0.1:{grpc_port}",
+            "--group", str(g)], f"worker g{g}")
+        procs(wp)
+        groups[g] = [f"127.0.0.1:{wport}"]
+
+    client = ClusterClient(f"127.0.0.1:{grpc_port}", groups)
+    client.mutate(set_nquads="\n".join(
+        f'_:n{i} <name> "q{i}" .' for i in range(20)))
+    tablets = client.zero.tablets()
+    src = tablets["name"]
+    dst = 1 - src
+
+    def http_get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}{path}", timeout=30) as r:
+            return _json.loads(r.read())
+
+    out = http_get(f"/moveTablet?tablet=name&group={dst}")
+    assert out.get("tablet") == "name" and out.get("dst") == dst, out
+    assert http_get("/state")["tabletMap"]["name"] == dst
+    res = client.query('{ q(func: eq(name, "q7")) { name } }')
+    assert [x["name"] for x in res["q"]] == ["q7"]
+
+    # deterministic skew: several comparable tablets all on group 0, none
+    # on group 1 — choose_rebalance_move MUST find a tablet fitting half
+    # the gap, so the background rebalancer has to move one within its tick
+    client.mutate(set_nquads="\n".join(
+        f'_:b{i} <balance> "{i}"^^<xs:int> .\n'
+        f'_:b{i} <follows> _:b{(i + 1) % 300} .' for i in range(300)))
+    for t, g in http_get("/state")["tabletMap"].items():
+        if g != 0:
+            http_get(f"/moveTablet?tablet={t}&group=0")
+    before = http_get("/state")["tabletMap"]
+    assert set(before.values()) == {0}
+    deadline = _time.time() + 30
+    moved = False
+    while _time.time() < deadline:
+        now = http_get("/state")["tabletMap"]
+        if any(g != 0 for g in now.values()):
+            moved = True
+            break
+        _time.sleep(0.5)
+    assert moved, f"auto-rebalancer never moved a tablet: {now}"
+    # queries stay correct through the automatic move; allow the client's
+    # 1s tablet-map TTL to lapse (the reference's membership stream has the
+    # same propagation window, worker/groups.go:454)
+    deadline = _time.time() + 10
+    while _time.time() < deadline:
+        client._invalidate()
+        res = client.query("{ q(func: has(balance)) { balance } }")
+        res2 = client.query('{ q(func: eq(name, "q3")) { name } }')
+        if len(res.get("q", [])) == 300 and \
+                [x["name"] for x in res2.get("q", [])] == ["q3"]:
+            break
+        _time.sleep(0.5)
+    assert len(res["q"]) == 300
+    assert [x["name"] for x in res2["q"]] == ["q3"]
+    client.close()
