@@ -32,7 +32,8 @@ from dgraph_tpu.query import upsert as ups
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage import index as idx
 from dgraph_tpu.storage import keys as K
-from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData, build_pred,
+from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData,
+                                          SnapshotAssembler, build_pred,
                                           build_snapshot)
 from dgraph_tpu.storage.postings import Op
 from dgraph_tpu.storage.store import Store
@@ -40,7 +41,6 @@ from dgraph_tpu.parallel.scheduler import Scheduler
 from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.schema import parse_schema
 
-SNAP_CACHE = 4  # snapshots kept device-resident
 
 
 @dataclass
@@ -79,11 +79,15 @@ class Node:
         self._lock = threading.RLock()       # commit/read linearization
         self._inflight_cv = threading.Condition(self._lock)
         self._sched = Scheduler()            # conflict-keyed mutation apply
-        self._snaps: dict[int, GraphSnapshot] = {}
-        # incremental-build cache: attr -> (eff_ts it was built at, PredData).
-        # Reused when no commit touched the predicate since (pred_commit_ts),
-        # so a commit touching one predicate rebuilds one predicate.
-        self._pred_cache: dict[str, tuple[int, PredData]] = {}
+        # incremental per-predicate snapshot reuse (shared with the worker
+        # wire service and follower readers): a commit touching one
+        # predicate re-folds one predicate
+        self._assembler = SnapshotAssembler(
+            self.store,
+            on_pred_build=lambda attr: self.metrics.counter(
+                "dgraph_posting_reads_total").inc(
+                    len(self.store.by_pred.get(
+                        (int(K.KeyKind.DATA), attr), ()))))
         if self.store.max_seen_commit_ts:
             # recover the ts sequence past everything the WAL replayed
             self.zero.oracle.timestamps(self.store.max_seen_commit_ts)
@@ -215,41 +219,11 @@ class Node:
         with self._lock:
             if read_ts is None:
                 read_ts = self.zero.oracle.read_ts()
-            # two read_ts above the newest commit see identical data
-            eff = min(read_ts, self.store.max_seen_commit_ts)
-            snap = self._snaps.get(eff)
-            if snap is None:
-                snap = self._assemble_snapshot(eff)
-                self._snaps[eff] = snap
-                while len(self._snaps) > SNAP_CACHE:
-                    self._snaps.pop(next(iter(self._snaps)))
-            return snap
-
-    def _assemble_snapshot(self, eff: int) -> GraphSnapshot:
-        """Incremental snapshot build: a predicate untouched since its cached
-        build keeps its device arrays (PredData identity); only predicates
-        with commits after the cached eff are re-folded. Reference contract:
-        posting/lists.go:243 read-through — the world is never rebuilt."""
-        snap = GraphSnapshot(eff)
-        for attr in self.store.predicates():
-            pct = self.store.pred_commit_ts.get(attr, 0)
-            cached = self._pred_cache.get(attr)
-            if cached is not None and cached[0] >= pct and eff >= pct:
-                # both views contain every commit to attr (all <= pct)
-                snap.preds[attr] = cached[1]
-                continue
-            pd = build_pred(self.store, attr, eff)
-            self.metrics.counter("dgraph_posting_reads_total").inc(
-                len(self.store.by_pred.get((int(K.KeyKind.DATA), attr), ())))
-            if eff >= pct:
-                self._pred_cache[attr] = (eff, pd)
-            snap.preds[attr] = pd
-        return snap
+            return self._assembler.snapshot(read_ts)
 
     def _invalidate_snapshots(self) -> None:
         with self._lock:
-            self._snaps.clear()
-            self._pred_cache.clear()
+            self._assembler.invalidate()
 
     # -- Query ---------------------------------------------------------------
 
@@ -580,9 +554,7 @@ class Node:
         dropped_snaps = 0
         if stats["bytes"] > budget_bytes:
             with self._lock:
-                dropped_snaps = len(self._snaps) + len(self._pred_cache)
-                self._snaps.clear()
-                self._pred_cache.clear()
+                dropped_snaps = self._assembler.invalidate()
         self.metrics.counter("dgraph_memory_bytes").set(stats["bytes"])
         return {"bytes": stats["bytes"], "lists": stats["lists"],
                 "layers": stats["layers"], "rolled_up": rolled,

@@ -68,6 +68,10 @@ class Oracle:
         self._key_commit: dict[int, int] = {}     # fingerprint -> max commit_ts
         self._pending: dict[int, TxnState] = {}   # start_ts -> state
         self._aborted: set[int] = set()
+        # attr -> highest commit_ts ASSIGNED to a txn touching it: tablet
+        # moves wait for the owner to APPLY up to this before streaming
+        # (a Decide RPC still in flight must not be left behind)
+        self.pred_commit: dict[str, int] = {}
         self.max_assigned = 0
         self._decisions = 0                       # purge cadence counter
 
@@ -167,6 +171,9 @@ class Oracle:
                 prev = self._key_commit.get(fp, 0)
                 if commit_ts > prev:
                     self._key_commit[fp] = commit_ts
+            for pred in st.preds:
+                if commit_ts > self.pred_commit.get(pred, 0):
+                    self.pred_commit[pred] = commit_ts
             del self._pending[start_ts]
             self._decisions += 1
             if self._decisions % self.PURGE_EVERY == 0:

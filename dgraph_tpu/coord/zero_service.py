@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent import futures
 
 try:
@@ -188,6 +189,24 @@ class ZeroOps:
                 for ts in self.zero.oracle.pending_on(attr):
                     self.zero.oracle.abort(ts)
                     aborted += 1
+                # a commit DECIDED at the oracle may still have its Decide
+                # RPC in flight to the source leader; streaming before it
+                # applies would silently drop committed postings (and the
+                # source delete would destroy them). Wait for the source's
+                # applied per-tablet watermark to reach the oracle's.
+                target = self.zero.oracle.pred_commit.get(attr, 0)
+                deadline = time.monotonic() + 5.0
+                while target and time.monotonic() < deadline:
+                    applied = json.loads(
+                        src.membership().pred_commit_json or "{}")
+                    if int(applied.get(attr, 0)) >= target:
+                        break
+                    time.sleep(0.05)
+                else:
+                    if target:
+                        raise MoveError(
+                            f"source never applied commits on {attr!r} up "
+                            f"to ts {target} (lost Decide?); move aborted")
                 read_ts = self.zero.oracle.read_ts()
                 move_st = self.zero.oracle.new_txn()
                 keys_b64 = []
@@ -315,7 +334,10 @@ def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
 def serve_zero(zero: Zero, addr: str = "localhost:0", max_workers: int = 8):
     """Start the Zero gRPC server; returns (server, bound_port, service)."""
     svc = ZeroService(zero)
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    from ..parallel.remote import GRPC_OPTIONS
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((svc.handler(),))
     port = server.add_insecure_port(addr)
     if port == 0:

@@ -201,10 +201,16 @@ class ClusterClient:
         client holds NO local tablet (all-remote NetworkDispatcher). A
         transport failure (e.g. cached leader died) invalidates the
         leader/tablet caches and retries once against fresh discovery."""
+        import grpc as _grpc
+
+        transport_errors = (_grpc.RpcError, ConnectionError, OSError,
+                            RuntimeError)   # RuntimeError: no live leader
         for attempt in (0, 1):
             try:
                 return self._query_once(q, variables)
-            except Exception:
+            except transport_errors:
+                # parse/semantic errors propagate directly — only transport
+                # failures warrant cache invalidation + a second fan-out
                 if attempt:
                     raise
                 self._invalidate()

@@ -24,10 +24,16 @@ except ImportError:              # pragma: no cover
 
 from ..protos import internal_pb2 as ipb
 from ..query.task import TaskQuery, TaskResult, process_task
+from ..storage.csr_build import STRUCTURAL_RECORDS
 from ..storage.postings import DirectedEdge, Op
 from ..storage.store import _val_from_json, _val_to_json
 
 SERVICE = "dgraph_tpu.internal.Worker"
+
+# tablet payloads (predicate moves, snapshot streams) far exceed gRPC's 4 MB
+# default; the reference raises its cap to 4 GB (x/x.go:56 GrpcMaxSize)
+GRPC_OPTIONS = [("grpc.max_send_message_length", 1 << 30),
+                ("grpc.max_receive_message_length", 1 << 30)]
 
 
 def _uids_to_bytes(a) -> bytes:
@@ -163,13 +169,11 @@ class WorkerService:
         import os
         import threading
 
-        from ..storage.csr_build import build_snapshot
+        from ..storage.csr_build import SnapshotAssembler
 
         self.store = store
-        self._build_snapshot = build_snapshot
+        self._assembler = SnapshotAssembler(store)
         self._lock = threading.Lock()
-        self._snap = None
-        self._snap_ts = -1
         # replication role. _rlock guards follower-side state ONLY; the
         # leader-side _ship path deliberately takes no service lock (it runs
         # under the store lock — taking _rlock there would ABBA-deadlock
@@ -201,16 +205,11 @@ class WorkerService:
         self.store.wal_sink = None
 
     def _snapshot(self, read_ts: int):
-        # visibility is commit_ts <= read_ts, so build at eff exactly
-        # (eff+1 would leak a commit landing at that ts); the lock keeps the
-        # 8-thread gRPC pool from cross-serving snapshots built for
-        # different read timestamps
-        eff = min(read_ts, self.store.max_seen_commit_ts)
+        # incremental: a commit touching one predicate re-folds exactly that
+        # predicate (SnapshotAssembler reuses PredData identity for clean
+        # ones); the lock keeps the 8-thread gRPC pool from racing assembly
         with self._lock:
-            if self._snap is None or self._snap_ts != eff:
-                self._snap = self._build_snapshot(self.store, read_ts=eff)
-                self._snap_ts = eff
-            return self._snap
+            return self._assembler.snapshot(read_ts)
 
     def serve_task(self, msg: ipb.TaskRequest, context) -> ipb.TaskResponse:
         q, read_ts = decode_task(msg)
@@ -221,7 +220,8 @@ class WorkerService:
                    context) -> ipb.MembershipResponse:
         return ipb.MembershipResponse(
             tablets=self.store.predicates(),
-            max_commit_ts=self.store.max_seen_commit_ts)
+            max_commit_ts=self.store.max_seen_commit_ts,
+            pred_commit_json=json.dumps(dict(self.store.pred_commit_ts)))
 
     def mutate(self, msg: ipb.MutateRequest, context) -> ipb.MutateResponse:
         """Apply one txn's slice of edges on this group (MutateOverNetwork's
@@ -248,8 +248,8 @@ class WorkerService:
         keys = list(msg.keys)
         if msg.commit_ts:
             self.store.commit(msg.start_ts, msg.commit_ts, keys)
-            with self._lock:
-                self._snap = None      # next read rebuilds past the commit
+            # no explicit invalidation: the commit bumped pred_commit_ts,
+            # which the assembler's per-predicate reuse keys on
         else:
             self.store.abort(msg.start_ts, keys)
         return ipb.DecisionResponse()
@@ -361,10 +361,13 @@ class WorkerService:
                                               log_len=self._last_seq)
                 return ipb.AppendResponse(ok=False, term=self.term,
                                           log_len=self._last_seq)
-            self.store.append_replica_record(bytes(msg.data))
+            data = bytes(msg.data)
+            rec = json.loads(data)       # parsed once, applied below as-is
+            self.store.append_replica_record(data, rec=rec)
             self._last_seq = int(msg.index)
-            with self._lock:
-                self._snap = None       # reads must see the applied record
+            if rec.get("t") in STRUCTURAL_RECORDS:
+                with self._lock:
+                    self._assembler.invalidate()
             return ipb.AppendResponse(ok=True, term=self.term,
                                       log_len=self._last_seq)
 
@@ -464,10 +467,14 @@ class WorkerService:
         if self.term > 0 and not self.is_leader:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           f"not leader (term {self.term})")
+        structural = False
         for data in msg.records:
-            self.store.ingest_record(json.loads(bytes(data)))
-        with self._lock:
-            self._snap = None
+            rec = json.loads(bytes(data))
+            structural |= rec.get("t") in STRUCTURAL_RECORDS
+            self.store.ingest_record(rec)
+        if structural:
+            with self._lock:
+                self._assembler.invalidate()
         return ipb.IngestResponse()
 
     def delete_predicate(self, msg: ipb.DeletePredicateRequest,
@@ -479,7 +486,7 @@ class WorkerService:
                           f"not leader (term {self.term})")
         self.store.delete_predicate(msg.attr)
         with self._lock:
-            self._snap = None
+            self._assembler.invalidate()
         return ipb.DeletePredicateResponse()
 
     def handler(self):
@@ -515,7 +522,8 @@ def serve_worker(store, addr: str = "localhost:0",
                  max_workers: int = 8):
     """Start a Worker gRPC server for one group's store; returns
     (server, bound_port)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((WorkerService(store).handler(),))
     port = server.add_insecure_port(addr)
     if port == 0:
@@ -529,7 +537,7 @@ class RemoteWorker:
 
     def __init__(self, addr: str) -> None:
         self.addr = addr
-        self.channel = grpc.insecure_channel(addr)
+        self.channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
         self._serve = self.channel.unary_unary(
             f"/{SERVICE}/ServeTask",
             request_serializer=ipb.TaskRequest.SerializeToString,

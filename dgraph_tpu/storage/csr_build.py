@@ -327,3 +327,79 @@ def build_snapshot(store: Store, read_ts: int,
     for attr in todo:
         snap.preds[attr] = build_pred(store, attr, read_ts, own_start_ts)
     return snap
+
+
+class SnapshotAssembler:
+    """Incremental snapshot cache: per-predicate PredData reuse keyed on the
+    store's per-predicate commit watermark (pred_commit_ts), plus a small
+    per-read-ts snapshot cache. A commit touching ONE predicate re-folds
+    one predicate; everything else keeps device-array identity. This is the
+    read-through contract of posting/lists.go:243 — the world is never
+    rebuilt — shared by the embedded Node, the worker wire service, and
+    follower readers (VERDICT r3 #6)."""
+
+    SNAP_CACHE = 4
+
+    def __init__(self, store, on_pred_build=None) -> None:
+        self.store = store
+        self.on_pred_build = on_pred_build       # callback(attr) per re-fold
+        self._pred_cache: dict[str, tuple[int, PredData]] = {}
+        self._snaps: dict[int, GraphSnapshot] = {}
+
+    def snapshot(self, read_ts: int) -> GraphSnapshot:
+        """Committed view at read_ts (clamped to the newest commit: two
+        read_ts above it see identical data and share the cache entry)."""
+        eff = min(read_ts, self.store.max_seen_commit_ts)
+        snap = self._snaps.get(eff)
+        if snap is None or self._stale(snap):
+            snap = self._assemble(eff)
+            self._snaps[eff] = snap
+            while len(self._snaps) > self.SNAP_CACHE:
+                self._snaps.pop(next(iter(self._snaps)))
+        return snap
+
+    def _stale(self, snap: GraphSnapshot) -> bool:
+        # a commit can land at a ts at/below a cached eff only through
+        # replication replay races; guard: the predicate set must match
+        # (a replayed commit can CREATE a predicate the cached snap lacks)
+        # and no cached pred may predate its commit watermark
+        if set(snap.preds) != set(self.store.predicates()):
+            return True
+        for attr, pd in snap.preds.items():
+            if self.store.pred_commit_ts.get(attr, 0) > snap.read_ts:
+                return True
+        return False
+
+    def _assemble(self, eff: int) -> GraphSnapshot:
+        snap = GraphSnapshot(eff)
+        for attr in self.store.predicates():
+            pct = self.store.pred_commit_ts.get(attr, 0)
+            cached = self._pred_cache.get(attr)
+            if cached is not None and cached[0] >= pct and eff >= pct:
+                # both views contain every commit to attr (all <= pct)
+                snap.preds[attr] = cached[1]
+                continue
+            pd = build_pred(self.store, attr, eff)
+            if self.on_pred_build is not None:
+                self.on_pred_build(attr)
+            if eff >= pct:
+                self._pred_cache[attr] = (eff, pd)
+            snap.preds[attr] = pd
+        return snap
+
+    def invalidate(self) -> int:
+        """Structural change (schema, drop, predicate delete): every cached
+        view may be wrong — rebuild from scratch on next read. Returns the
+        number of dropped cache entries (memory accounting)."""
+        n = len(self._pred_cache) + len(self._snaps)
+        self._pred_cache.clear()
+        self._snaps.clear()
+        return n
+
+    def cache_size(self) -> int:
+        return len(self._pred_cache) + len(self._snaps)
+
+
+# WAL record types that change visible structure beyond the per-predicate
+# commit watermark: schema lines, predicate/kind drops
+STRUCTURAL_RECORDS = frozenset({"s", "dp", "dk"})

@@ -331,19 +331,22 @@ class Store:
         self._wal_write(rec, sync=sync)
         self.apply_record(rec)
 
-    def append_replica_record(self, data: bytes, sync: bool = True) -> None:
+    def append_replica_record(self, data: bytes, sync: bool = True,
+                              rec: dict | None = None) -> None:
         """Follower-side replication apply: one shipped WAL record becomes
         durable in this replica's own log AND live in memory, atomically
         under the store lock (the worker/draft.go:485-624 store-then-apply
         order, collapsed because the record is already quorum-ordered by
-        the leader)."""
+        the leader). Pass `rec` when the caller already parsed the bytes
+        (the replication hot path parses once)."""
         with self._lock:
             if self._wal is not None:
                 self._wal.write(_U32.pack(len(data)) + data)
                 if sync:
                     self._wal.flush()
                     os.fsync(self._wal.fileno())
-            self._apply_record_locked(json.loads(data))
+            self._apply_record_locked(rec if rec is not None
+                                      else json.loads(data))
             self.wal_record_count += 1
 
     def apply_record(self, rec: dict) -> None:

@@ -121,3 +121,62 @@ def test_regexp_matches_any_list_element(node):
                            '_:c <nick> "zedding" .', commit_now=True)
     out, _ = node.query('{ q(func: regexp(nick, /zedd/)) { name } }')
     assert _names(out) == ["cyd"]
+
+
+# -- incremental snapshots on workers + followers (VERDICT r3 #6) ------------
+
+def test_worker_snapshot_rebuilds_one_predicate(tmp_path):
+    """A commit touching one predicate re-folds that predicate only — every
+    other PredData keeps array identity on the worker wire service."""
+    pytest.importorskip("grpc")
+    from dgraph_tpu.parallel.remote import WorkerService
+    from dgraph_tpu.query import mutation as mut
+    from dgraph_tpu.query import rdf
+    from dgraph_tpu.storage.postings import DirectedEdge, Op
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    s = Store()
+    for e in parse_schema("a: int .\nb: int ."):
+        s.set_schema(e)
+    for ts, (attr, val) in ((1, ("a", 1)), (3, ("b", 2))):
+        touched, _, _ = mut.apply_mutations(
+            s, [DirectedEdge(1, attr, value=Val(TypeID.INT, val))], ts)
+        s.commit(ts, ts + 1, touched)
+    svc = WorkerService(s)
+    snap1 = svc._snapshot(10)
+    pd_a1, pd_b1 = snap1.preds["a"], snap1.preds["b"]
+
+    # commit touching ONLY b
+    touched, _, _ = mut.apply_mutations(
+        s, [DirectedEdge(2, "b", value=Val(TypeID.INT, 9))], 20)
+    s.commit(20, 21, touched)
+    snap2 = svc._snapshot(30)
+    assert snap2.preds["a"] is pd_a1          # untouched: same arrays
+    assert snap2.preds["b"] is not pd_b1      # re-folded past the commit
+    assert 2 in snap2.preds["b"].host_values
+
+
+def test_follower_snapshot_rebuilds_one_predicate(tmp_path):
+    from dgraph_tpu.coord.replication import ReplicaGroup
+
+    g = ReplicaGroup(str(tmp_path / "grp"), n=3, serve_reads=True)
+    try:
+        g.node.alter(schema_text="a: int .\nb: int .")
+        g.node.mutate(set_nquads='<0x1> <a> "1"^^<xs:int> .\n'
+                                 '<0x1> <b> "2"^^<xs:int> .', commit_now=True)
+        f = next(m.reader for m in g.members if m.reader is not None)
+        assert f.query("{ q(func: has(a)) { a b } }")["q"] == [
+            {"a": 1, "b": 2}]
+        snap1 = f._assembler.snapshot(f.store.max_seen_commit_ts)
+        pd_a1, pd_b1 = snap1.preds["a"], snap1.preds["b"]
+
+        g.node.mutate(set_nquads='<0x2> <b> "9"^^<xs:int> .', commit_now=True)
+        out = f.query("{ q(func: has(b)) { b } }")
+        assert sorted(x["b"] for x in out["q"]) == [2, 9]
+        snap2 = f._assembler.snapshot(f.store.max_seen_commit_ts)
+        assert snap2.preds["a"] is pd_a1
+        assert snap2.preds["b"] is not pd_b1
+    finally:
+        g.close()
