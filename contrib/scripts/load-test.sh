@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# End-to-end load test against a REAL multi-process cluster (reference:
+# contrib/scripts/load-test.sh): boots zero + a 3-replica group + a second
+# group, promotes a leader, loads data through transactions, runs a query
+# battery, kills the leader with SIGKILL, fails over, and re-verifies.
+#
+# Usage: contrib/scripts/load-test.sh [n_rows]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+exec python3 contrib/scripts/load_test.py "${1:-2000}"

@@ -1,0 +1,101 @@
+"""Driver behind contrib/scripts/load-test.sh — the systest topology as an
+operator-facing script (spawns real CLI processes, no pytest)."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.getcwd())
+
+from dgraph_tpu.parallel.client import ClusterClient          # noqa: E402
+from dgraph_tpu.parallel.remote import RemoteWorker           # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+PROCS = []
+
+
+def spawn(args, tag):
+    env = dict(os.environ, PYTHONUNBUFFERED="1")
+    p = subprocess.Popen([sys.executable, "-m", "dgraph_tpu"] + args,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env)
+    PROCS.append(p)
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        m = re.search(r"serving .* on [\w.]+:(\d+)", line or "")
+        if m:
+            return p, int(m.group(1))
+    raise SystemExit(f"{tag} never came up")
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="dgraph-tpu-loadtest-")
+    schema = os.path.join(tmp, "schema.txt")
+    with open(schema, "w") as f:
+        f.write("name: string @index(exact, term) .\n"
+                "score: int @index(int) .\nfollows: [uid] @reverse .\n")
+    _, zport = spawn(["zero", "--port", "0", "--groups", "2"], "zero")
+    groups = {}
+    workers = []
+    for g, n_rep in ((0, 3), (1, 1)):
+        addrs = []
+        for r in range(n_rep):
+            wp, wport = spawn(["worker", "--port", "0",
+                               "-p", f"{tmp}/g{g}r{r}", "--schema", schema,
+                               "--zero", f"127.0.0.1:{zport}",
+                               "--group", str(g)], f"worker g{g}r{r}")
+            workers.append((wp, f"127.0.0.1:{wport}", g))
+            addrs.append(f"127.0.0.1:{wport}")
+        groups[g] = addrs
+    replicas = [RemoteWorker(a) for a in groups[0]]
+    assert replicas[0].promote(1, groups[0][1:]).ok
+    c = ClusterClient(f"127.0.0.1:{zport}", groups)
+
+    t0 = time.time()
+    B = 250
+    for lo in range(0, N, B):
+        rows = [f'_:n{i} <name> "user{i}" .\n'
+                f'_:n{i} <score> "{i % 100}"^^<xs:int> .\n'
+                f'_:n{i} <follows> _:n{(i * 7 + 1) % N} .'
+                for i in range(lo, min(lo + B, N))]
+        c.mutate(set_nquads="\n".join(rows))
+    dt = time.time() - t0
+    print(f"loaded {N} rows in {dt:.1f}s ({N / dt:.0f} rows/s)")
+
+    def battery():
+        out = c.query('{ q(func: eq(name, "user7")) '
+                      '{ name score follows { name } } }')
+        assert out["q"][0]["score"] == 7, out
+        out = c.query('{ q(func: ge(score, 98)) { count(uid) } }')
+        assert out["q"][0]["count"] == 2 * (N // 100), out
+        out = c.query('{ q(func: anyofterms(name, "user3 user4")) { name } }')
+        assert len(out["q"]) == 2, out
+    battery()
+    print("query battery OK")
+
+    leader_proc = workers[0][0]
+    os.kill(leader_proc.pid, signal.SIGKILL)
+    stats = [((r.status().max_commit_ts, r.status().log_len), i)
+             for i, r in enumerate(replicas[1:], 1)]
+    new = max(stats)[1]
+    peers = [a for j, a in enumerate(groups[0]) if j not in (0, new)]
+    assert replicas[new].promote(2, peers).ok
+    battery()
+    print(f"failover OK (replica {new} leads at term 2); battery re-passed")
+    c.close()
+
+
+if __name__ == "__main__":
+    try:
+        main()
+        print("LOAD TEST PASSED")
+    finally:
+        for p in PROCS:
+            if p.poll() is None:
+                p.kill()

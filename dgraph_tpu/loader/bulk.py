@@ -303,7 +303,7 @@ def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
                     slots.append(slot)
                     postings[slot] = Posting(slot, Op.SET, v, lang, fa)
                     if entry.indexed:
-                        for tk in index_tokens(entry, v):
+                        for tk in index_tokens(entry, v, lang):
                             tokens.setdefault(tk, []).append(s)
                     stats.values += 1
                 emit(K.data_key(attr, s).encode(),

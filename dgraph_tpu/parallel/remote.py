@@ -31,7 +31,9 @@ from ..storage.store import _val_from_json, _val_to_json
 SERVICE = "dgraph_tpu.internal.Worker"
 
 # tablet payloads (predicate moves, snapshot streams) far exceed gRPC's 4 MB
-# default; the reference raises its cap to 4 GB (x/x.go:56 GrpcMaxSize)
+# default. The reference uses 4 GB (x/x.go:56 GrpcMaxSize); protobuf itself
+# caps a message at 2 GB, so 1 GiB is the practical single-message bound
+# here — tablets beyond it need the move chunked, not a bigger cap.
 GRPC_OPTIONS = [("grpc.max_send_message_length", 1 << 30),
                 ("grpc.max_receive_message_length", 1 << 30)]
 

@@ -453,9 +453,12 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
     if fname in ("anyofterms", "allofterms"):
         return _terms_func(pd, schema, fname, str(args[0]), "term")
     if fname in ("anyoftext", "alloftext"):
+        # the attr's lang tag picks the full-text analyzer (tok/fts.go):
+        # alloftext(desc@ru, ...) stems the query the way @ru values were
+        # indexed
         return _terms_func(pd, schema,
                            "anyofterms" if fname == "anyoftext" else "allofterms",
-                           str(args[0]), "fulltext")
+                           str(args[0]), "fulltext", lang=q.lang)
     if fname == "regexp":
         return _regexp_func(pd, schema, str(args[0]),
                             str(args[1]) if len(args) > 1 else "")
@@ -489,7 +492,8 @@ def _empty_or_missing_index(pd: PredData, schema, tokname: str) -> np.ndarray | 
     return None
 
 
-def _terms_func(pd: PredData, schema, fname: str, text: str, tokname: str) -> np.ndarray:
+def _terms_func(pd: PredData, schema, fname: str, text: str, tokname: str,
+                lang: str = "") -> np.ndarray:
     ti = pd.indexes.get(tokname)
     if ti is None:
         empty = _empty_or_missing_index(pd, schema, tokname)
@@ -497,7 +501,10 @@ def _terms_func(pd: PredData, schema, fname: str, text: str, tokname: str) -> np
             return empty
         raise TaskError(f"predicate {pd.attr} needs @index({tokname})")
     tz = tokmod.get(tokname)
-    toks = [t[1:] for t in tz.tokens(Val(TypeID.STRING, text))]
+    if tokname == "fulltext" and lang:
+        toks = tokmod.fulltext_tokens(text, lang.split(":")[0])
+    else:
+        toks = [t[1:] for t in tz.tokens(Val(TypeID.STRING, text))]
     rows = [r for t in toks if (r := ti.term_row(t)) >= 0]
     if fname == "allofterms":
         if len(rows) != len(toks):
@@ -518,25 +525,37 @@ def _regexp_func(pd: PredData, schema, pattern: str, flags: str) -> np.ndarray:
     rx = remod.compile(pattern, remod.IGNORECASE if "i" in flags else 0)
     # candidate trigrams: any literal 3-gram required by the pattern; fall
     # back to scanning every indexed uid when the pattern has no required
-    # literal. Case-insensitive patterns prune by the union of each required
-    # trigram's 2^3 case variants (the index stores raw-case trigrams) —
-    # codesearch's case-folded query expansion, not a full scan.
-    literals = _required_trigrams(pattern)
-    if literals and "i" in flags:
+    # per-branch OR-of-AND trigram query (worker/trigram.go:36 + codesearch
+    # index/regexp): candidates = union over alternation branches of the
+    # intersection of each required trigram's uid list. Case-insensitive
+    # patterns probe each trigram's 2^3 case variants (the index stores
+    # raw-case trigrams) — case-folded query expansion, not a full scan.
+    plan = _trigram_plan(pattern)
+    # inline ignorecase ((?i) / (?i:...)) is invisible to the literal
+    # analysis — the trigrams come out exact-case, so the probe must
+    # case-expand exactly as for /re/i. Substring detection over-matches
+    # (e.g. an escaped paren) only toward a WIDER probe — always sound.
+    ci = "i" in flags or "(?i" in pattern
+    if plan is not None:
         cands = None
-        for t in literals:
-            rows = [r for v in _case_variants(t)
-                    if (r := ti.term_row(v.encode())) >= 0]
-            uids = _index_uids_for_rows(ti, rows)
-            cands = uids if cands is None else us.intersect_host(cands, uids)
-            if not len(cands):
-                break
-        cands = cands if cands is not None else np.zeros(0, np.int64)
-    elif literals:
-        rows = [r for t in literals if (r := ti.term_row(t.encode())) >= 0]
-        cands = _index_uids_intersect_rows(ti, rows) if rows and len(rows) == len(literals) \
-            else _index_uids_for_rows(ti, rows)
-        if not rows:
+        for tris in plan:
+            branch = None
+            for t in tris:
+                if ci:
+                    rows = [r for v in _case_variants(t)
+                            if (r := ti.term_row(v.encode())) >= 0]
+                else:
+                    r0 = ti.term_row(t.encode())
+                    rows = [r0] if r0 >= 0 else []
+                uids = _index_uids_for_rows(ti, rows)
+                branch = uids if branch is None \
+                    else us.intersect_host(branch, uids)
+                if not len(branch):
+                    break
+            if branch is not None and len(branch):
+                cands = branch if cands is None \
+                    else np.union1d(cands, branch)
+        if cands is None:
             cands = np.zeros(0, np.int64)
     else:
         nrows = max(len(ti.terms), 0)
@@ -559,36 +578,101 @@ def _case_variants(tri: str) -> list[str]:
     return out
 
 
-def _required_trigrams(pattern: str) -> list[str]:
-    """Literal trigrams that every match must contain (simplified codesearch
-    query planning): longest literal run outside character classes/operators.
+_MAX_PLAN_ALTS = 16     # alternation product cap (planner bail-out)
 
-    Alternation (`a|b`), groups (`(ab)?` can make a whole run optional), and
-    counted repeats (`b{0,3}`) mean no single run is required — those
-    patterns fall back to the unpruned scan rather than risk dropping
-    matches (the reference's planner builds per-branch OR queries here,
-    worker/trigram.go + codesearch index/regexp)."""
-    runs, cur = [], []
-    escaped = False
-    for c in pattern:
-        if escaped:
-            cur.append(c)
-            escaped = False
-        elif c == "\\":
-            escaped = True
-        elif c in "(|{":
-            return []
-        elif c in ".*+?)[]}^$":
-            if c in "*?":   # preceding char is optional — drop it
-                if cur:
-                    cur.pop()
-            runs.append("".join(cur))
-            cur = []
+
+def _lit_alternatives(seq) -> list[list[str]] | None:
+    """Required-literal analysis of a parsed regex sequence (simplified
+    codesearch index/regexp, the planner behind worker/trigram.go:36).
+
+    Returns a list of alternatives — ANY match satisfies at least one — and
+    for each alternative the list of literal runs EVERY match of it must
+    contain. Soundness rules: constructs we don't model (classes, anchors,
+    backrefs, min==0 repeats) contribute nothing and break the current run;
+    group/repeat boundaries also break runs (never concatenate across them,
+    "ab+c" must not claim "abc"). None = give up (caller scans)."""
+    import re._parser as sre
+
+    alts: list[list[str]] = [[""]]      # per alternative: runs; last is open
+
+    def brk(a):
+        if a[-1] != "":
+            a.append("")
+
+    def product(sub_alts):
+        nonlocal alts
+        if sub_alts is None:
+            return False
+        if len(alts) * len(sub_alts) > _MAX_PLAN_ALTS:
+            return False
+        out = []
+        for a in alts:
+            base = a if a[-1] == "" else a + [""]
+            for s in sub_alts:
+                out.append(base + [r for r in s if r] + [""])
+            # the empty-run padding keeps sub-runs from concatenating
+        alts = out
+        return True
+
+    for op, av in seq:
+        name = str(op)
+        if name == "LITERAL":
+            ch = chr(av)
+            for a in alts:
+                a[-1] += ch
+        elif name == "SUBPATTERN":
+            sub = av[3]
+            if not product(_lit_alternatives(sub)):
+                return None
+        elif name == "BRANCH":
+            branches = av[1]
+            sub_alts: list[list[str]] = []
+            for b in branches:
+                r = _lit_alternatives(b)
+                if r is None:
+                    return None
+                sub_alts.extend(r)
+            if not product(sub_alts):
+                return None
+        elif name in ("MAX_REPEAT", "MIN_REPEAT"):
+            mn, _mx, sub = av
+            if mn >= 1:
+                # at least one occurrence is required
+                if not product(_lit_alternatives(sub)):
+                    return None
+            else:
+                for a in alts:
+                    brk(a)
         else:
-            cur.append(c)
-    runs.append("".join(cur))
-    best = max(runs, key=len, default="")
-    return [best[i : i + 3] for i in range(len(best) - 2)] if len(best) >= 3 else []
+            # IN / ANY / AT / CATEGORY / GROUPREF / ...: matches something
+            # we don't track — requireds on either side still hold
+            for a in alts:
+                brk(a)
+    return [[r for r in a if r] for a in alts]
+
+
+def _trigram_plan(pattern: str) -> list[list[str]] | None:
+    """OR-of-AND trigram query for a pattern: one AND-list per alternation
+    branch (candidates = union over branches of the intersection of each
+    trigram's uid list). None = no branch has a literal >= 3 chars, or the
+    pattern is beyond the planner — caller falls back to the full scan."""
+    try:
+        import re._parser as sre
+
+        parsed = list(sre.parse(pattern))
+    except Exception:
+        return None
+    alts = _lit_alternatives(parsed)
+    if alts is None:
+        return None
+    plan = []
+    for runs in alts:
+        tris = sorted({run[i: i + 3] for run in runs if len(run) >= 3
+                       for i in range(len(run) - 2)})
+        if not tris:
+            return None     # one unbounded branch poisons the whole query
+        plan.append(tris)
+    return plan
 
 
 def _geo_func(pd: PredData, schema, fname: str, args: list) -> np.ndarray:

@@ -359,16 +359,26 @@ class SnapshotAssembler:
         return snap
 
     def _stale(self, snap: GraphSnapshot) -> bool:
-        # a commit can land at a ts at/below a cached eff only through
-        # replication replay races; guard: the predicate set must match
-        # (a replayed commit can CREATE a predicate the cached snap lacks)
-        # and no cached pred may predate its commit watermark
-        if set(snap.preds) != set(self.store.predicates()):
-            return True
-        for attr, pd in snap.preds.items():
-            if self.store.pred_commit_ts.get(attr, 0) > snap.read_ts:
-                return True
+        # A cached snapshot at read_ts is immutable under NORMAL commits
+        # (they land above read_ts and are invisible to it). The only way
+        # it rots is a commit arriving AT/BELOW read_ts after assembly —
+        # replication replay races — so compare each predicate's commit
+        # watermark against the value stamped at assembly, and only when
+        # the new watermark is visible at this read_ts. A plain
+        # "watermark > read_ts" check would mark every old-ts snapshot
+        # permanently stale the moment any newer commit lands.
+        stamped = getattr(snap, "pred_watermarks", None)
+        if stamped is None:
+            return True                   # built before stamping existed
+        for attr in self.store.predicates():
+            pct = self.store.pred_commit_ts.get(attr, 0)
+            if pct <= snap.read_ts and stamped.get(attr) != pct:
+                return True               # replayed/new commit now visible
         return False
+
+    def _stamp(self, snap: GraphSnapshot) -> None:
+        snap.pred_watermarks = {
+            a: self.store.pred_commit_ts.get(a, 0) for a in snap.preds}
 
     def _assemble(self, eff: int) -> GraphSnapshot:
         snap = GraphSnapshot(eff)
@@ -385,6 +395,7 @@ class SnapshotAssembler:
             if eff >= pct:
                 self._pred_cache[attr] = (eff, pd)
             snap.preds[attr] = pd
+        self._stamp(snap)
         return snap
 
     def invalidate(self) -> int:

@@ -20,12 +20,17 @@ from dgraph_tpu.utils.schema import SchemaEntry
 from dgraph_tpu.utils.types import TypeID, Val, convert
 
 
-def index_tokens(entry: SchemaEntry, v: Val) -> list[bytes]:
+def index_tokens(entry: SchemaEntry, v: Val, lang: str = "") -> list[bytes]:
     """All index terms for a value under a predicate's tokenizers
-    (reference posting/index.go:44 indexTokens)."""
+    (reference posting/index.go:44 indexTokens). The lang tag selects the
+    full-text analyzer (tok/fts.go) — index and query must agree."""
     out: list[bytes] = []
     for name in entry.tokenizers:
         tz = tok.get(name)
+        if name == "fulltext" and lang:
+            out.extend(bytes([tz.ident]) + t
+                       for t in tok.fulltext_tokens(str(v.value), lang))
+            continue
         sv = convert(v, tz.type_id) if v.tid != tz.type_id else v
         out.extend(tz.tokens(sv))
     return out
@@ -57,14 +62,17 @@ def add_mutation_with_index(store: Store, edge: DirectedEdge, start_ts: int) -> 
     # index edits for value predicates
     if entry.indexed:
         if edge.op == Op.DEL_ALL:
-            for old in pl.all_values(start_ts, own_start_ts=start_ts):
-                _index_edit(store, entry, old, edge.subject, start_ts, Op.DEL, touched)
+            for p in pl.postings(start_ts, own_start_ts=start_ts):
+                if p.value is not None:
+                    _index_edit(store, entry, p.value, edge.subject,
+                                start_ts, Op.DEL, touched, lang=p.lang)
         elif edge.value is not None:
             new_val = _edge_val(edge, entry)
             if entry.is_list:
                 # list-valued scalars accumulate; only an explicit DEL of one
                 # value removes that value's tokens
-                _index_edit(store, entry, new_val, edge.subject, start_ts, edge.op, touched)
+                _index_edit(store, entry, new_val, edge.subject, start_ts,
+                            edge.op, touched, lang=edge.lang)
             else:
                 # single-valued: the old value lives in exactly this slot —
                 # a lang-agnostic read here would wrongly delete another
@@ -75,13 +83,13 @@ def add_mutation_with_index(store: Store, edge: DirectedEdge, start_ts: int) -> 
                                             own_start_ts=start_ts)
                 if old_val is not None:
                     _index_edit(store, entry, old_val, edge.subject, start_ts,
-                                Op.DEL, touched)
+                                Op.DEL, touched, lang=edge.lang)
                 if edge.op == Op.SET:
                     _index_edit(store, entry, new_val, edge.subject, start_ts,
-                                Op.SET, touched)
+                                Op.SET, touched, lang=edge.lang)
                 elif edge.op == Op.DEL and old_val is None:
                     _index_edit(store, entry, new_val, edge.subject, start_ts,
-                                Op.DEL, touched)
+                                Op.DEL, touched, lang=edge.lang)
 
     # reverse edges (uid predicates with @reverse)
     if entry.reverse and edge.value is None and edge.op != Op.DEL_ALL:
@@ -111,10 +119,11 @@ def add_mutation_with_index(store: Store, edge: DirectedEdge, start_ts: int) -> 
 
 
 def _index_edit(store: Store, entry: SchemaEntry, v: Val | None, subject: int,
-                start_ts: int, op: Op, touched: list[bytes]) -> None:
+                start_ts: int, op: Op, touched: list[bytes],
+                lang: str = "") -> None:
     if v is None:
         return
-    for term in index_tokens(entry, v):
+    for term in index_tokens(entry, v, lang):
         ik = K.index_key(entry.predicate, term)
         store.add_mutation(start_ts, ik, Posting(subject, op))
         touched.append(ik.encode())
@@ -133,8 +142,10 @@ def rebuild_index(store: Store, attr: str, read_ts: int, commit_ts: int) -> None
     sts = -commit_ts  # synthetic rebuild txn
     for kb in store.keys_of(K.KeyKind.DATA, attr):
         key = K.parse_key(kb)
-        for v in store.lists[kb].all_values(read_ts):
-            _index_edit(store, entry, v, key.uid, sts, Op.SET, [])
+        for p in store.lists[kb].postings(read_ts):
+            if p.value is not None:
+                _index_edit(store, entry, p.value, key.uid, sts, Op.SET, [],
+                            lang=p.lang)
     _commit_synthetic(store, attr, K.KeyKind.INDEX, sts, commit_ts)
 
 

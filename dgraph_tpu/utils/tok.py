@@ -221,9 +221,94 @@ def porter_stem(w: str) -> str:
     return w
 
 
+# per-language full-text analysis (reference tok/fts.go: Bleve analyzers
+# selected by the value's lang tag). English keeps the Porter stemmer;
+# other supported languages use light suffix-stripping stemmers — the
+# contract is CONSISTENCY (index and query tokenize identically under the
+# same lang), which is what makes alloftext(pred@ru, ...) match inflected
+# forms. Unknown languages analyze without stemming or stopwords.
+
+_LANG_STOPWORDS: dict[str, frozenset] = {
+    "ru": frozenset("и в во не что он на я с со как а то все она так его но да"
+                    " ты к у же вы за бы по ее мне было вот от меня еще нет о"
+                    " из ему был него до вас они ни мы этот того потому этого"
+                    " какой ей этом мой тем чтобы есть надо ней для их нее уже"
+                    " или вам сказал себя под будет при об это кто".split()),
+    "de": frozenset("der die das und oder aber ein eine einen einem einer in"
+                    " im an am auf aus bei mit nach seit von zu zum zur ist"
+                    " sind war waren wird werden nicht auch als wie für den"
+                    " des dem es ich du er sie wir ihr man sich".split()),
+    "fr": frozenset("le la les un une des du de au aux et ou mais dans par"
+                    " pour sur avec sans sous est sont était ce cette ces il"
+                    " elle ils elles je tu nous vous se ne pas plus que qui"
+                    " quoi dont où".split()),
+    "es": frozenset("el la los las un una unos unas y o pero en de del al con"
+                    " por para sin sobre es son era eran este esta estos estas"
+                    " yo tú él ella nosotros ellos se no sí que quien como".split()),
+    "it": frozenset("il lo la i gli le un uno una e o ma in di del della al"
+                    " alla con per su da è sono era erano questo questa io tu"
+                    " lui lei noi voi loro si non che chi come".split()),
+}
+# tokens are compared AFTER _normalize (NFKD + strip combining marks +
+# lower), so the tables must hold normalized forms — 'était' arrives as
+# 'etait', 'für' as 'fur'
+_LANG_STOPWORDS = {k: frozenset(_normalize(w) for w in v)
+                   for k, v in _LANG_STOPWORDS.items()}
+
+_LANG_SUFFIXES: dict[str, list[str]] = {
+    # longest-first light stemmers; endings chosen to fold the common
+    # number/case/verb inflections onto one token
+    "ru": ["иями", "ями", "ами", "ием", "иях", "иям", "ется",
+           "ого", "его", "ому", "ему", "ыми", "ими",
+           "ают", "яют", "уют", "юют", "ает", "яет", "ует",
+           "ют", "ешь", "ишь", "ить", "ать", "ять", "еть", "ов", "ев",
+           "ий", "ый", "ой", "ей", "ом", "ем", "ам", "ям", "ах", "ях",
+           "ла", "ло", "ли", "ть", "ы", "и", "а", "я", "о", "е", "у",
+           "ю", "ь"],
+    "de": ["ungen", "ung", "heit", "keit", "lich", "isch", "ern", "en",
+           "er", "es", "em", "e", "n", "s"],
+    "fr": ["issements", "issement", "issantes", "issant", "emment",
+           "ement", "ments", "ment", "euses", "euse", "eaux", "eux",
+           "ives", "ive", "ées", "ée", "és", "é", "er", "es", "e", "s"],
+    "es": ["amientos", "amiento", "aciones", "ación", "adores", "ador",
+           "ancias", "ancia", "mente", "idades", "idad", "ando", "iendo",
+           "arse", "ar", "er", "ir", "as", "os", "es", "a", "o", "e", "s"],
+    "it": ["azioni", "azione", "amenti", "amento", "mente", "ando",
+           "endo", "are", "ere", "ire", "i", "e", "a", "o"],
+}
+_LANG_SUFFIXES = {k: [_normalize(s) for s in v]
+                  for k, v in _LANG_SUFFIXES.items()}
+
+
+def lang_stem(w: str, code: str) -> str:
+    """Stemmer for a 2-letter language code: Porter for English, light
+    suffix stripping for the other supported languages, identity else."""
+    if code == "en":
+        return porter_stem(w)
+    rules = _LANG_SUFFIXES.get(code)
+    if rules is None:
+        return w
+    for suf in rules:
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: len(w) - len(suf)]
+    return w
+
+
+def fulltext_tokens(text: str, lang: str = "") -> list[bytes]:
+    """Language-aware full-text terms (unprefixed). The lang tag's primary
+    subtag picks the analyzer; untagged text analyzes as English (the
+    reference's default analyzer)."""
+    code = (lang or "en").split("-")[0].lower()
+    stop = _STOPWORDS if code == "en" else _LANG_STOPWORDS.get(
+        code, frozenset())
+    words = "".join(c if c.isalnum() else " "
+                    for c in _normalize(text)).split()
+    return sorted({lang_stem(w, code).encode("utf-8")
+                   for w in words if w not in stop})
+
+
 def _fulltext_tokens(v: Val) -> list[bytes]:
-    words = "".join(c if c.isalnum() else " " for c in _normalize(str(v.value))).split()
-    return sorted({porter_stem(w).encode("utf-8") for w in words if w not in _STOPWORDS})
+    return fulltext_tokens(str(v.value))
 
 
 def _geo_tokens(v: Val) -> list[bytes]:

@@ -180,3 +180,31 @@ def test_follower_snapshot_rebuilds_one_predicate(tmp_path):
         assert snap2.preds["b"] is not pd_b1
     finally:
         g.close()
+
+
+def test_old_ts_snapshot_stays_cached_after_newer_commit():
+    """A newer commit must NOT invalidate cached snapshots at older read
+    timestamps — they are immutable views (review r4 on _stale)."""
+    from dgraph_tpu.parallel.remote import WorkerService
+    from dgraph_tpu.query import mutation as mut
+    from dgraph_tpu.storage.postings import DirectedEdge
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    s = Store()
+    for e in parse_schema("a: int ."):
+        s.set_schema(e)
+    touched, _, _ = mut.apply_mutations(
+        s, [DirectedEdge(1, "a", value=Val(TypeID.INT, 1))], 1)
+    s.commit(1, 2, touched)
+    svc = WorkerService(s)
+    old = svc._snapshot(2)
+    touched, _, _ = mut.apply_mutations(
+        s, [DirectedEdge(2, "a", value=Val(TypeID.INT, 5))], 10)
+    s.commit(10, 11, touched)
+    assert svc._snapshot(2) is old          # immutable old view: cache hit
+    new = svc._snapshot(11)
+    assert new is not old
+    assert 2 in new.preds["a"].host_values
+    assert 2 not in old.preds["a"].host_values

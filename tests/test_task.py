@@ -173,20 +173,27 @@ def test_missing_index_errors(snap_env):
 def test_case_insensitive_regexp_uses_trigram_pruning():
     """/pat/i prunes candidates via case-variant trigram probes instead of a
     full index scan (codesearch case-folded query expansion)."""
-    from dgraph_tpu.query.task import _case_variants, _required_trigrams
+    from dgraph_tpu.query.task import _case_variants, _trigram_plan
     assert set(_case_variants("ab1")) == {"ab1", "Ab1", "aB1", "AB1"}
-    assert _required_trigrams("RiCk") == ["RiC", "iCk"]
+    assert _trigram_plan("RiCk") == [["RiC", "iCk"]]
 
 
-def test_required_trigrams_alternation_groups_unsafe():
-    """Patterns where no literal is required must return [] (full scan),
-    never a branch literal that would drop other branches' matches."""
-    from dgraph_tpu.query.task import _required_trigrams
-    assert _required_trigrams("GRIMES|rhee") == []
-    assert _required_trigrams("(abc)?def") == []
-    assert _required_trigrams("ab{0,3}cde") == []
-    assert _required_trigrams("film 1. of") == ["fil", "ilm", "lm ", "m 1"]
-    assert _required_trigrams("rick") == ["ric", "ick"]
+def test_trigram_plan_per_branch_or_of_and():
+    """Alternations plan one AND-list per branch (worker/trigram.go:36 +
+    codesearch index/regexp), ORed at probe time; branches with no literal
+    >= 3 chars poison the whole plan (full scan, never dropped matches)."""
+    from dgraph_tpu.query.task import _trigram_plan
+    assert _trigram_plan("GRIMES|rhee") == [
+        ["GRI", "IME", "MES", "RIM"], ["hee", "rhe"]]
+    assert _trigram_plan("(abc)?def") == [["def"]]     # optional group
+    assert _trigram_plan("ab{0,3}cde") == [["cde"]]    # counted repeat
+    assert _trigram_plan("film 1. of") == [[" of", "fil", "ilm", "lm ", "m 1"]]
+    assert _trigram_plan("rick") == [["ick", "ric"]]
+    assert _trigram_plan("a|b") is None                # short branch
+    assert _trigram_plan("x[0-9]+y") is None           # class-only
+    assert _trigram_plan("(abc)+") == [["abc"]]        # min>=1 repeat
+    # group/repeat boundaries never concatenate: "ab+c" must not claim "abc"
+    assert _trigram_plan("ab+c") is None
 
 
 def test_expand_allocation_is_frontier_proportional(monkeypatch):
@@ -223,3 +230,33 @@ def test_expand_allocation_is_frontier_proportional(monkeypatch):
     assert total == 3 * deg
     assert caps == [256]                    # 3 live rows * 64 → pow2 256
     assert len(matrix[3]) == 0              # missing subject stays empty
+
+
+def test_regexp_alternation_end_to_end():
+    """regexp(name, /^(GRIMES|rhee)/) prunes via per-branch trigrams AND
+    returns both branches' matches (VERDICT r3 weak#8)."""
+    from dgraph_tpu.api.server import Node
+
+    n = Node()
+    n.alter(schema_text="name: string @index(trigram) .")
+    n.mutate(set_nquads='_:a <name> "GRIMES the artist" .\n'
+                        '_:b <name> "rhee of dgraph" .\n'
+                        '_:c <name> "unrelated" .', commit_now=True)
+    out, _ = n.query('{ q(func: regexp(name, /^(GRIMES|rhee)/)) { name } }')
+    assert sorted(x["name"] for x in out["q"]) == [
+        "GRIMES the artist", "rhee of dgraph"]
+    out, _ = n.query('{ q(func: regexp(name, /(grimes|RHEE)/i)) { name } }')
+    assert sorted(x["name"] for x in out["q"]) == [
+        "GRIMES the artist", "rhee of dgraph"]
+
+
+def test_regexp_inline_ignorecase_flag():
+    """(?i) inside the pattern must case-expand the trigram probe exactly
+    like /re/i (review r4: the planner sees exact-case literals)."""
+    from dgraph_tpu.api.server import Node
+
+    n = Node()
+    n.alter(schema_text="name: string @index(trigram) .")
+    n.mutate(set_nquads='_:a <name> "RICK GRIMES" .', commit_now=True)
+    out, _ = n.query('{ q(func: regexp(name, /(?i)rick/)) { name } }')
+    assert [x["name"] for x in out["q"]] == ["RICK GRIMES"]

@@ -116,3 +116,58 @@ def test_geohash_and_predicates():
     assert not geo.near(geo.Geom("Point", sf), (-74.0, 40.7), 5000)
     roundtrip = geo.parse_geojson(geo.to_geojson(square))
     assert roundtrip == square
+
+
+# -- per-language full-text (reference tok/fts.go Bleve analyzers) -----------
+
+def test_fulltext_lang_stemming_roundtrip():
+    """Index-side and query-side tokens agree per language, folding common
+    inflections onto one token."""
+    from dgraph_tpu.utils.tok import fulltext_tokens
+
+    # Russian plural/case forms meet at one stem
+    assert fulltext_tokens("собаки", "ru") == fulltext_tokens("собака", "ru")
+    # German plural
+    assert fulltext_tokens("Hunden", "de") == fulltext_tokens("Hunde", "de")
+    # Spanish verb forms
+    assert set(fulltext_tokens("corriendo", "es")) & \
+        set(fulltext_tokens("correr", "es"))
+    # stopwords per language
+    assert fulltext_tokens("и в не", "ru") == []
+    assert fulltext_tokens("der die das", "de") == []
+    # unknown language: no stemming, no stopwords (consistent both sides)
+    assert fulltext_tokens("running the dogs", "xx") == sorted(
+        {b"running", b"the", b"dogs"})
+    # English keeps Porter
+    assert fulltext_tokens("running dogs", "en") == fulltext_tokens(
+        "run dog", "en")
+
+
+def test_alloftext_lang_end_to_end():
+    """alloftext on @ru values matches inflected forms because index and
+    query use the same Russian analyzer."""
+    from dgraph_tpu.api.server import Node
+
+    n = Node()
+    n.alter(schema_text="bio: string @index(fulltext) @lang .")
+    n.mutate(set_nquads='_:a <bio> "большие собаки"@ru .\n'
+                        '_:a <bio> "big dogs"@en .\n'
+                        '_:b <bio> "кошка спит"@ru .', commit_now=True)
+    out, _ = n.query('{ q(func: alloftext(bio@ru, "собака")) { uid } }')
+    assert len(out["q"]) == 1
+    out, _ = n.query('{ q(func: alloftext(bio@en, "dog")) { uid } }')
+    assert len(out["q"]) == 1
+    out, _ = n.query('{ q(func: alloftext(bio@ru, "собака кошка")) { uid } }')
+    assert out.get("q", []) == []
+
+
+def test_fulltext_accented_stopwords_and_suffixes():
+    """Tables are stored in normalized form: accented stopwords are
+    dropped and accented suffixes stem (review r4: _normalize strips
+    combining marks before the checks)."""
+    from dgraph_tpu.utils.tok import fulltext_tokens
+
+    assert fulltext_tokens("était le chien", "fr") == [b"chien"]
+    assert fulltext_tokens("für den Hund", "de") == [b"hund"]
+    # French past participle singular/plural meet at one token
+    assert fulltext_tokens("donné", "fr") == fulltext_tokens("données", "fr")
