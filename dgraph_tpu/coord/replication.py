@@ -33,7 +33,7 @@ from dgraph_tpu.api.server import Node
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage.csr_build import build_snapshot
-from dgraph_tpu.storage.store import Store
+from dgraph_tpu.storage.store import Store, decode_record
 from dgraph_tpu.utils.watermark import WaterMark
 
 _U32 = struct.Struct("<I")
@@ -136,7 +136,7 @@ class FollowerReader:
             idx = self._version + 1
             self.applied.begin(idx)
             try:
-                rec = json.loads(data)
+                rec = decode_record(data)
                 self.store.apply_record(rec)
                 if rec.get("t") in self._structural:
                     # schema/drop records change structure beyond the

@@ -25,6 +25,7 @@ except ImportError:              # pragma: no cover
 from ..protos import internal_pb2 as ipb
 from ..query.task import TaskQuery, TaskResult, process_task
 from ..storage.csr_build import STRUCTURAL_RECORDS
+from ..storage.store import decode_record
 from ..storage.postings import DirectedEdge, Op
 from ..storage.store import _val_from_json, _val_to_json
 
@@ -364,7 +365,7 @@ class WorkerService:
                 return ipb.AppendResponse(ok=False, term=self.term,
                                           log_len=self._last_seq)
             data = bytes(msg.data)
-            rec = json.loads(data)       # parsed once, applied below as-is
+            rec = decode_record(data)    # parsed once, applied below as-is
             self.store.append_replica_record(data, rec=rec)
             self._last_seq = int(msg.index)
             if rec.get("t") in STRUCTURAL_RECORDS:
@@ -437,10 +438,8 @@ class WorkerService:
                        context) -> ipb.PredicateDataResponse:
         """Source side: stream every key of the predicate at read_ts as WAL
         'm' records under the move txn (movePredicateHelper :86-177)."""
-        import base64
-
         from ..storage import keys as K
-        from ..storage.store import posting_to_json
+        from ..storage.store import encode_record
 
         records, keys = [], []
         for kind in (K.KeyKind.DATA, K.KeyKind.REVERSE,
@@ -450,11 +449,8 @@ class WorkerService:
                 if pl is None:
                     continue
                 for p in pl.postings(msg.read_ts):
-                    records.append(json.dumps(
-                        {"t": "m", "s": int(msg.start_ts),
-                         "k": base64.b64encode(kb).decode(),
-                         "p": posting_to_json(p)},
-                        separators=(",", ":")).encode())
+                    records.append(encode_record(
+                        {"t": "m", "s": int(msg.start_ts), "k": kb, "p": p}))
                 keys.append(kb)
         entry = self.store.schema.get(msg.attr)
         if entry is not None:
@@ -471,7 +467,7 @@ class WorkerService:
                           f"not leader (term {self.term})")
         structural = False
         for data in msg.records:
-            rec = json.loads(bytes(data))
+            rec = decode_record(bytes(data))
             structural |= rec.get("t") in STRUCTURAL_RECORDS
             self.store.ingest_record(rec)
         if structural:

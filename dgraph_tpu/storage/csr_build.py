@@ -368,17 +368,25 @@ class SnapshotAssembler:
         # "watermark > read_ts" check would mark every old-ts snapshot
         # permanently stale the moment any newer commit lands.
         stamped = getattr(snap, "pred_watermarks", None)
+        replays = getattr(snap, "pred_replays", None)
         if stamped is None:
             return True                   # built before stamping existed
         for attr in self.store.predicates():
             pct = self.store.pred_commit_ts.get(attr, 0)
             if pct <= snap.read_ts and stamped.get(attr) != pct:
                 return True               # replayed/new commit now visible
+            if self.store.pred_replay_seq.get(attr, 0) !=                     (replays or {}).get(attr, 0):
+                # a commit landed BELOW the predicate's watermark since
+                # assembly — the max-only watermark can't place it relative
+                # to read_ts, so treat every cached view as suspect
+                return True
         return False
 
     def _stamp(self, snap: GraphSnapshot) -> None:
         snap.pred_watermarks = {
             a: self.store.pred_commit_ts.get(a, 0) for a in snap.preds}
+        snap.pred_replays = {
+            a: self.store.pred_replay_seq.get(a, 0) for a in snap.preds}
 
     def _assemble(self, eff: int) -> GraphSnapshot:
         snap = GraphSnapshot(eff)

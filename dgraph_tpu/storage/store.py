@@ -77,6 +77,129 @@ def posting_from_json(d: dict) -> Posting:
     )
 
 
+# -- binary WAL record codec -------------------------------------------------
+# The hot record types (mutation / commit / abort — ~all of a load's volume)
+# encode as packed structs; rare types (schema, drops) stay JSON. The first
+# byte discriminates: '{' (0x7b) = JSON, else the binary tag. Old JSON WALs
+# replay unchanged. Decoded records carry RAW key bytes and Posting objects
+# ("fast form"); _apply_record_locked accepts both forms. This is also the
+# replication wire format — followers decode the same bytes.
+
+_REC_M, _REC_C, _REC_A = 0x01, 0x02, 0x03
+_Q = struct.Struct("<q")
+_HDR_M = struct.Struct("<q I")        # start_ts, key len
+_HDR_C = struct.Struct("<q q I")      # start_ts, commit_ts, n keys
+_HDR_A = struct.Struct("<q I")        # start_ts, n keys
+
+
+def _key_bytes(k) -> bytes:
+    return k if isinstance(k, (bytes, bytearray)) else base64.b64decode(k)
+
+
+def _enc_val(out: list, v: Val) -> None:
+    b = marshal(v)
+    out.append(struct.pack("<B I", int(v.tid), len(b)))
+    out.append(b)
+
+
+def _dec_val(raw: bytes, off: int) -> tuple[Val, int]:
+    tid, blen = struct.unpack_from("<B I", raw, off)
+    off += 5
+    return unmarshal(TypeID(tid), raw[off: off + blen]), off + blen
+
+
+def encode_record(rec: dict) -> bytes:
+    """Record dict -> wire/WAL bytes (binary for m/c/a, JSON otherwise)."""
+    t = rec["t"]
+    if t == "m":
+        kb = _key_bytes(rec["k"])
+        p = rec["p"]
+        if not isinstance(p, Posting):
+            p = posting_from_json(p)
+        out = [bytes([_REC_M]), _HDR_M.pack(rec["s"], len(kb)), kb]
+        flags = ((1 if p.value is not None else 0)
+                 | (2 if p.lang else 0) | (4 if p.facets else 0))
+        out.append(struct.pack("<Q B B", p.uid, int(p.op), flags))
+        if p.value is not None:
+            _enc_val(out, p.value)
+        if p.lang:
+            lb = p.lang.encode()
+            out.append(bytes([len(lb)]) + lb)
+        if p.facets:
+            out.append(bytes([len(p.facets)]))
+            for name, fv in p.facets:
+                nb = name.encode()
+                out.append(bytes([len(nb)]) + nb)
+                _enc_val(out, fv)
+        return b"".join(out)
+    if t in ("c", "a"):
+        keys = [_key_bytes(k) for k in rec["k"]]
+        if t == "c":
+            out = [bytes([_REC_C]), _HDR_C.pack(rec["s"], rec["ts"], len(keys))]
+        else:
+            out = [bytes([_REC_A]), _HDR_A.pack(rec["s"], len(keys))]
+        for kb in keys:
+            out.append(struct.pack("<I", len(kb)))
+            out.append(kb)
+        return b"".join(out)
+    return json.dumps(rec, separators=(",", ":")).encode("utf-8")
+
+
+def decode_record(raw: bytes) -> dict:
+    """Wire/WAL bytes -> record dict (fast form for binary records)."""
+    tag = raw[0]
+    if tag == 0x7B:                     # '{' — JSON record
+        return json.loads(raw)
+    off = 1
+    if tag == _REC_M:
+        s, klen = _HDR_M.unpack_from(raw, off)
+        off += _HDR_M.size
+        kb = raw[off: off + klen]
+        off += klen
+        uid, op, flags = struct.unpack_from("<Q B B", raw, off)
+        off += 10
+        value = lang = None
+        facets = ()
+        if flags & 1:
+            value, off = _dec_val(raw, off)
+        if flags & 2:
+            n = raw[off]
+            lang = raw[off + 1: off + 1 + n].decode()
+            off += 1 + n
+        if flags & 4:
+            cnt = raw[off]
+            off += 1
+            fs = []
+            for _ in range(cnt):
+                n = raw[off]
+                name = raw[off + 1: off + 1 + n].decode()
+                off += 1 + n
+                fv, off = _dec_val(raw, off)
+                fs.append((name, fv))
+            facets = tuple(fs)
+        return {"t": "m", "s": s, "k": kb,
+                "p": Posting(uid, Op(op), value, lang or "", facets)}
+    if tag == _REC_C:
+        s, ts, n = _HDR_C.unpack_from(raw, off)
+        off += _HDR_C.size
+    elif tag == _REC_A:
+        s, n = _HDR_A.unpack_from(raw, off)
+        ts = None
+        off += _HDR_A.size
+    else:
+        raise ValueError(f"unknown WAL record tag {tag}")
+    keys = []
+    for _ in range(n):
+        (klen,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        keys.append(raw[off: off + klen])
+        off += klen
+    rec = {"t": "c" if tag == _REC_C else "a", "s": s, "k": keys}
+    if ts is not None:
+        rec["ts"] = ts
+    return rec
+
+
 class Store:
     """One group's posting store (the `pstore` of a server node)."""
 
@@ -94,6 +217,7 @@ class Store:
         # reference never rebuilds the world — posting/lists.go:243
         # read-through; here clean predicates reuse device arrays)
         self.pred_commit_ts: dict[str, int] = {}
+        self.pred_replay_seq: dict[str, int] = {}   # below-watermark commits
         self.snapshot_ts = 0  # commits at/below this are folded into bases
         # records currently in wal.log (an up-to-dateness signal for
         # elections; NOT the replication ship index — that is a per-term
@@ -163,14 +287,13 @@ class Store:
     # -- write path ---------------------------------------------------------
 
     def add_mutation(self, start_ts: int, key: K.Key, p: Posting) -> None:
-        self._wal_write({"t": "m", "s": start_ts, "k": base64.b64encode(key.encode()).decode(),
-                         "p": posting_to_json(p)})
+        self._wal_write({"t": "m", "s": start_ts, "k": key.encode(), "p": p})
         self.get(key).add_mutation(start_ts, p)
         self.dirty.add(key.encode())
 
     def commit(self, start_ts: int, commit_ts: int, key_bytes: list[bytes]) -> None:
         self._wal_write({"t": "c", "s": start_ts, "ts": commit_ts,
-                         "k": [base64.b64encode(k).decode() for k in key_bytes]}, sync=True)
+                         "k": list(key_bytes)}, sync=True)
         with self._lock:
             for kb in key_bytes:
                 pl = self.lists.get(kb)
@@ -181,13 +304,18 @@ class Store:
 
     def _bump_pred_ts(self, kb: bytes, commit_ts: int) -> None:
         self._lock.assert_held()   # caller owns the commit critical section
-        attr = K.parse_key(kb).attr
-        if commit_ts > self.pred_commit_ts.get(attr, 0):
+        attr = K.kind_attr_of(kb)[1]
+        cur = self.pred_commit_ts.get(attr, 0)
+        if commit_ts > cur:
             self.pred_commit_ts[attr] = commit_ts
+        elif commit_ts < cur:
+            # a commit arriving BELOW the watermark (replication replay /
+            # out-of-order apply): max-only watermarks can't see it, so
+            # cached snapshots key staleness on this counter too
+            self.pred_replay_seq[attr] = self.pred_replay_seq.get(attr, 0) + 1
 
     def abort(self, start_ts: int, key_bytes: list[bytes]) -> None:
-        self._wal_write({"t": "a", "s": start_ts,
-                         "k": [base64.b64encode(k).decode() for k in key_bytes]})
+        self._wal_write({"t": "a", "s": start_ts, "k": list(key_bytes)})
         with self._lock:
             for kb in key_bytes:
                 pl = self.lists.get(kb)
@@ -293,7 +421,7 @@ class Store:
     def _wal_write(self, rec: dict, sync: bool = False) -> None:
         if self._wal is None and self.wal_sink is None:
             return    # in-memory, unreplicated: records have nowhere to go
-        data = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        data = encode_record(rec)
         with self._lock:
             # ship under the same lock as the local append so followers see
             # records in exactly the leader's log order (replication is
@@ -314,14 +442,15 @@ class Store:
         with open(path, "rb") as f:
             raw = f.read()
         off = 0
-        while off + 4 <= len(raw):
-            (n,) = _U32.unpack_from(raw, off)
-            off += 4
-            if off + n > len(raw):
-                break  # torn tail write — ignore (crash mid-append)
-            self.apply_record(json.loads(raw[off : off + n]))
-            off += n
-            self.wal_record_count += 1
+        with self._lock:       # one lock hold for the whole replay
+            while off + 4 <= len(raw):
+                (n,) = _U32.unpack_from(raw, off)
+                off += 4
+                if off + n > len(raw):
+                    break  # torn tail write — ignore (crash mid-append)
+                self._apply_record_locked(decode_record(raw[off: off + n]))
+                off += n
+                self.wal_record_count += 1
 
     def ingest_record(self, rec: dict, sync: bool = False) -> None:
         """Write-and-apply one record through the normal WAL path — the
@@ -346,7 +475,7 @@ class Store:
                     self._wal.flush()
                     os.fsync(self._wal.fileno())
             self._apply_record_locked(rec if rec is not None
-                                      else json.loads(data))
+                                      else decode_record(data))
             self.wal_record_count += 1
 
     def apply_record(self, rec: dict) -> None:
@@ -359,12 +488,21 @@ class Store:
     def _apply_record_locked(self, rec: dict) -> None:
         t = rec["t"]
         if t == "m":
-            key = K.parse_key(base64.b64decode(rec["k"]))
-            self.get(key).add_mutation(rec["s"], posting_from_json(rec["p"]))
-            self.dirty.add(key.encode())
+            kb = _key_bytes(rec["k"])
+            pl = self.lists.get(kb)
+            if pl is None:      # full parse only on first sight of the key
+                key = K.parse_key(kb)
+                pl = PostingList()
+                self.lists[kb] = pl
+                self.by_pred.setdefault(
+                    (int(key.kind), key.attr), set()).add(kb)
+            p = rec["p"]
+            pl.add_mutation(
+                rec["s"], p if isinstance(p, Posting) else posting_from_json(p))
+            self.dirty.add(kb)
         elif t == "c":
-            for kb64 in rec["k"]:
-                kb = base64.b64decode(kb64)
+            for kraw in rec["k"]:
+                kb = _key_bytes(kraw)
                 self._bump_pred_ts(kb, rec["ts"])
                 pl = self.lists.get(kb)
                 if pl is None:
@@ -378,9 +516,8 @@ class Store:
                     pl.commit(rec["s"], rec["ts"])
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, rec["ts"])
         elif t == "a":
-            for kb64 in rec["k"]:
-                kb = base64.b64decode(kb64)
-                pl = self.lists.get(kb)
+            for kraw in rec["k"]:
+                pl = self.lists.get(_key_bytes(kraw))
                 if pl is not None:
                     pl.abort(rec["s"])
         elif t == "s":
@@ -420,24 +557,20 @@ class Store:
                 pl = self.lists[kb]
                 for sts, layer in pl.uncommitted.items():
                     if layer.del_all:
-                        self._wal_write({"t": "m", "s": sts,
-                                         "k": base64.b64encode(kb).decode(),
-                                         "p": posting_to_json(Posting(0, Op.DEL_ALL))})
+                        self._wal_write({"t": "m", "s": sts, "k": kb,
+                                         "p": Posting(0, Op.DEL_ALL)})
                     for p in layer.postings.values():
-                        self._wal_write({"t": "m", "s": sts,
-                                         "k": base64.b64encode(kb).decode(),
-                                         "p": posting_to_json(p)})
+                        self._wal_write({"t": "m", "s": sts, "k": kb, "p": p})
                 for layer in pl.layers:
                     fake_start = -layer.commit_ts  # synthetic txn id for replay
                     recs = list(layer.postings.values())
                     if layer.del_all:
                         recs = [Posting(0, Op.DEL_ALL)] + recs
                     for p in recs:
-                        self._wal_write({"t": "m", "s": fake_start,
-                                         "k": base64.b64encode(kb).decode(),
-                                         "p": posting_to_json(p)})
-                    self._wal_write({"t": "c", "s": fake_start, "ts": layer.commit_ts,
-                                     "k": [base64.b64encode(kb).decode()]})
+                        self._wal_write({"t": "m", "s": fake_start, "k": kb,
+                                         "p": p})
+                    self._wal_write({"t": "c", "s": fake_start,
+                                     "ts": layer.commit_ts, "k": [kb]})
             self._wal.flush()
             os.fsync(self._wal.fileno())
             self._wal.close()
