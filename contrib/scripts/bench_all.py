@@ -1,0 +1,130 @@
+"""The five BASELINE.md config benchmarks on one chip (or CPU).
+
+Config 1: sorted-uid intersect on packed lists  (algo/uidlist.go:278)
+Config 2: 1-hop expand + eq/has filter          (worker/task.go:605)
+Config 3: @recurse depth-3                      (query/recurse.go:31)
+Config 4: k-shortest-path p50                   (query/shortest.go:274,437)
+Config 5: @groupby + aggregation                (query/groupby.go:371)
+
+Prints one JSON line per config. bench.py stays the driver's single-line
+headline (3-hop traversed-edges/sec); this battery is the operator view.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.getcwd())
+
+import numpy as np                                       # noqa: E402
+
+
+def timeit(fn, iters=10):
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jx_sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def jx_sync(out):
+    try:
+        import jax
+
+        jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    except Exception:
+        pass
+
+
+def config1():
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops import uidset as us
+
+    rng = np.random.default_rng(1)
+    n = 1 << 20
+    a = np.unique(rng.integers(0, 1 << 24, n)).astype(np.int32)
+    b = np.unique(rng.integers(0, 1 << 24, n)).astype(np.int32)
+    sa = us.make_set(a, capacity=1 << 21)
+    sb = us.make_set(b, capacity=1 << 21)
+
+    def run():
+        return us.intersect(sa, sb)
+
+    dt = timeit(run)
+    inter = us.to_numpy(run())
+    want = np.intersect1d(a, b)
+    assert np.array_equal(inter, want)
+    rate = (len(a) + len(b)) / dt
+    print(json.dumps({"config": 1, "metric": "intersect_elems_per_sec",
+                      "value": round(rate / 1e6, 1), "unit": "M/s",
+                      "ms": round(dt * 1e3, 2)}))
+
+
+def _film_node(n_people=20000, follows=12):
+    from dgraph_tpu.api.server import Node
+
+    node = Node()
+    node.alter(schema_text="name: string @index(exact) .\n"
+                           "age: int @index(int) .\n"
+                           "genre: string @index(exact) .\n"
+                           "follows: [uid] .")
+    rng = np.random.default_rng(2)
+    quads = []
+    genres = ["drama", "comedy", "noir", "scifi"]
+    for i in range(n_people):
+        quads.append(f'<0x{i + 1:x}> <name> "p{i}" .')
+        quads.append(f'<0x{i + 1:x}> <age> "{18 + i % 60}"^^<xs:int> .')
+        quads.append(f'<0x{i + 1:x}> <genre> "{genres[i % 4]}" .')
+    src = rng.integers(1, n_people + 1, n_people * follows)
+    dst = rng.integers(1, n_people + 1, n_people * follows)
+    for s, d in zip(src.tolist(), dst.tolist()):
+        quads.append(f"<0x{s:x}> <follows> <0x{d:x}> .")
+    for lo in range(0, len(quads), 50000):
+        node.mutate(set_nquads="\n".join(quads[lo: lo + 50000]),
+                    commit_now=True)
+    return node
+
+
+def main():
+    config1()
+    node = _film_node()
+
+    def q(text):
+        out, _ = node.query(text)
+        return out
+
+    # config 2: 1-hop expand + filter
+    dt = timeit(lambda: q('{ q(func: eq(age, 30)) '
+                          '{ follows @filter(ge(age, 40)) { uid } } }'),
+                iters=5)
+    print(json.dumps({"config": 2, "metric": "one_hop_eq_ms",
+                      "value": round(dt * 1e3, 1), "unit": "ms"}))
+    # config 3: @recurse depth 3
+    dt = timeit(lambda: q('{ q(func: uid(0x1)) @recurse(depth: 3) '
+                          '{ name follows } }'), iters=5)
+    print(json.dumps({"config": 3, "metric": "recurse_d3_ms",
+                      "value": round(dt * 1e3, 1), "unit": "ms"}))
+    # config 4: k-shortest p50 (device sssp path for numpaths=1)
+    lat = []
+    for dst in range(50, 60):
+        t0 = time.perf_counter()
+        q(f'{{ p as shortest(from: 0x1, to: 0x{dst:x}) {{ follows }} '
+          f'  r(func: uid(p)) {{ uid }} }}')
+        lat.append(time.perf_counter() - t0)
+    print(json.dumps({"config": 4, "metric": "shortest_p50_ms",
+                      "value": round(sorted(lat)[len(lat) // 2] * 1e3, 1),
+                      "unit": "ms"}))
+    # config 5: @groupby + aggregation
+    dt = timeit(lambda: q('{ q(func: has(age)) @groupby(genre) '
+                          '{ count(uid) a : avg(val(ag)) } '
+                          '  var(func: has(age)) { ag as age } }'), iters=5)
+    print(json.dumps({"config": 5, "metric": "groupby_agg_ms",
+                      "value": round(dt * 1e3, 1), "unit": "ms"}))
+    node.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -130,12 +130,27 @@ def cmd_worker(args) -> int:
                 store.set_schema(e)
     server, port = serve_worker(store, f"{args.host}:{args.port}")
     if args.zero:
+        import threading
+
         from dgraph_tpu.coord.zero_service import ZeroClient
 
         zc = ZeroClient(args.zero)
         group, rid = zc.connect(f"{args.host}:{port}", args.group)
-        zc.close()
         print(f"worker joined group {group} as replica {rid}", flush=True)
+
+        def membership_loop():
+            # periodic re-registration (worker/groups.go:454
+            # periodicMembershipUpdate): survives a zero restart and keeps
+            # the registry a liveness signal, not a one-shot record
+            while True:
+                time.sleep(args.membership_interval)
+                try:
+                    zc.connect(f"{args.host}:{port}", group)
+                except Exception:
+                    pass                   # zero down: next tick retries
+
+        if args.membership_interval > 0:
+            threading.Thread(target=membership_loop, daemon=True).start()
     print(f"worker serving {len(store.predicates())} tablets on "
           f"{args.host}:{port}", flush=True)
     try:
@@ -280,6 +295,9 @@ def main(argv=None) -> int:
                     help="zero address to register with (host:port)")
     wp.add_argument("--group", type=int, default=-1,
                     help="group to join (-1 = let zero assign)")
+    wp.add_argument("--membership_interval", type=float, default=30,
+                    help="seconds between membership re-registrations with "
+                         "zero (0 = register once)")
     wp.set_defaults(fn=cmd_worker)
 
     zp = sub.add_parser("zero", help="run the cluster coordinator process")

@@ -24,7 +24,6 @@ already the wire format a gRPC/DCN transport would carry.
 
 from __future__ import annotations
 
-import json
 import os
 import struct
 import threading

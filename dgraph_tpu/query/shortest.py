@@ -83,12 +83,19 @@ def _build_adjacency(ex, sg: SubGraph, src: int, dst: int):
     return adj
 
 
+# below this edge count the host adjacency walk + Dijkstra beats the
+# device relaxation's fixed dispatch/sync cost (size-adaptive, same
+# rationale as task.HOST_EXPAND_MAX)
+DEVICE_SSSP_MIN_EDGES = 1 << 17
+
+
 def _device_csr(ex, sg: SubGraph):
     """The single predicate CSR eligible for the device sssp path, or None.
 
     Eligible: one uid child, no facet cost key, no child filter, no lang,
     numpaths <= 1, predicate CSR resident on THIS device (tablet-routed
-    DistPredCSR falls back to the per-level wire expansion)."""
+    DistPredCSR falls back to the per-level wire expansion) and large
+    enough that device relaxation amortizes its dispatch cost."""
     spec = sg.gq.shortest
     if spec.numpaths > 1 or len(sg.gq.children) != 1:
         return None
@@ -103,6 +110,8 @@ def _device_csr(ex, sg: SubGraph):
         return None
     csr = pd.rev_csr if rev else pd.csr
     if csr is None or getattr(csr, "is_dist", False):
+        return None
+    if csr.num_edges < DEVICE_SSSP_MIN_EDGES:
         return None
     return cgq.attr, csr
 

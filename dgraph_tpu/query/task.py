@@ -43,6 +43,12 @@ class TaskError(ValueError):
     pass
 
 
+# below this edge volume a host-mirror gather beats the device's fixed
+# per-dispatch + sync cost (the size-adaptive strategy switch; reference
+# algo/uidlist.go:147-155 ratio heuristic)
+HOST_EXPAND_MAX = 1 << 16
+
+
 @dataclass
 class TaskQuery:
     """One execution task (reference: intern.Query, protos/internal.proto:38)."""
@@ -96,18 +102,38 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
         rows = rows_for_uids(csr, uids)
         indptr_h = csr.host_arrays()[1]
         rc = np.clip(rows, 0, max(len(indptr_h) - 2, 0))
-        deg = np.where(rows != us.SENTINEL32, indptr_h[rc + 1] - indptr_h[rc], 0)
+        ok = rows != us.SENTINEL32
+        deg = np.where(ok, indptr_h[rc + 1] - indptr_h[rc], 0)
         need = int(deg.sum())
-        cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
-        res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=cap)
-        total = int(res.total)
-        if total > cap:  # capacity-class retry (cannot happen: cap >= degree sum)
-            res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows), out_cap=total)
-        targets = np.asarray(res.targets)[:total].astype(np.int64)
-        counts = np.asarray(res.counts)[: len(uids)]
-        offs = np.zeros(len(uids) + 1, dtype=np.int64)
-        np.cumsum(counts, out=offs[1:])
-        matrix = [targets[offs[i] : offs[i + 1]] for i in range(len(uids))]
+        if need <= HOST_EXPAND_MAX:
+            # size-adaptive strategy (the TPU-era analog of the reference's
+            # linear/gallop/binary ratio switch, algo/uidlist.go:147-155):
+            # a small gather is microseconds on the cached host mirror but
+            # pays fixed per-dispatch + sync latency on device — the device
+            # path wins only once the edge volume amortizes it
+            indices_h = csr.host_arrays()[2]
+            starts = np.where(ok, indptr_h[rc], 0).astype(np.int64)
+            offs = np.zeros(len(uids) + 1, dtype=np.int64)
+            np.cumsum(deg, out=offs[1:])
+            pos = np.repeat(starts - offs[:-1], deg) + np.arange(need)
+            targets = indices_h[pos].astype(np.int64)
+            matrix = [targets[offs[i]: offs[i + 1]]
+                      for i in range(len(uids))]
+            total = need
+        else:
+            cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
+            res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows),
+                                out_cap=cap)
+            total = int(res.total)
+            if total > cap:  # capacity retry (cannot happen: cap >= degrees)
+                res = csrops.expand(csr.indptr, csr.indices,
+                                    jnp.asarray(rows), out_cap=total)
+            targets = np.asarray(res.targets)[:total].astype(np.int64)
+            counts = np.asarray(res.counts)[: len(uids)]
+            offs = np.zeros(len(uids) + 1, dtype=np.int64)
+            np.cumsum(counts, out=offs[1:])
+            matrix = [targets[offs[i]: offs[i + 1]]
+                      for i in range(len(uids))]
     if first > 0:
         matrix = [m[:first] for m in matrix]
     elif first < 0:
@@ -126,11 +152,18 @@ def _merge_matrix(matrix: list[np.ndarray]) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
-    """Union of uid lists of the chosen token rows (device merge)."""
+    """Union of uid lists of the chosen token rows (size-adaptive: host
+    merge below the dispatch-amortization point, device merge above)."""
     if not rows:
         return np.zeros(0, np.int64)
+    indptr_h, uids_h = ti.host_arrays()
+    total = int(sum(indptr_h[r + 1] - indptr_h[r] for r in rows))
+    if total <= HOST_EXPAND_MAX:
+        parts = [uids_h[indptr_h[r]: indptr_h[r + 1]] for r in rows]
+        return np.unique(np.concatenate(parts)) if parts \
+            else np.zeros(0, np.int64)
     rows_arr = us.make_set(np.asarray(rows, dtype=np.int32), capacity=len(rows))
-    cap = int(np.asarray(ti.indptr)[-1]) or 1
+    cap = int(indptr_h[-1]) or 1
     dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr, out_cap=cap)
     return us.to_numpy(dest).astype(np.int64)
 

@@ -112,12 +112,17 @@ class PredData:
     indexes: dict[str, TokenIndex] = field(default_factory=dict)
 
     def has_subjects(self) -> np.ndarray:
-        """uids for has(attr): subjects with any edge or value."""
+        """uids for has(attr): subjects with any edge or value (host
+        mirrors — a device fetch per query would pay transfer latency for
+        an array the host already holds)."""
         outs = []
         if self.csr is not None:
-            outs.append(np.asarray(self.csr.subjects))
-        if self.value_subjects is not None:
-            outs.append(np.asarray(self.value_subjects))
+            if hasattr(self.csr, "host_arrays"):
+                outs.append(self.csr.host_arrays()[0])
+            else:   # mesh-sharded tablet (DistPredCSR): device fetch
+                outs.append(np.asarray(self.csr.subjects))
+        if self.value_subjects_host is not None:
+            outs.append(self.value_subjects_host)
         if not outs:
             return np.zeros(0, dtype=np.int32)
         return np.unique(np.concatenate(outs))
@@ -375,7 +380,8 @@ class SnapshotAssembler:
             pct = self.store.pred_commit_ts.get(attr, 0)
             if pct <= snap.read_ts and stamped.get(attr) != pct:
                 return True               # replayed/new commit now visible
-            if self.store.pred_replay_seq.get(attr, 0) !=                     (replays or {}).get(attr, 0):
+            if self.store.pred_replay_seq.get(attr, 0) != \
+                    (replays or {}).get(attr, 0):
                 # a commit landed BELOW the predicate's watermark since
                 # assembly — the max-only watermark can't place it relative
                 # to read_ts, so treat every cached view as suspect

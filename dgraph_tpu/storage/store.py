@@ -124,12 +124,12 @@ def encode_record(rec: dict) -> bytes:
             _enc_val(out, p.value)
         if p.lang:
             lb = p.lang.encode()
-            out.append(bytes([len(lb)]) + lb)
+            out.append(struct.pack("<H", len(lb)) + lb)
         if p.facets:
-            out.append(bytes([len(p.facets)]))
+            out.append(struct.pack("<H", len(p.facets)))
             for name, fv in p.facets:
                 nb = name.encode()
-                out.append(bytes([len(nb)]) + nb)
+                out.append(struct.pack("<H", len(nb)) + nb)
                 _enc_val(out, fv)
         return b"".join(out)
     if t in ("c", "a"):
@@ -163,17 +163,17 @@ def decode_record(raw: bytes) -> dict:
         if flags & 1:
             value, off = _dec_val(raw, off)
         if flags & 2:
-            n = raw[off]
-            lang = raw[off + 1: off + 1 + n].decode()
-            off += 1 + n
+            (n,) = struct.unpack_from("<H", raw, off)
+            lang = raw[off + 2: off + 2 + n].decode()
+            off += 2 + n
         if flags & 4:
-            cnt = raw[off]
-            off += 1
+            (cnt,) = struct.unpack_from("<H", raw, off)
+            off += 2
             fs = []
             for _ in range(cnt):
-                n = raw[off]
-                name = raw[off + 1: off + 1 + n].decode()
-                off += 1 + n
+                (n,) = struct.unpack_from("<H", raw, off)
+                name = raw[off + 2: off + 2 + n].decode()
+                off += 2 + n
                 fv, off = _dec_val(raw, off)
                 fs.append((name, fv))
             facets = tuple(fs)

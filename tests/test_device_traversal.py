@@ -34,6 +34,8 @@ def chain_node():
 def test_shortest_uses_device_sssp(chain_node, monkeypatch):
     calls = []
     from dgraph_tpu.ops import traversal
+    from dgraph_tpu.query import shortest as sh
+    monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 0)  # tiny test graph
     real = traversal.sssp
 
     def spy(*a, **kw):
@@ -51,7 +53,9 @@ def test_shortest_uses_device_sssp(chain_node, monkeypatch):
     assert path["uid"] == "0x1"
 
 
-def test_shortest_device_matches_host(chain_node):
+def test_shortest_device_matches_host(chain_node, monkeypatch):
+    from dgraph_tpu.query import shortest as sh
+    monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 0)
     sgq = "{ p as shortest(from: 0x1, to: 0x4) { next } q(func: uid(p)) { name } }"
     dev_out, _ = chain_node.query(sgq)
 
@@ -65,7 +69,9 @@ def test_shortest_device_matches_host(chain_node):
     assert dev_out == host_out
 
 
-def test_shortest_unreachable_device(chain_node):
+def test_shortest_unreachable_device(chain_node, monkeypatch):
+    from dgraph_tpu.query import shortest as sh
+    monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 0)
     out, _ = chain_node.query(
         "{ p as shortest(from: 0x4, to: 0x1) { next } q(func: uid(p)) { name } }")
     assert out.get("q", []) == [] and "_path_" not in out

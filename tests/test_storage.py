@@ -414,3 +414,21 @@ def test_abort_record_applies(tmp_path):
     # unknown key must be a no-op, not a crash
     s.apply_record(decode_record(encode_record(
         {"t": "a", "s": 9, "k": [K.data_key("v", 99).encode()]})))
+
+
+def test_wal_codec_wide_fields():
+    """Lang tags / facet names / facet counts beyond 255 must round-trip
+    (review r4: the first binary cut used 1-byte length fields)."""
+    from dgraph_tpu.storage import keys as K
+    from dgraph_tpu.storage.postings import Op, Posting
+    from dgraph_tpu.storage.store import decode_record, encode_record
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    kb = K.data_key("p", 1).encode()
+    facets = tuple((f"key{i:04d}" + "x" * 300, Val(TypeID.INT, i))
+                   for i in range(300))
+    p = Posting(0, Op.SET, Val(TypeID.STRING, "v"), "x-" + "l" * 300, facets)
+    rec = decode_record(encode_record({"t": "m", "s": 1, "k": kb, "p": p}))
+    assert rec["p"].lang == p.lang
+    assert len(rec["p"].facets) == 300
+    assert rec["p"].facets[299][0] == p.facets[299][0]

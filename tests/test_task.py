@@ -218,6 +218,8 @@ def test_expand_allocation_is_frontier_proportional(monkeypatch):
         return real_expand(indptr_, indices_, rows_, out_cap)
 
     monkeypatch.setattr(taskmod.csrops, "expand", spy)
+    # force the device path (small expands normally take the host mirror)
+    monkeypatch.setattr(taskmod, "HOST_EXPAND_MAX", 0)
     matrix, total = taskmod._expand_csr(csr, np.asarray([7], dtype=np.int64))
     assert total == deg and len(matrix[0]) == deg
     # 1-uid frontier: capacity is the pow2 class of its degree (64), nowhere
