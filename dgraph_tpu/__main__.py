@@ -128,7 +128,8 @@ def cmd_worker(args) -> int:
         with open(args.schema) as f:
             for e in parse_schema(f.read()):
                 store.set_schema(e)
-    server, port = serve_worker(store, f"{args.host}:{args.port}")
+    server, port = serve_worker(store, f"{args.host}:{args.port}",
+                                advertise_host=args.advertise_host)
     if args.zero:
         import threading
 
@@ -295,6 +296,9 @@ def main(argv=None) -> int:
                     help="zero address to register with (host:port)")
     wp.add_argument("--group", type=int, default=-1,
                     help="group to join (-1 = let zero assign)")
+    wp.add_argument("--advertise_host", default=None,
+                    help="host peers should dial back (needed when binding "
+                         "0.0.0.0, e.g. in containers)")
     wp.add_argument("--membership_interval", type=float, default=30,
                     help="seconds between membership re-registrations with "
                          "zero (0 = register once)")

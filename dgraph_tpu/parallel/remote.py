@@ -190,6 +190,7 @@ class WorkerService:
         self._buffer = collections.deque(maxlen=self.SHIP_BUFFER)
         self._pool = None                        # ship executor
         self._ship_lock = threading.Lock()       # _ship <-> promote only
+        self._syncing = False                    # FetchState catch-up active
         self._term_path = (os.path.join(store.dir, "term")
                            if store.dir else None)
         self.term = 0
@@ -290,28 +291,32 @@ class WorkerService:
                 self.store.wal_sink = self._ship
                 return ipb.PromoteResponse(ok=True, term=self.term)
 
+    advertise_addr = ""     # set by serve_worker; followers call back here
+
     def _ship_to_peer(self, i: int, p: "RemoteWorker",
                       records: list[tuple[int, bytes]]) -> bool:
         """Bring one peer up to the latest seq: re-feed anything it is
         missing from the buffer, then the new record. Returns True when the
         peer acked through the final seq; StaleLeader propagates."""
-        want = self._peer_seq.get(i, 0) + 1
         for seq, data in records:
-            if seq < want:
+            if seq <= self._peer_seq.get(i, 0):
                 continue
             try:
-                r = p.append(self.term, seq, data)
+                r = p.append(self.term, seq, data, self.advertise_addr)
             except Exception:
                 return False            # dead peer
             if not r.ok:
                 if r.term > self.term:
                     raise StaleLeader(
                         f"peer at term {r.term} > {self.term}")
-                # genuine gap beyond the buffer window: stays lagging
-                # until the control plane rejoins it with a snapshot
+                # genuine gap beyond the buffer window: the peer kicks off
+                # its own FetchState catch-up (it got our callback addr);
+                # after it syncs, its appends ack as duplicates and the
+                # fast-forward below adopts its position
                 return False
-            self._peer_seq[i] = seq
-        return self._peer_seq.get(i, 0) == records[-1][0]
+            # duplicate acks (peer already held seq) fast-forward too
+            self._peer_seq[i] = max(seq, int(r.log_len))
+        return self._peer_seq.get(i, 0) >= records[-1][0]
 
     def _ship(self, data: bytes, sync: bool) -> None:
         """Deliver one WAL record to all peers concurrently; quorum counts
@@ -362,6 +367,16 @@ class WorkerService:
                     # duplicate re-feed (leader catch-up overlap): ack it
                     return ipb.AppendResponse(ok=True, term=self.term,
                                               log_len=self._last_seq)
+                # fell beyond the leader's buffer window: pull the leader's
+                # full durable state in the background (retrieveSnapshot,
+                # worker/draft.go:452) and resume appends from its seq
+                if msg.leader_addr and not self._syncing:
+                    self._syncing = True
+                    import threading as _t
+
+                    _t.Thread(target=self._state_sync,
+                              args=(msg.leader_addr, int(msg.term)),
+                              daemon=True).start()
                 return ipb.AppendResponse(ok=False, term=self.term,
                                           log_len=self._last_seq)
             data = bytes(msg.data)
@@ -376,6 +391,107 @@ class WorkerService:
 
     _SIZES_TTL = 5.0   # Status doubles as the hot leader-discovery probe;
                        # the O(all keys) size walk refreshes on this cadence
+
+    def fetch_state(self, _msg: ipb.FetchStateRequest,
+                    context) -> ipb.FetchStateResponse:
+        """Serve this store's durable files for a follower's catch-up
+        (retrieveSnapshot / populateShard). Snapshot+WAL are copied under
+        the store lock, so no half-shipped commit can tear the image."""
+        import os
+        import shutil
+        import tempfile
+
+        if self.store.dir is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "in-memory store has no durable state to serve "
+                          "(in-memory leaders keep an unbounded ship buffer "
+                          "instead)")
+        tmp = tempfile.mkdtemp(prefix="dgt-fetch-")
+        try:
+            # seq <-> file consistency WITHOUT _ship_lock (taking it here
+            # would invert _wal_write's store-lock -> ship-lock order and
+            # deadlock the leader): ship + local append happen under one
+            # store-lock critical section, so if the session seq is equal
+            # before and after the clone, the cloned files correspond to
+            # exactly that seq. Retry on movement.
+            for _ in range(8):
+                seq = self._session_seq
+                self.store.clone_to(tmp)
+                if self._session_seq == seq:
+                    break
+            else:
+                context.abort(grpc.StatusCode.ABORTED,
+                              "state kept moving during clone; retry")
+            snap_p = os.path.join(tmp, "snapshot.bin")
+            wal_p = os.path.join(tmp, "wal.log")
+            snap = open(snap_p, "rb").read() if os.path.exists(snap_p) else b""
+            wal = open(wal_p, "rb").read() if os.path.exists(wal_p) else b""
+            return ipb.FetchStateResponse(snapshot=snap, wal=wal,
+                                          session_seq=seq, term=self.term)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _state_sync(self, leader_addr: str, term: int) -> None:
+        """Background full-state catch-up from the leader; on success this
+        replica's store is rebuilt from the fetched files and appends
+        resume at the leader's session seq."""
+        import os
+
+        try:
+            rw = RemoteWorker(leader_addr)
+            try:
+                resp = rw.fetch_state()
+            finally:
+                rw.close()
+            from ..storage.csr_build import SnapshotAssembler
+            from ..storage.store import Store
+
+            with self._rlock:
+                if term < self.term:
+                    return             # a newer leader appeared meanwhile
+                d = self.store.dir
+                self.store.close()
+                detach = d is None
+                if detach:
+                    import tempfile as _tf
+
+                    d = _tf.mkdtemp(prefix="dgt-sync-")
+                # crash-consistent install order: stage both files, DELETE
+                # the old wal first (old-snapshot + no-wal and new-snapshot
+                # + no-wal are both valid states; new-snapshot + OLD-wal —
+                # replaying a different log history over an unrelated base
+                # — is not), then swap snapshot, then wal.
+                snap_p = os.path.join(d, "snapshot.bin")
+                wal_p = os.path.join(d, "wal.log")
+                with open(snap_p + ".tmp", "wb") as f:
+                    f.write(resp.snapshot)
+                with open(wal_p + ".tmp", "wb") as f:
+                    f.write(resp.wal)
+                if os.path.exists(wal_p):
+                    os.remove(wal_p)
+                if resp.snapshot:
+                    os.replace(snap_p + ".tmp", snap_p)
+                else:
+                    os.remove(snap_p + ".tmp")
+                    if os.path.exists(snap_p):
+                        os.remove(snap_p)
+                os.replace(wal_p + ".tmp", wal_p)
+                self.store = Store(d)
+                if detach:   # in-memory replica: files were only a vehicle
+                    if self.store._wal is not None:
+                        self.store._wal.close()
+                        self.store._wal = None
+                    self.store.dir = None
+                    import shutil as _sh
+
+                    _sh.rmtree(d, ignore_errors=True)
+                with self._lock:
+                    self._assembler = SnapshotAssembler(self.store)
+                self._last_seq = int(resp.session_seq)
+        except Exception:
+            pass                       # next gap retries the sync
+        finally:
+            self._syncing = False
 
     def status(self, _msg: ipb.StatusRequest, context) -> ipb.StatusResponse:
         import os
@@ -501,6 +617,8 @@ class WorkerService:
             "Decide": u(self.decide, ipb.DecisionRequest,
                         ipb.DecisionResponse),
             "Append": u(self.append, ipb.AppendRequest, ipb.AppendResponse),
+            "FetchState": u(self.fetch_state, ipb.FetchStateRequest,
+                            ipb.FetchStateResponse),
             "Promote": u(self.promote, ipb.PromoteRequest,
                          ipb.PromoteResponse),
             "Status": u(self.status, ipb.StatusRequest, ipb.StatusResponse),
@@ -517,15 +635,24 @@ class WorkerService:
 
 
 def serve_worker(store, addr: str = "localhost:0",
-                 max_workers: int = 8):
+                 max_workers: int = 8, advertise_host: str | None = None):
     """Start a Worker gRPC server for one group's store; returns
-    (server, bound_port)."""
+    (server, bound_port). advertise_host overrides the callback host
+    followers use for FetchState — required when binding a wildcard
+    (0.0.0.0), which is unroutable from a peer."""
+    svc = WorkerService(store)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
-    server.add_generic_rpc_handlers((WorkerService(store).handler(),))
+    server.add_generic_rpc_handlers((svc.handler(),))
     port = server.add_insecure_port(addr)
     if port == 0:
         raise RuntimeError(f"could not bind worker listener on {addr}")
+    host = advertise_host or addr.rsplit(":", 1)[0] or "localhost"
+    if host in ("0.0.0.0", "[::]", ""):
+        import socket
+
+        host = socket.gethostname()
+    svc.advertise_addr = f"{host}:{port}"
     server.start()
     return server, port
 
@@ -560,6 +687,10 @@ class RemoteWorker:
             f"/{SERVICE}/Promote",
             request_serializer=ipb.PromoteRequest.SerializeToString,
             response_deserializer=ipb.PromoteResponse.FromString)
+        self._fetch_state = self.channel.unary_unary(
+            f"/{SERVICE}/FetchState",
+            request_serializer=ipb.FetchStateRequest.SerializeToString,
+            response_deserializer=ipb.FetchStateResponse.FromString)
         self._status = self.channel.unary_unary(
             f"/{SERVICE}/Status",
             request_serializer=ipb.StatusRequest.SerializeToString,
@@ -586,9 +717,14 @@ class RemoteWorker:
             response_deserializer=ipb.DeletePredicateResponse.FromString)
 
     def append(self, term: int, index: int, data: bytes,
+               leader_addr: str = "",
                timeout: float = 5.0) -> ipb.AppendResponse:
-        return self._append(ipb.AppendRequest(term=term, index=index,
-                                              data=data), timeout=timeout)
+        return self._append(ipb.AppendRequest(
+            term=term, index=index, data=data, leader_addr=leader_addr),
+            timeout=timeout)
+
+    def fetch_state(self, timeout: float = 60.0) -> "ipb.FetchStateResponse":
+        return self._fetch_state(ipb.FetchStateRequest(), timeout=timeout)
 
     def promote(self, term: int, peers: list[str]) -> ipb.PromoteResponse:
         return self._promote(ipb.PromoteRequest(term=term, peers=peers))

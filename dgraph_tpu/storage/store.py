@@ -84,6 +84,11 @@ def posting_from_json(d: dict) -> Posting:
 # replay unchanged. Decoded records carry RAW key bytes and Posting objects
 # ("fast form"); _apply_record_locked accepts both forms. This is also the
 # replication wire format — followers decode the same bytes.
+#
+# VERSIONING: tags 0x01-0x03 denote EXACTLY this layout (u32 key lengths,
+# u16 lang/facet lengths). Any future layout change must claim NEW tag
+# bytes — the tag byte is the format version, like the snapshot header
+# (DGTS1/DGTS2 below).
 
 _REC_M, _REC_C, _REC_A = 0x01, 0x02, 0x03
 _Q = struct.Struct("<q")

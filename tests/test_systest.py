@@ -41,7 +41,7 @@ def _spawn(tmp_path, args, tag):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd="/root/repo")
     port = None
-    deadline = time.time() + 60
+    deadline = time.time() + 120   # jax import under load
     lines = []
     while time.time() < deadline:
         line = proc.stdout.readline()
