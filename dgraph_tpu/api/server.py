@@ -358,22 +358,9 @@ class Node:
         return out, uid_map, ctx
 
     def _schema_json(self, preds: list[str]) -> list[dict]:
-        out = []
-        for attr in (preds or self.store.schema.predicates()):
-            e = self.store.schema.get(attr)
-            if e is None:
-                continue
-            d: dict = {"predicate": e.predicate, "type": e.type_id.name.lower()}
-            if e.indexed:
-                d["index"] = True
-                d["tokenizer"] = list(e.tokenizers)
-            for flag in ("reverse", "count", "upsert", "lang"):
-                if getattr(e, flag, False):
-                    d[flag] = True
-            if e.is_list:
-                d["list"] = True
-            out.append(d)
-        return out
+        from dgraph_tpu.utils.schema import schema_json
+
+        return schema_json(self.store.schema, preds)
 
     # -- Mutate --------------------------------------------------------------
 

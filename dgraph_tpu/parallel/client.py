@@ -218,6 +218,13 @@ class ClusterClient:
     def _query_once(self, q: str, variables: dict | None) -> dict:
         read_ts = int(self.zero.state().get("maxTxnTs", 0))
         schema = self.schema()
+        parsed = dql.parse(q, variables)
+        if parsed.schema_request is not None:
+            # schema{} over the cluster: the merged GetSchemaOverNetwork
+            # view, same JSON shape as the embedded server
+            from ..utils.schema import schema_json
+
+            return {"schema": schema_json(schema, parsed.schema_request)}
         dispatcher = NetworkDispatcher(
             self.zero, local_group=-1,
             local_snap_fn=lambda ts: GraphSnapshot(ts),
@@ -226,7 +233,7 @@ class ClusterClient:
         snap = GraphSnapshot(read_ts)
         ex = Executor(snap, schema,
                       dispatch=lambda tq: dispatcher.process_task(tq, read_ts))
-        return ex.execute(dql.parse(q, variables))
+        return ex.execute(parsed)
 
     def close(self) -> None:
         for rws in self.groups.values():

@@ -282,7 +282,13 @@ class WorkerService:
                 self.peers = [RemoteWorker(a) for a in msg.peers]
                 self._peer_seq = {i: 0 for i in range(len(self.peers))}
                 self._session_seq = 0
-                self._buffer.clear()
+                # an in-memory leader has no durable files for FetchState —
+                # its ship buffer IS the full history, so it must not evict
+                import collections as _c
+
+                self._buffer = _c.deque(
+                    maxlen=None if self.store.dir is None
+                    else self.SHIP_BUFFER)
                 if self._pool is not None:
                     self._pool.shutdown(wait=False)
                 self._pool = _futures.ThreadPoolExecutor(
@@ -375,7 +381,7 @@ class WorkerService:
                     import threading as _t
 
                     _t.Thread(target=self._state_sync,
-                              args=(msg.leader_addr, int(msg.term)),
+                              args=(msg.leader_addr,),
                               daemon=True).start()
                 return ipb.AppendResponse(ok=False, term=self.term,
                                           log_len=self._last_seq)
@@ -431,7 +437,7 @@ class WorkerService:
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 
-    def _state_sync(self, leader_addr: str, term: int) -> None:
+    def _state_sync(self, leader_addr: str) -> None:
         """Background full-state catch-up from the leader; on success this
         replica's store is rebuilt from the fetched files and appends
         resume at the leader's session seq."""
@@ -447,8 +453,13 @@ class WorkerService:
             from ..storage.store import Store
 
             with self._rlock:
-                if term < self.term:
+                if resp.term < self.term:
                     return             # a newer leader appeared meanwhile
+                # adopt the SERVING leader's term with its state: seq and
+                # term pair up (append() resets _last_seq on term changes,
+                # which would re-feed records the synced store already has)
+                if resp.term > self.term:
+                    self._set_term(int(resp.term))
                 d = self.store.dir
                 self.store.close()
                 detach = d is None

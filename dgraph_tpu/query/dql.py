@@ -245,10 +245,12 @@ class _Parser:
     # -- token helpers ------------------------------------------------------
 
     def peek(self) -> Tok:
-        return self.toks[self.i]
+        # clamp to the trailing eof token: loops that consume until a
+        # closer must see eof (and error), never run off the list
+        return self.toks[min(self.i, len(self.toks) - 1)]
 
     def next(self) -> Tok:
-        t = self.toks[self.i]
+        t = self.peek()
         self.i += 1
         return t
 
@@ -309,6 +311,12 @@ class _Parser:
         if self.peek().text == "upsert":
             req.upsert = self._parse_upsert_block()
             return req
+        if self.peek().text == "schema":
+            # top-level `schema {}` / `schema(pred: [..]) {..}` — the form
+            # the reference's clients send (gql/parser.go schema handling);
+            # the braced `{ schema {} }` form is also accepted below
+            req.schema_request = self._parse_schema_block()
+            return req
         self.expect("{")
         while not self.accept("}"):
             t = self.peek()
@@ -354,7 +362,9 @@ class _Parser:
             self.expect(")")
         if self.accept("{"):
             while not self.accept("}"):
-                self.next()  # field selection is cosmetic; we return all fields
+                if self.peek().kind == "eof":
+                    raise ParseError("unterminated schema block")
+                self.next()  # field selection is cosmetic; all fields return
         return preds
 
     # -- mutations ----------------------------------------------------------

@@ -179,3 +179,25 @@ class SchemaState:
 
     def to_text(self) -> str:
         return "\n".join(str(e) for e in self.entries())
+
+
+def schema_json(state: "SchemaState", preds: list[str] | None = None) -> list[dict]:
+    """`schema {}` response entries (the reference's schema-query JSON
+    shape, edgraph/server.go schema handling). Shared by the embedded
+    server and the cluster client so the two surfaces cannot drift."""
+    out = []
+    for attr in (preds or state.predicates()):
+        e = state.get(attr)
+        if e is None:
+            continue
+        d: dict = {"predicate": e.predicate, "type": e.type_id.name.lower()}
+        if e.indexed:
+            d["index"] = True
+            d["tokenizer"] = list(e.tokenizers)
+        for flag in ("reverse", "count", "upsert", "lang"):
+            if getattr(e, flag, False):
+                d[flag] = True
+        if e.is_list:
+            d["list"] = True
+        out.append(d)
+    return out

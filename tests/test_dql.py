@@ -193,3 +193,11 @@ def test_schema_block():
     assert req.schema_request == ["name", "age"]
     req = dql.parse("{ schema { } }")  # all predicates
     assert req.schema_request == []
+
+
+def test_top_level_schema_query():
+    """dgraph clients send `schema {}` WITHOUT enclosing braces."""
+    req = dql.parse("schema {}")
+    assert req.schema_request == []
+    req = dql.parse('schema(pred: [name, age]) {}')
+    assert req.schema_request == ["name", "age"]

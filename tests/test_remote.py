@@ -459,19 +459,42 @@ def test_follower_state_sync_beyond_buffer():
     # B comes back: next ship finds the gap beyond the buffer; B pulls the
     # leader's full state and subsequent appends land normally
     pb.append = real_append
-    _write_edge(addrs[0], 99, 99, ts=200)
+    _write_edge(addrs[0], 99, 99, ts=200)   # gap detected: sync kicks off
+    # drive more writes until the post-sync resume lands on B
     deadline = _t.time() + 20
-    while _t.time() < deadline and fb.store.max_seen_commit_ts < 201:
-        _t.sleep(0.1)
-        _write_edge(addrs[0], 100, 100,
-                    ts=210 + int((_t.time() % 1) * 1000) % 50 * 2)
-        break
-    # drive a few more writes so the post-sync resume is exercised
-    _write_edge(addrs[0], 101, 101, ts=400)
-    deadline = _t.time() + 20
+    ts = 400
     while _t.time() < deadline and fb.store.max_seen_commit_ts < 401:
+        _write_edge(addrs[0], 101, 101, ts=ts)
+        ts += 2
         _t.sleep(0.2)
     assert fb.store.max_seen_commit_ts >= 401, fb.store.max_seen_commit_ts
+    assert fb._last_seq == leader._session_seq
+    rw.close()
+    for s in servers:
+        s.stop(0)
+
+
+def test_in_memory_leader_buffer_never_evicts():
+    """An in-memory leader has no files for FetchState, so its promote()
+    must install an unbounded ship buffer — the buffer IS the history a
+    lagging follower catches up from (review r4)."""
+    svcs, servers, addrs = _mk_replica_trio()   # in-memory stores
+    leader = svcs[0]
+    rw = RemoteWorker(addrs[0])
+    assert rw.promote(1, [addrs[1], addrs[2]]).ok
+    assert leader._buffer.maxlen is None
+
+    # follower B misses far more than SHIP_BUFFER would hold, then recovers
+    leader.SHIP_BUFFER = 8   # would have evicted if maxlen were set
+    pb = leader.peers[1]
+    real_append = pb.append
+    pb.append = lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("down"))
+    for i in range(30):
+        _write_edge(addrs[0], i + 1, i, ts=10 + 2 * i)
+    pb.append = real_append
+    _write_edge(addrs[0], 99, 99, ts=200)   # re-feeds ALL 60+ records
+    fb = svcs[2]
+    assert fb.store.max_seen_commit_ts == 201
     assert fb._last_seq == leader._session_seq
     rw.close()
     for s in servers:
