@@ -22,14 +22,16 @@ def cmd_serve(args) -> int:
 
     node = Node(dirpath=args.postings, trace_fraction=args.trace)
     if args.memory_mb:
-        budget = args.memory_mb * (1 << 20)
+        # the enforcer re-reads node.memory_budget each tick so
+        # POST /admin/config/memory_mb reconfigs stick (admin.go)
+        node.memory_budget = args.memory_mb * (1 << 20)
 
         def _enforce():
             import time as _t
             while True:
                 _t.sleep(10)
                 try:
-                    node.enforce_memory(budget)
+                    node.enforce_memory(node.memory_budget)
                 except Exception:
                     pass
         threading.Thread(target=_enforce, daemon=True).start()
@@ -47,7 +49,7 @@ def cmd_serve(args) -> int:
     srv = make_server(node, args.host, args.port,
                       tls_cert=args.tls_cert, tls_key=args.tls_key)
     print(f"serving HTTP{'S' if args.tls_cert else ''} on "
-          f"{args.host}:{args.port} "
+          f"{args.host}:{srv.server_address[1]} "
           f"(postings={args.postings or '<memory>'})", flush=True)
     try:
         srv.serve_forever()

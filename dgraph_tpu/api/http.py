@@ -18,6 +18,9 @@ Request formats:
   POST /abort/?startTs=N
   POST /alter    body = schema text, or {"drop_all": true} / {"drop_attr": p}
   GET  /health, GET /state
+  POST /admin/export[?dest=dir]      RDF+schema export (admin.go)
+  POST /admin/shutdown               graceful stop
+  POST /admin/config/memory_mb       body = MB; live budget reconfig
 """
 
 from __future__ import annotations
@@ -105,6 +108,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self._abort()
             elif path == "/alter":
                 self._alter()
+            elif path == "/admin/export":
+                self._admin_export()
+            elif path == "/admin/shutdown":
+                self._admin_shutdown()
+            elif path == "/admin/config/memory_mb":
+                self._admin_memory()
             else:
                 self._send(404, _envelope_err("ErrorInvalidRequest",
                                               "no such path"))
@@ -112,6 +121,52 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(409, _envelope_err("ErrorAborted", str(e)))
         except Exception as e:  # surface parse/exec errors in the envelope
             self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
+
+    # -- admin (reference dgraph/cmd/server/admin.go) -------------------------
+
+    def _admin_export(self):
+        """Export the served graph to RDF (admin.go export handler; the
+        reference writes export/dgraph.r{ts} dirs next to the postings)."""
+        import os
+        import time as _time
+
+        from dgraph_tpu.loader.export import export_rdf
+
+        qs = self._qs()
+        base = qs.get("dest") or (
+            os.path.join(self.node.store.dir, "export")
+            if self.node.store.dir else "export")
+        os.makedirs(base, exist_ok=True)
+        ts = self.node.zero.oracle.read_ts()
+        out = os.path.join(base, f"dgraph.r{ts}.rdf.gz")
+        schema_out = os.path.join(base, f"dgraph.r{ts}.schema")
+        t0 = _time.perf_counter()
+        stats = export_rdf(self.node.store, out, schema_path=schema_out)
+        self._send(200, json.dumps(
+            {"code": "Success", "message": "export completed",
+             "file": out, "schema": schema_out, "quads": stats.quads,
+             "predicates": stats.predicates,
+             "seconds": round(_time.perf_counter() - t0, 2)}).encode())
+
+    def _admin_shutdown(self):
+        """Graceful stop (admin.go shutdown handler)."""
+        import threading
+
+        self._send(200, json.dumps(
+            {"code": "Success", "message": "Server is shutting down"}).encode())
+        threading.Thread(target=self.server.shutdown, daemon=True).start()
+
+    def _admin_memory(self):
+        """Live memory budget reconfig + enforcement pass (the reference's
+        POST /admin/config/memory_mb, admin.go)."""
+        mb = int(self._read_body().strip() or 0)
+        if mb <= 0:
+            raise ValueError("body must be a positive memory_mb integer")
+        # persist for the background enforcer (it re-reads each tick), then
+        # run one pass immediately
+        self.node.memory_budget = mb * (1 << 20)
+        stats = self.node.enforce_memory(mb * (1 << 20))
+        self._send(200, json.dumps({"code": "Success", **stats}).encode())
 
     def _query(self):
         body = self._read_body()

@@ -216,15 +216,15 @@ class ClusterClient:
                 self._invalidate()
 
     def _query_once(self, q: str, variables: dict | None) -> dict:
-        read_ts = int(self.zero.state().get("maxTxnTs", 0))
-        schema = self.schema()
         parsed = dql.parse(q, variables)
+        schema = self.schema()
         if parsed.schema_request is not None:
             # schema{} over the cluster: the merged GetSchemaOverNetwork
             # view, same JSON shape as the embedded server
             from ..utils.schema import schema_json
 
             return {"schema": schema_json(schema, parsed.schema_request)}
+        read_ts = int(self.zero.state().get("maxTxnTs", 0))
         dispatcher = NetworkDispatcher(
             self.zero, local_group=-1,
             local_snap_fn=lambda ts: GraphSnapshot(ts),

@@ -337,8 +337,22 @@ class WorkerService:
             self._session_seq += 1
             seq = self._session_seq
             self._buffer.append((seq, data))
-            records = list(self._buffer)
+            # slice only the tail the slowest peer still needs: an
+            # unbounded in-memory-leader buffer must not make every write
+            # O(history) (the full copy is only taken when some peer is
+            # behind the lowest buffered seq)
             peers = list(self.peers)
+            min_acked = min((self._peer_seq.get(i, 0)
+                             for i in range(len(peers))), default=seq - 1)
+            lag = seq - min_acked
+            if lag >= len(self._buffer):
+                records = list(self._buffer)
+            else:
+                import itertools as _it
+
+                # O(lag): deque iteration from the right end
+                records = list(_it.islice(reversed(self._buffer),
+                                          lag))[::-1]
             futs = [self._pool.submit(self._ship_to_peer, i, p, records)
                     for i, p in enumerate(peers)]
             acks, stale = 1, None

@@ -123,3 +123,58 @@ def test_health_and_state(server):
 def test_error_envelope(server):
     st, out = _post(server, "/query", "{ bad query ")
     assert st == 400 and out["errors"][0]["code"] == "ErrorInvalidRequest"
+
+
+def test_admin_export_and_memory(tmp_path):
+    import urllib.request
+
+    from dgraph_tpu.api.http import make_server
+    from dgraph_tpu.api.server import Node
+
+    node = Node(str(tmp_path / "p"))
+    node.alter(schema_text="name: string @index(exact) .")
+    node.mutate(set_nquads='_:a <name> "x" .', commit_now=True)
+    srv = make_server(node, "127.0.0.1", 0)
+    import threading
+
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/export", data=b"", method="POST")
+        out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert out["code"] == "Success" and out["quads"] >= 1
+        import os
+
+        assert os.path.exists(out["file"])
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/admin/config/memory_mb", data=b"512",
+            method="POST")
+        out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert out["code"] == "Success" and "bytes" in out
+    finally:
+        srv.shutdown()
+        node.close()
+
+
+def test_admin_shutdown(tmp_path):
+    import urllib.request
+
+    from dgraph_tpu.api.http import make_server
+    from dgraph_tpu.api.server import Node
+
+    node = Node()
+    srv = make_server(node, "127.0.0.1", 0)
+    import threading
+
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    port = srv.server_address[1]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/admin/shutdown", data=b"", method="POST")
+    out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert out["code"] == "Success"
+    t.join(timeout=10)
+    assert not t.is_alive()
+    node.close()
