@@ -22,19 +22,7 @@ def cmd_serve(args) -> int:
 
     node = Node(dirpath=args.postings, trace_fraction=args.trace)
     if args.memory_mb:
-        # the enforcer re-reads node.memory_budget each tick so
-        # POST /admin/config/memory_mb reconfigs stick (admin.go)
-        node.memory_budget = args.memory_mb * (1 << 20)
-
-        def _enforce():
-            import time as _t
-            while True:
-                _t.sleep(10)
-                try:
-                    node.enforce_memory(node.memory_budget)
-                except Exception:
-                    pass
-        threading.Thread(target=_enforce, daemon=True).start()
+        node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
         with open(args.schema) as f:
             node.alter(schema_text=f.read())

@@ -137,11 +137,15 @@ class _Handler(BaseHTTPRequestHandler):
             os.path.join(self.node.store.dir, "export")
             if self.node.store.dir else "export")
         os.makedirs(base, exist_ok=True)
-        ts = self.node.zero.oracle.read_ts()
+        # name and CONTENT use the same ts (the newest applied commit);
+        # oracle.read_ts() may run ahead of it via assigned-not-committed
+        # txns and would over-claim what the file contains
+        ts = self.node.store.max_seen_commit_ts
         out = os.path.join(base, f"dgraph.r{ts}.rdf.gz")
         schema_out = os.path.join(base, f"dgraph.r{ts}.schema")
         t0 = _time.perf_counter()
-        stats = export_rdf(self.node.store, out, schema_path=schema_out)
+        stats = export_rdf(self.node.store, out, read_ts=ts,
+                           schema_path=schema_out)
         self._send(200, json.dumps(
             {"code": "Success", "message": "export completed",
              "file": out, "schema": schema_out, "quads": stats.quads,
@@ -162,9 +166,10 @@ class _Handler(BaseHTTPRequestHandler):
         mb = int(self._read_body().strip() or 0)
         if mb <= 0:
             raise ValueError("body must be a positive memory_mb integer")
-        # persist for the background enforcer (it re-reads each tick), then
+        # install budget + ensure the enforcement loop runs (it re-reads
+        # the budget each tick, even when serve started without one), then
         # run one pass immediately
-        self.node.memory_budget = mb * (1 << 20)
+        self.node.set_memory_budget(mb * (1 << 20))
         stats = self.node.enforce_memory(mb * (1 << 20))
         self._send(200, json.dumps({"code": "Success", **stats}).encode())
 

@@ -94,6 +94,27 @@ class Node:
         maxuid = self._max_uid_in_store()
         if maxuid:
             self.zero.uids.assign(maxuid)
+        self.memory_budget = 0          # 0 = unbounded
+        self._enforcer_started = False
+
+    def set_memory_budget(self, budget_bytes: int) -> None:
+        """Install/retarget the memory budget and ensure the background
+        enforcement loop is running (admin.go live memory_mb reconfig —
+        the loop re-reads the budget each tick, so later changes stick)."""
+        self.memory_budget = int(budget_bytes)
+        if self._enforcer_started or budget_bytes <= 0:
+            return
+        self._enforcer_started = True
+
+        def loop():
+            while True:
+                time.sleep(10)
+                try:
+                    if self.memory_budget > 0:
+                        self.enforce_memory(self.memory_budget)
+                except Exception:
+                    pass
+        threading.Thread(target=loop, daemon=True).start()
 
     # value-posting slots (lang/value fingerprints) carry the 1<<60 / 1<<61
     # tag bits (storage/postings.py lang_uid/value_fingerprint) and must never

@@ -185,6 +185,7 @@ class WorkerService:
         self.is_leader = False
         self.peers: list["RemoteWorker"] = []
         self._peer_seq: dict[int, int] = {}      # peer idx -> acked seq
+        self._peer_fails: dict[int, int] = {}    # consecutive ship failures
         self._session_seq = 0                    # this term's shipped count
         self._last_seq = 0                       # follower: applied seq
         self._buffer = collections.deque(maxlen=self.SHIP_BUFFER)
@@ -310,6 +311,7 @@ class WorkerService:
             try:
                 r = p.append(self.term, seq, data, self.advertise_addr)
             except Exception:
+                self._peer_fails[i] = self._peer_fails.get(i, 0) + 1
                 return False            # dead peer
             if not r.ok:
                 if r.term > self.term:
@@ -319,10 +321,13 @@ class WorkerService:
                 # its own FetchState catch-up (it got our callback addr);
                 # after it syncs, its appends ack as duplicates and the
                 # fast-forward below adopts its position
+                self._peer_fails[i] = self._peer_fails.get(i, 0) + 1
                 return False
             # duplicate acks (peer already held seq) fast-forward too
             self._peer_seq[i] = max(seq, int(r.log_len))
-        return self._peer_seq.get(i, 0) >= records[-1][0]
+        ok = self._peer_seq.get(i, 0) >= records[-1][0]
+        self._peer_fails[i] = 0 if ok else self._peer_fails.get(i, 0) + 1
+        return ok
 
     def _ship(self, data: bytes, sync: bool) -> None:
         """Deliver one WAL record to all peers concurrently; quorum counts
@@ -337,13 +342,17 @@ class WorkerService:
             self._session_seq += 1
             seq = self._session_seq
             self._buffer.append((seq, data))
-            # slice only the tail the slowest peer still needs: an
+            # slice only the tail the slowest DUE peer still needs: an
             # unbounded in-memory-leader buffer must not make every write
-            # O(history) (the full copy is only taken when some peer is
-            # behind the lowest buffered seq)
+            # O(history). A peer that keeps failing backs off to every
+            # 64th ship, so a dead replica cannot force the full-history
+            # copy per write either (it still resyncs on its due ticks,
+            # and FetchState covers disk-backed leaders).
             peers = list(self.peers)
-            min_acked = min((self._peer_seq.get(i, 0)
-                             for i in range(len(peers))), default=seq - 1)
+            due = [i for i in range(len(peers))
+                   if self._peer_fails.get(i, 0) < 3 or seq % 64 == 0]
+            min_acked = min((self._peer_seq.get(i, 0) for i in due),
+                            default=seq - 1)
             lag = seq - min_acked
             if lag >= len(self._buffer):
                 records = list(self._buffer)
@@ -353,8 +362,8 @@ class WorkerService:
                 # O(lag): deque iteration from the right end
                 records = list(_it.islice(reversed(self._buffer),
                                           lag))[::-1]
-            futs = [self._pool.submit(self._ship_to_peer, i, p, records)
-                    for i, p in enumerate(peers)]
+            futs = [self._pool.submit(self._ship_to_peer, i, peers[i],
+                                      records) for i in due]
             acks, stale = 1, None
             for f in futs:
                 try:

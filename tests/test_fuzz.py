@@ -56,3 +56,95 @@ def test_schema_parser_never_crashes():
             sch.parse_schema(s)
         except ValueError:      # schema errors are ValueError subclasses
             pass
+
+
+def test_trigram_plan_soundness_fuzz():
+    """Planner invariant: every string MATCHING the pattern must contain
+    every trigram of at least one plan alternative — otherwise the index
+    probe would drop real matches (worker/trigram.go contract)."""
+    import random
+    import re as remod
+
+    from dgraph_tpu.query.task import _trigram_plan
+
+    rng = random.Random(20260730)
+    atoms = ["abc", "defg", "hi", "xyz", "lmnop", "q", "[0-9]", ".", "w+",
+             "(abc|wxyz)", "(?:def)?", "tuv{0,2}", "st*", "\\d", "rick",
+             "(GRIMES|rhee)", "a(bc)d", "ef|gh"]
+    corpus_bits = ["abc", "defg", "hi", "xyz", "lmnop", "q", "7", "z", "ww",
+                   "def", "tu", "tuvv", "s", "sttt", "rick", "GRIMES",
+                   "rhee", "abcd", "ef", "gh", " ", "Q"]
+    checked = 0
+    for _ in range(300):
+        pat = "".join(rng.choice(atoms) for _ in range(rng.randint(1, 4)))
+        try:
+            rx = remod.compile(pat)
+        except remod.error:
+            continue
+        plan = _trigram_plan(pat)
+        if plan is None:
+            continue                      # full scan: trivially sound
+        for _ in range(40):
+            s = "".join(rng.choice(corpus_bits)
+                        for _ in range(rng.randint(1, 8)))
+            if rx.search(s) is None:
+                continue
+            ok = any(all(t in s for t in alt) for alt in plan)
+            assert ok, (pat, plan, s)
+            checked += 1
+    assert checked > 50   # the fuzz actually exercised matching cases
+
+
+def test_wal_codec_roundtrip_fuzz():
+    """Random postings/keys round-trip the binary WAL codec bit-exactly."""
+    import random
+
+    from dgraph_tpu.storage import keys as K
+    from dgraph_tpu.storage.postings import Op, Posting
+    from dgraph_tpu.storage.store import decode_record, encode_record
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    rng = random.Random(42)
+
+    def rand_val():
+        tid = rng.choice([TypeID.INT, TypeID.FLOAT, TypeID.BOOL,
+                          TypeID.STRING])
+        v = {TypeID.INT: lambda: rng.randint(-2**40, 2**40),
+             TypeID.FLOAT: lambda: rng.random() * 1e6,
+             TypeID.BOOL: lambda: rng.random() < 0.5,
+             TypeID.STRING: lambda: "".join(
+                 rng.choice("aé日🎉 b\\\"\n") for _ in range(rng.randint(0, 40)))
+             }[tid]()
+        return Val(tid, v)
+
+    for _ in range(200):
+        kind = rng.choice([lambda: K.data_key("p" * rng.randint(1, 30),
+                                              rng.randint(1, 2**40)),
+                           lambda: K.index_key("attr", bytes(
+                               rng.randrange(256) for _ in range(
+                                   rng.randint(0, 300))))])
+        kb = kind().encode()
+        p = Posting(
+            uid=rng.randint(0, 2**50), op=Op(rng.randint(0, 2)),
+            value=rand_val() if rng.random() < 0.7 else None,
+            lang=rng.choice(["", "en", "zh-Hant", "x" * 300]),
+            facets=tuple((f"k{i}", rand_val())
+                         for i in range(rng.randint(0, 5))))
+        rec = {"t": "m", "s": rng.randint(-2**40, 2**40), "k": kb, "p": p}
+        got = decode_record(encode_record(rec))
+        assert got["s"] == rec["s"] and got["k"] == kb
+        gp = got["p"]
+        assert (gp.uid, gp.op, gp.lang) == (p.uid, p.op, p.lang)
+        assert (gp.value is None) == (p.value is None)
+        if p.value is not None:
+            assert gp.value.tid == p.value.tid
+            if p.value.tid == TypeID.FLOAT:
+                assert abs(gp.value.value - p.value.value) < 1e-9
+            else:
+                assert gp.value.value == p.value.value
+        assert len(gp.facets) == len(p.facets)
+
+        keys = [kind().encode() for _ in range(rng.randint(0, 20))]
+        crec = decode_record(encode_record(
+            {"t": "c", "s": 5, "ts": rng.randint(1, 2**40), "k": keys}))
+        assert crec["k"] == keys

@@ -492,10 +492,17 @@ def test_in_memory_leader_buffer_never_evicts():
     for i in range(30):
         _write_edge(addrs[0], i + 1, i, ts=10 + 2 * i)
     pb.append = real_append
-    _write_edge(addrs[0], 99, 99, ts=200)   # re-feeds ALL 60+ records
+    # the failed peer backed off; keep writing until its due tick re-feeds
+    # the ENTIRE history from the unbounded buffer
     fb = svcs[2]
-    assert fb.store.max_seen_commit_ts == 201
+    ts = 200
+    for _ in range(80):
+        _write_edge(addrs[0], 99, 99, ts=ts)
+        ts += 2
+        if fb._last_seq == leader._session_seq:
+            break
     assert fb._last_seq == leader._session_seq
+    assert fb.store.max_seen_commit_ts == ts - 1
     rw.close()
     for s in servers:
         s.stop(0)
