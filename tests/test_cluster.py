@@ -242,3 +242,72 @@ def test_cluster_query_reuses_device_arrays():
     out = c.query('{ q(func: eq(name, "x")) { name age } }')
     assert out["q"][0]["name"] == "x"
     c.close()
+
+
+def test_choose_rebalance_move_decision_table():
+    """The shared decision function (tablet.go:60-74 + chooseTablet :156)
+    drives BOTH the in-process and zero-process rebalancers."""
+    from dgraph_tpu.coord.zero import choose_rebalance_move as pick
+
+    # balanced within the 85% ratio: no move
+    assert pick({0: {"a": 100}, 1: {"b": 90}}) is None
+    # single group: no move
+    assert pick({0: {"a": 100}}) is None
+    # imbalanced: the largest tablet fitting half the gap moves
+    got = pick({0: {"a": 60, "b": 50}, 1: {"c": 10}})
+    assert got == ("b", 0, 1, 50)     # gap=(110-10)/2=50; b fits, a doesn't
+    # nothing fits half the gap (one huge tablet): no move (anti-thrash)
+    assert pick({0: {"a": 200}, 1: {"b": 10}}) is None
+    # blocked tablets are skipped (a FITS the gap and would win on size)
+    got = pick({0: {"a": 39, "b": 38}, 1: {}}, blocked={"a"})
+    assert got[0] == "b"
+    # empty smallest group with several comparable tablets
+    got = pick({0: {"x": 30, "y": 29, "z": 28}, 1: {}})
+    assert got[0] == "x" and got[2] == 1
+
+
+def test_cluster_conflict_aborts_across_groups():
+    """SSI conflict on a cross-group txn aborts every group's slice."""
+    from dgraph_tpu.coord.cluster import Cluster
+    from dgraph_tpu.coord.zero import TxnConflict
+
+    c = Cluster(n_groups=2)
+    c.alter("name: string @index(exact) @upsert .\nage: int .")
+    c.zero.move_tablet("name", 0)
+    c.zero.move_tablet("age", 1)
+    c.mutate(set_nquads='<0x1> <name> "a" .\n<0x1> <age> "1"^^<xs:int> .')
+
+    # two txns race on the same subject+predicate
+    st1 = c.zero.oracle.new_txn()
+    st2 = c.zero.oracle.new_txn()
+    from dgraph_tpu.query import mutation as mut
+    from dgraph_tpu.query import rdf
+    from dgraph_tpu.storage.postings import Op
+
+    def buffer(st, val):
+        nq = rdf.parse(f'<0x1> <name> "{val}" .\n'
+                       f'<0x1> <age> "9"^^<xs:int> .')
+        edges = mut.to_edges(nq, {}, Op.SET)
+        by_group = mut.split_edges_by_group(edges, 2, c.group_of)
+        keys = {}
+        conflicts = []
+        for g, ge in by_group.items():
+            touched, confl, preds = mut.apply_mutations(
+                c.stores[g], ge, st.start_ts)
+            keys[g] = touched
+            conflicts += confl
+        c.zero.oracle.track(st.start_ts, conflicts)
+        return keys
+
+    k1 = buffer(st1, "x")
+    k2 = buffer(st2, "y")
+    ts1 = c.zero.oracle.commit(st1.start_ts)
+    for g, kb in k1.items():
+        c.stores[g].commit(st1.start_ts, ts1, kb)
+    with pytest.raises(TxnConflict):
+        c.zero.oracle.commit(st2.start_ts)
+    for g, kb in k2.items():
+        c.stores[g].abort(st2.start_ts, kb)
+    out = c.query('{ q(func: eq(name, "x")) { name age } }')
+    assert out["q"] == [{"name": "x", "age": 9}]
+    c.close()
