@@ -258,8 +258,10 @@ def test_choose_rebalance_move_decision_table():
     assert got == ("b", 0, 1, 50)     # gap=(110-10)/2=50; b fits, a doesn't
     # nothing fits half the gap (one huge tablet): no move (anti-thrash)
     assert pick({0: {"a": 200}, 1: {"b": 10}}) is None
-    # blocked tablets are skipped (a FITS the gap and would win on size)
-    got = pick({0: {"a": 39, "b": 38}, 1: {}}, blocked={"a"})
+    # blocked tablets are skipped (gap=38: a fits and sorts first, so only
+    # the blocked check can force b)
+    assert pick({0: {"a": 38, "b": 38}, 1: {}})[0] == "a"
+    got = pick({0: {"a": 38, "b": 38}, 1: {}}, blocked={"a"})
     assert got[0] == "b"
     # empty smallest group with several comparable tablets
     got = pick({0: {"x": 30, "y": 29, "z": 28}, 1: {}})
