@@ -148,3 +148,67 @@ def test_wal_codec_roundtrip_fuzz():
         crec = decode_record(encode_record(
             {"t": "c", "s": 5, "ts": rng.randint(1, 2**40), "k": keys}))
         assert crec["k"] == keys
+
+
+def test_engine_execution_fuzz():
+    """Random structurally-valid queries against a seeded graph: execution
+    must either answer or raise a TYPED error (ParseError/TaskError/
+    QueryError) — never crash with an internal exception. Covers engine
+    paths the goldens don't reach (odd filter/directive/pagination
+    combos)."""
+    import random
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.query.dql import ParseError
+    from dgraph_tpu.query.engine import QueryError
+    from dgraph_tpu.query.task import TaskError
+
+    n = Node()
+    n.alter(schema_text="""
+        name: string @index(exact, term, trigram) @lang .
+        age: int @index(int) .
+        score: [float] .
+        friend: [uid] @reverse @count .
+        bio: string @index(fulltext) .
+    """)
+    quads = []
+    for i in range(1, 30):
+        quads += [f'<0x{i:x}> <name> "p{i}" .',
+                  f'<0x{i:x}> <age> "{18 + i}"^^<xs:int> .',
+                  f'<0x{i:x}> <score> "{i}.5"^^<xs:float> .',
+                  f'<0x{i:x}> <bio> "likes running and dogs {i}" .',
+                  f'<0x{i:x}> <friend> <0x{(i * 3) % 29 + 1:x}> .']
+    n.mutate(set_nquads="\n".join(quads), commit_now=True)
+
+    rng = random.Random(4)
+    roots = ['has(name)', 'eq(name, "p3")', 'ge(age, 25)',
+             'anyofterms(name, "p1 p2")', 'alloftext(bio, "dog run")',
+             'regexp(name, /p[0-9]+/)', 'uid(0x1, 0x5)', 'has(friend)',
+             'eq(count(friend), 1)', 'le(age, 30)']
+    filters = ['', '@filter(ge(age, 20))', '@filter(has(friend))',
+               '@filter(NOT eq(name, "p1") AND le(age, 40))',
+               '@filter(uid_in(friend, 0x2) OR eq(name, "p9"))']
+    directives = ['', '@cascade', '@normalize',
+                  '@recurse(depth: 2)', '@groupby(age) { count(uid) }']
+    pageargs = ['', ', first: 3', ', offset: 2', ', first: -2',
+                ', first: 2, offset: 1', ', orderasc: age',
+                ', orderdesc: name, first: 4', ', after: 0x3']
+    bodies = ['{ name }', '{ name age }', '{ uid friend { name } }',
+              '{ count(uid) }', '{ name ~friend { name } }',
+              '{ friend (first: 1) { age } }', '{ expand(_all_) }',
+              '{ a : name n : count(friend) }']
+    ran = 0
+    for _ in range(250):
+        d = rng.choice(directives)
+        body = '' if d.startswith('@groupby') else rng.choice(bodies)
+        if d == '@recurse(depth: 2)':
+            body = '{ name friend }'
+        q = (f'{{ q(func: {rng.choice(roots)}{rng.choice(pageargs)}) '
+             f'{rng.choice(filters)} {d} {body} }}')
+        try:
+            out, _ = n.query(q)
+            assert isinstance(out, dict)
+            ran += 1
+        except (ParseError, TaskError, QueryError, ValueError):
+            pass     # typed rejection is fine; internal crashes are not
+    assert ran > 150, ran
