@@ -166,7 +166,7 @@ def cmd_zero(args) -> int:
     from dgraph_tpu.coord.zero_service import (ZeroOps, serve_zero,
                                                serve_zero_http)
 
-    zero = Zero(n_groups=args.groups)
+    zero = Zero(n_groups=args.groups, dirpath=args.wal)
     server, port, svc = serve_zero(zero, f"{args.host}:{args.port}")
     ops = ZeroOps(svc)
     httpd, hport = serve_zero_http(svc, ops, args.host, args.http_port)
@@ -302,6 +302,10 @@ def main(argv=None) -> int:
                          "(0 = ephemeral)")
     zp.add_argument("--groups", type=int, default=1,
                     help="number of server groups to balance tablets over")
+    zp.add_argument("-w", "--wal", default=None,
+                    help="durable state dir: lease ceilings + tablet map "
+                         "survive restarts (a crash skips at most one "
+                         "10k lease block, assign.go semantics)")
     zp.add_argument("--rebalance_interval", type=float, default=0,
                     help="seconds between automatic tablet rebalance ticks "
                          "(0 = off)")

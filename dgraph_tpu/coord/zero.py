@@ -64,6 +64,13 @@ class Oracle:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        # durability hook: called UNDER _lock whenever max_assigned crosses
+        # the current lease ceiling, BEFORE the triggering ts is returned —
+        # a caller never receives a timestamp the new ceiling doesn't
+        # durably cover (assign.go lease-block semantics). Covers every
+        # mutator (timestamps/new_txn/commit) by construction.
+        self.on_lease = None
+        self._ceiling = 0
         self._next_ts = 1
         self._key_commit: dict[int, int] = {}     # fingerprint -> max commit_ts
         self._pending: dict[int, TxnState] = {}   # start_ts -> state
@@ -74,6 +81,11 @@ class Oracle:
         self.pred_commit: dict[str, int] = {}
         self.max_assigned = 0
         self._decisions = 0                       # purge cadence counter
+
+    def _bump_ceiling_locked(self) -> None:
+        if self.on_lease is not None and self.max_assigned >= self._ceiling:
+            self._ceiling = self.max_assigned + LEASE_BLOCK
+            self.on_lease(self._ceiling)
 
     def _purge_below_locked(self) -> None:
         """Drop conflict/abort state no live or future txn can observe
@@ -95,6 +107,7 @@ class Oracle:
             ts = self._next_ts
             self._next_ts += n
             self.max_assigned = self._next_ts - 1
+            self._bump_ceiling_locked()
             return ts
 
     def new_txn(self) -> TxnState:
@@ -102,6 +115,7 @@ class Oracle:
             ts = self._next_ts
             self._next_ts += 1
             self.max_assigned = self._next_ts - 1
+            self._bump_ceiling_locked()
             st = TxnState(ts)
             self._pending[ts] = st
             return st
@@ -167,6 +181,7 @@ class Oracle:
             commit_ts = self._next_ts
             self._next_ts += 1
             self.max_assigned = self._next_ts - 1
+            self._bump_ceiling_locked()
             for fp in st.keys:
                 prev = self._key_commit.get(fp, 0)
                 if commit_ts > prev:
@@ -198,6 +213,8 @@ class UidLease:
 
     def __init__(self, start: int = 1) -> None:
         self._lock = threading.Lock()
+        self.on_lease = None       # same contract as Oracle.on_lease
+        self._ceiling = 0
         self._next = start
 
     def assign(self, n: int) -> tuple[int, int]:
@@ -207,12 +224,18 @@ class UidLease:
         with self._lock:
             s = self._next
             self._next += n
+            if self.on_lease is not None and self._next - 1 >= self._ceiling:
+                self._ceiling = self._next - 1 + LEASE_BLOCK
+                self.on_lease(self._ceiling)
             return s, self._next - 1
 
     def bump_to(self, uid: int) -> None:
         """Advance the lease past an externally-seen uid (xidmap/restart)."""
         with self._lock:
             self._next = max(self._next, uid + 1)
+            if self.on_lease is not None and self._next - 1 >= self._ceiling:
+                self._ceiling = self._next - 1 + LEASE_BLOCK
+                self.on_lease(self._ceiling)
 
     @property
     def max_leased(self) -> int:
@@ -253,15 +276,74 @@ class Zero:
     Reference: the `dgraph zero` process. Tablets map predicates to groups
     (zero.go:436 ShouldServe); in the TPU design a "group" is a set of mesh
     devices serving that predicate's sharded CSR (parallel/mesh.py).
+
+    Durability (`dirpath`): the reference Raft-persists leases and the
+    tablet map (assign.go:65-125 proposes lease BLOCKS so a crash skips at
+    most one block; zero.go tablet proposals). Here a state file records
+    lease CEILINGS (bumped a block ahead of issuance) plus the tablet map:
+    a restarted Zero resumes past every ts/uid it could have handed out —
+    it may burn up to one block, exactly the reference's crash semantics.
+    Pending (undecided) txns are lost on restart = aborted, also matching
+    the reference (their Decide would fail at the new oracle).
     """
 
-    def __init__(self, n_groups: int = 1) -> None:
+    def __init__(self, n_groups: int = 1, dirpath: str | None = None) -> None:
         self.oracle = Oracle()
         self.uids = UidLease()
         self.n_groups = max(1, n_groups)
         self._tablets: dict[str, int] = {}
         self._moving: set[str] = set()     # tablets mid-move: writes blocked
         self._tlock = threading.Lock()
+        self._dir = dirpath
+        self._ts_ceiling = 0
+        self._uid_ceiling = 0
+        self._plock = threading.Lock()
+        if dirpath:
+            import json as _json
+            import os as _os
+
+            _os.makedirs(dirpath, exist_ok=True)
+            path = _os.path.join(dirpath, "zero_state.json")
+            if _os.path.exists(path):
+                with open(path) as f:
+                    st = _json.load(f)
+                self.oracle.timestamps(max(int(st.get("ts_ceiling", 0)), 0))
+                if int(st.get("uid_ceiling", 0)) > 0:
+                    self.uids.bump_to(int(st["uid_ceiling"]))
+                self._tablets = {a: int(g)
+                                 for a, g in st.get("tablets", {}).items()}
+                self.n_groups = max(self.n_groups,
+                                    int(st.get("n_groups", self.n_groups)))
+            # lease-source callbacks run UNDER the issuing lock, so a ts
+            # or uid is never returned before the ceiling covering it is
+            # durable (assign.go: a crash burns at most one block)
+            self.oracle.on_lease = self._on_ts_lease
+            self.uids.on_lease = self._on_uid_lease
+            self._persist()
+
+    def _on_ts_lease(self, ceiling: int) -> None:
+        self._ts_ceiling = ceiling
+        self._persist()
+
+    def _on_uid_lease(self, ceiling: int) -> None:
+        self._uid_ceiling = ceiling
+        self._persist()
+
+    def _persist(self) -> None:
+        import json as _json
+        import os as _os
+
+        path = _os.path.join(self._dir, "zero_state.json")
+        tmp = path + ".tmp"
+        with self._plock:   # ts/uid/tablet persists may race each other
+            with open(tmp, "w") as f:
+                _json.dump({"ts_ceiling": self._ts_ceiling,
+                            "uid_ceiling": self._uid_ceiling,
+                            "tablets": self.tablets(),
+                            "n_groups": self.n_groups}, f)
+                f.flush()
+                _os.fsync(f.fileno())
+            _os.replace(tmp, path)
 
     def block_writes(self, attr: str) -> None:
         """Mark a tablet read-only for the duration of a move (the reference
@@ -285,6 +367,7 @@ class Zero:
     def should_serve(self, attr: str) -> int:
         """Group owning a predicate; first-asker claims it, balanced by
         tablet count (reference zero.go:436 + tablet.go chooseTablet)."""
+        claimed = False
         with self._tlock:
             g = self._tablets.get(attr)
             if g is None:
@@ -293,7 +376,10 @@ class Zero:
                     loads[gg] += 1
                 g = loads.index(min(loads))
                 self._tablets[attr] = g
-            return g
+                claimed = True
+        if claimed and self._dir:      # outside _tlock (persist reads the map)
+            self._persist()
+        return g
 
     def tablets(self) -> dict[str, int]:
         with self._tlock:
@@ -302,6 +388,8 @@ class Zero:
     def move_tablet(self, attr: str, group: int) -> None:
         with self._tlock:
             self._tablets[attr] = group
+        if self._dir:
+            self._persist()
 
     def state(self) -> dict:
         """Membership dump (reference /state, dgraph/cmd/zero/http.go:130)."""

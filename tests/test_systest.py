@@ -325,3 +325,52 @@ def test_process_move_tablet_and_rebalance(tmp_path, procs):
     assert len(res["q"]) == 300
     assert [x["name"] for x in res2["q"]] == ["q3"]
     client.close()
+
+
+def test_zero_process_restart_with_wal(tmp_path, procs):
+    """kill -9 the zero coordinator and restart it from its state dir: the
+    tablet map and lease ceilings survive, so the cluster keeps answering
+    and new uids/timestamps never collide with pre-crash ones."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    zport = s.getsockname()[1]
+    s.close()
+    zw = str(tmp_path / "zw")
+    zp, _ = _spawn(tmp_path, ["zero", "--port", str(zport), "-w", zw], "zero")
+    procs(zp)
+    sf = _write_schema(tmp_path)
+    wp, wport = _spawn(tmp_path, [
+        "worker", "--port", "0", "-p", str(tmp_path / "w0"),
+        "--schema", sf, "--zero", f"127.0.0.1:{zport}",
+        "--group", "0", "--membership_interval", "1"], "worker")
+    procs(wp)
+    groups = {0: [f"127.0.0.1:{wport}"]}
+    client = ClusterClient(f"127.0.0.1:{zport}", groups)
+    uids1 = client.mutate(set_nquads='_:a <name> "before" .')
+    out = client.query('{ q(func: eq(name, "before")) { uid name } }')
+    assert [x["name"] for x in out["q"]] == ["before"]
+    old_uid = int(out["q"][0]["uid"], 16)
+
+    os.kill(zp.pid, signal.SIGKILL)
+    zp.wait(timeout=10)
+    zp2, _ = _spawn(tmp_path, ["zero", "--port", str(zport), "-w", zw],
+                    "zero-restarted")
+    procs(zp2)
+
+    client._invalidate()
+    deadline = time.time() + 30
+    uids2 = None
+    while time.time() < deadline:
+        try:
+            uids2 = client.mutate(set_nquads='_:b <name> "after" .')
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert uids2 is not None, "cluster never recovered after zero restart"
+    new_uid = uids2["_:b"]
+    assert new_uid > old_uid        # lease ceiling prevented uid reuse
+    out = client.query('{ q(func: has(name), orderasc: name) { name } }')
+    assert [x["name"] for x in out["q"]] == ["after", "before"]
+    client.close()
