@@ -217,6 +217,11 @@ class UidLease:
         self._ceiling = 0
         self._next = start
 
+    def _bump_ceiling_locked(self) -> None:
+        if self.on_lease is not None and self._next - 1 >= self._ceiling:
+            self._ceiling = self._next - 1 + LEASE_BLOCK
+            self.on_lease(self._ceiling)
+
     def assign(self, n: int) -> tuple[int, int]:
         """Lease n uids; returns [start, end] inclusive."""
         if n <= 0:
@@ -224,18 +229,14 @@ class UidLease:
         with self._lock:
             s = self._next
             self._next += n
-            if self.on_lease is not None and self._next - 1 >= self._ceiling:
-                self._ceiling = self._next - 1 + LEASE_BLOCK
-                self.on_lease(self._ceiling)
+            self._bump_ceiling_locked()
             return s, self._next - 1
 
     def bump_to(self, uid: int) -> None:
         """Advance the lease past an externally-seen uid (xidmap/restart)."""
         with self._lock:
             self._next = max(self._next, uid + 1)
-            if self.on_lease is not None and self._next - 1 >= self._ceiling:
-                self._ceiling = self._next - 1 + LEASE_BLOCK
-                self.on_lease(self._ceiling)
+            self._bump_ceiling_locked()
 
     @property
     def max_leased(self) -> int:
@@ -307,9 +308,13 @@ class Zero:
             if _os.path.exists(path):
                 with open(path) as f:
                     st = _json.load(f)
-                self.oracle.timestamps(max(int(st.get("ts_ceiling", 0)), 0))
-                if int(st.get("uid_ceiling", 0)) > 0:
-                    self.uids.bump_to(int(st["uid_ceiling"]))
+                # restore the CEILINGS too: a restart that issues nothing
+                # before the next crash must not write them back as 0
+                self._ts_ceiling = int(st.get("ts_ceiling", 0))
+                self._uid_ceiling = int(st.get("uid_ceiling", 0))
+                self.oracle.timestamps(max(self._ts_ceiling, 0))
+                if self._uid_ceiling > 0:
+                    self.uids.bump_to(self._uid_ceiling)
                 self._tablets = {a: int(g)
                                  for a, g in st.get("tablets", {}).items()}
                 self.n_groups = max(self.n_groups,
@@ -329,17 +334,21 @@ class Zero:
         self._uid_ceiling = ceiling
         self._persist()
 
-    def _persist(self) -> None:
+    def _persist(self, tablets: dict | None = None) -> None:
         import json as _json
         import os as _os
 
+        # take the tablet snapshot BEFORE _plock (callers inside _tlock
+        # pass it; taking _tlock under _plock would deadlock against the
+        # _tlock -> _plock order of the claim paths)
+        snap = tablets if tablets is not None else self.tablets()
         path = _os.path.join(self._dir, "zero_state.json")
         tmp = path + ".tmp"
         with self._plock:   # ts/uid/tablet persists may race each other
             with open(tmp, "w") as f:
                 _json.dump({"ts_ceiling": self._ts_ceiling,
                             "uid_ceiling": self._uid_ceiling,
-                            "tablets": self.tablets(),
+                            "tablets": snap,
                             "n_groups": self.n_groups}, f)
                 f.flush()
                 _os.fsync(f.fileno())
@@ -367,7 +376,6 @@ class Zero:
     def should_serve(self, attr: str) -> int:
         """Group owning a predicate; first-asker claims it, balanced by
         tablet count (reference zero.go:436 + tablet.go chooseTablet)."""
-        claimed = False
         with self._tlock:
             g = self._tablets.get(attr)
             if g is None:
@@ -376,9 +384,11 @@ class Zero:
                     loads[gg] += 1
                 g = loads.index(min(loads))
                 self._tablets[attr] = g
-                claimed = True
-        if claimed and self._dir:      # outside _tlock (persist reads the map)
-            self._persist()
+                if self._dir:
+                    # durable BEFORE any caller can act on the claim — a
+                    # crash must not re-balance a tablet that data already
+                    # landed on (the reference Raft-proposes the claim)
+                    self._persist(tablets=dict(self._tablets))
         return g
 
     def tablets(self) -> dict[str, int]:
@@ -388,8 +398,8 @@ class Zero:
     def move_tablet(self, attr: str, group: int) -> None:
         with self._tlock:
             self._tablets[attr] = group
-        if self._dir:
-            self._persist()
+            if self._dir:
+                self._persist(tablets=dict(self._tablets))
 
     def state(self) -> dict:
         """Membership dump (reference /state, dgraph/cmd/zero/http.go:130)."""

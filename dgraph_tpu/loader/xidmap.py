@@ -40,9 +40,13 @@ class XidMap:
             return u
         explicit = parse_uid_literal(xid)
         if explicit is not None:
-            # reserve: the uid may fall inside an already-leased block
+            # reserve: the uid may fall inside an already-leased block.
+            # Memoize like named nodes — graph data repeats each uid ~degree
+            # times, and re-parsing + re-locking the lease per occurrence
+            # was the bulk loader's hottest line
             self._taken.add(explicit)
             self._lease.bump_to(explicit)
+            self._map[xid] = explicit
             return explicit
         while True:
             if self._next > self._end:

@@ -234,11 +234,22 @@ def build_pred(store: Store, attr: str, read_ts: int,
     own = own_start_ts
     kbs = store.keys_of(K.KeyKind.DATA, attr)
     tablet_uids = _tablet_uids(store, kbs, read_ts, own)
+    uid_typed = tid == TypeID.UID
     for kb, u in zip(kbs, tablet_uids):
         subj = K.uid_of(kb)        # DATA key: partial parse, hot loop
         pl = store.lists.get(kb)
         if pl is None:             # predicate dropped mid-build (follower
             continue               # live-apply); version bump rebuilds
+        if uid_typed and not pl.layers and not pl.uncommitted \
+                and not pl.base_postings:
+            # post-bulk fast path: a pure packed uid list carries no
+            # values/facets — skip the live_map fold entirely (unlocked
+            # peek is safe: a layer landing mid-check commits ABOVE this
+            # snapshot's ts and is invisible to it anyway; replayed
+            # below-watermark commits invalidate via pred_replay_seq)
+            if len(u):
+                fwd_rows.append((subj, u))
+            continue
         live = pl.live_map(read_ts, own_start_ts=own)
         # type heuristic for untyped predicates probes ANY value ("." tag);
         # host_values below still reads only the untagged slot

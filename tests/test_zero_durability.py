@@ -66,3 +66,18 @@ def test_commit_ts_covered_by_ceiling(tmp_path):
     assert ceiling > max(commit_ts)
     z2 = Zero(dirpath=d)
     assert z2.oracle.new_txn().start_ts > max(commit_ts)
+
+
+def test_double_restart_keeps_ceilings(tmp_path):
+    """A restart that issues NOTHING before the next crash must still
+    protect everything the previous incarnation issued (review r4: the
+    restored ceilings were written back as 0)."""
+    d = str(tmp_path / "z")
+    z = Zero(dirpath=d)
+    issued = [z.oracle.new_txn().start_ts for _ in range(3)]
+    z.uids.assign(50)
+    z2 = Zero(dirpath=d)      # restart 1: serves nothing
+    z3 = Zero(dirpath=d)      # restart 2
+    assert z3.oracle.new_txn().start_ts > max(issued)
+    s, _ = z3.uids.assign(1)
+    assert s > 50
