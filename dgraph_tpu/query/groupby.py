@@ -31,6 +31,17 @@ def process_groupby(ex, sg) -> None:
         sg.group_result = []
         return
 
+    # vectorized fast path: a single NUMERIC value key groups via one
+    # searchsorted + np.unique over the exact float64 mirror — no per-uid
+    # Python (the segmented-reduction stance of the module docstring,
+    # applied to the grouping itself)
+    fast = _numeric_single_key_groups(ex, gq, uids)
+    if fast is not None:
+        keys_sorted, members_per, alias = fast
+        sg.group_result = _assemble_rows(
+            ex, gq, [{alias: kv} for kv in keys_sorted], members_per)
+        return
+
     # group keys per uid, one column per groupby attr
     columns: list[tuple[str, dict[int, Any]]] = []  # (alias, uid -> key val)
     for alias, attr, lang in gq.groupby.attrs:
@@ -73,21 +84,16 @@ def process_groupby(ex, sg) -> None:
     # aggregates from the block's children — numeric ops run as ONE
     # segmented reduction across every group (ops/segments.py); count and
     # non-numeric min/max fall back per group
-    result = []
     keys_sorted = sorted(groups.keys(), key=repr)
     members_per = [np.unique(np.asarray(groups[k], dtype=np.int64))
                    for k in keys_sorted]
-    batched = _batch_aggregates(ex, gq.children, members_per)
-    for gi, key in enumerate(keys_sorted):
+    seeds = []
+    for key in keys_sorted:
         row: dict = {}
         for (alias, _col), kv in zip(columns, key):
             row[alias] = kv if not isinstance(kv, tuple) else kv[1]
-        for cgq in gq.children:
-            got = batched.get(id(cgq))
-            row.update(got[gi] if got is not None
-                       else _group_agg(ex, cgq, members_per[gi]))
-        result.append(row)
-    sg.group_result = result
+        seeds.append(row)
+    sg.group_result = _assemble_rows(ex, gq, seeds, members_per)
 
 
 def _host_segment_reduce(op: str, seg: np.ndarray, vals: np.ndarray,
@@ -173,6 +179,76 @@ def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
             rows.append({name: _val_json(v)})
         out[id(cgq)] = rows
     return out
+
+
+def _assemble_rows(ex, gq, row_seeds: list[dict],
+                   members_per: list[np.ndarray]) -> list[dict]:
+    """Attach each group's child aggregates to its key row (shared by the
+    vectorized and generic grouping paths)."""
+    batched = _batch_aggregates(ex, gq.children, members_per)
+    for gi, row in enumerate(row_seeds):
+        for cgq in gq.children:
+            got = batched.get(id(cgq))
+            row.update(got[gi] if got is not None
+                       else _group_agg(ex, cgq, members_per[gi]))
+    return row_seeds
+
+
+def _numeric_single_key_groups(ex, gq, uids):
+    """(sorted key-json list, member arrays, alias) for the vectorized
+    single-numeric-key case, else None (generic path). Requires the key
+    predicate's exact numeric mirror locally (non-list INT/FLOAT/BOOL/
+    DATETIME); string keys and remote tablets keep the generic path."""
+    if len(gq.groupby.attrs) != 1:
+        return None
+    alias, attr, lang = gq.groupby.attrs[0]
+    if lang:
+        return None
+    pd = ex.snap.pred(attr)
+    if pd is None or pd.num_values_host is None \
+            or pd.value_subjects_host is None or ex.schema.is_list(attr):
+        return None
+    tid = ex.schema.type_of(attr)
+    # DATETIME excluded: equal instants with different tz offsets collapse
+    # in the float mirror but display as distinct isoformat keys
+    if tid not in (TypeID.INT, TypeID.FLOAT, TypeID.BOOL):
+        return None
+    from dgraph_tpu.ops.uidset import host_rank_of
+    from dgraph_tpu.query.outputnode import _val_json
+
+    pos = host_rank_of(pd.value_subjects_host, uids, -1)
+    ok = pos >= 0
+    vals = np.where(ok, pd.num_values_host[np.clip(pos, 0, None)], np.nan)
+    nan_slots = ok & np.isnan(vals)
+    if nan_slots.any():
+        # a NaN mirror is EITHER a missing/lang-only value (skip, like the
+        # generic path) OR a stored float NaN (a real group key the mirror
+        # cannot carry) — bail to generic when any stored NaN exists
+        for u in uids[nan_slots].tolist():
+            v = pd.host_values.get(int(u))
+            if v is not None and isinstance(v.value, float) \
+                    and v.value != v.value:
+                return None
+    ok &= ~np.isnan(vals)
+    if not ok.any():
+        return [], [], (alias or attr)
+    if tid == TypeID.INT and np.abs(vals[ok]).max() >= 2.0 ** 53:
+        return None     # float64 mirror is lossy past 2^53: keys could merge
+    grp_vals, inverse = np.unique(vals[ok], return_inverse=True)
+    kept = uids[ok]
+    order = np.argsort(inverse, kind="stable")
+    bounds = np.searchsorted(inverse[order], np.arange(len(grp_vals) + 1))
+    members_per = [np.unique(kept[order[bounds[i]: bounds[i + 1]]])
+                   for i in range(len(grp_vals))]
+    # key display values from the exact per-uid Val of one representative
+    keys = []
+    for i in range(len(grp_vals)):
+        rep = int(members_per[i][0])
+        keys.append(_val_json(pd.host_values[rep]))
+    # generic path sorts groups by repr of the key tuple — sort to match
+    perm = sorted(range(len(keys)), key=lambda i: repr((keys[i],)))
+    return [keys[i] for i in perm], [members_per[i] for i in perm], \
+        (alias or attr)
 
 
 def _group_key(x):

@@ -522,3 +522,70 @@ def test_value_edge_facets():
     assert row["name|since"].startswith("2021-01-01")
     out, _ = n.query('{ q(func: eq(name, "Fay")) { name @facets(src: by) } }')
     assert out["q"][0] == {"name": "Fay", "name|src": "import"}
+
+
+def test_groupby_numeric_fast_path_matches_generic():
+    """Single-numeric-key groupby takes the vectorized path and must equal
+    the generic per-uid path exactly (keys, order, members, aggregates)."""
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.query import groupby as gbmod
+
+    n = Node()
+    n.alter(schema_text="name: string .\nage: int .\nscore: float .")
+    quads = []
+    for i in range(1, 40):
+        quads.append(f'<0x{i:x}> <name> "p{i}" .')
+        quads.append(f'<0x{i:x}> <age> "{20 + i % 5}"^^<xs:int> .')
+        quads.append(f'<0x{i:x}> <score> "{i}.25"^^<xs:float> .')
+    n.mutate(set_nquads="\n".join(quads), commit_now=True)
+    q = ('{ q(func: has(name)) @groupby(age) { count(uid) m : max(val(s)) } '
+         '  var(func: has(name)) { s as score } }')
+    spy = {"n": 0}
+    real = gbmod._numeric_single_key_groups
+
+    def counting(*a, **kw):
+        out = real(*a, **kw)
+        if out is not None:
+            spy["n"] += 1
+        return out
+
+    gbmod._numeric_single_key_groups = counting
+    try:
+        fast, _ = n.query(q)
+    finally:
+        gbmod._numeric_single_key_groups = real
+    assert spy["n"] == 1, "fast path was not taken"
+    gbmod._numeric_single_key_groups = lambda *a, **kw: None
+    try:
+        generic, _ = n.query(q)
+    finally:
+        gbmod._numeric_single_key_groups = real
+    assert fast == generic
+    counts = {g["age"]: g["count"] for g in fast["q"][0]["@groupby"]}
+    assert sum(counts.values()) == 39 and len(counts) == 5
+
+
+def test_groupby_fast_path_exactness_guards():
+    """Cases where the float64 mirror is lossy/ambiguous must take the
+    generic path and keep exact semantics (review r4)."""
+    from dgraph_tpu.api.server import Node
+
+    n = Node()
+    n.alter(schema_text="big: int .\nx: float .\nwhen: datetime .")
+    n.mutate(set_nquads=f'''
+        <0x1> <big> "{2**53}"^^<xs:int> .
+        <0x2> <big> "{2**53 + 1}"^^<xs:int> .
+        <0x3> <x> "NaN"^^<xs:float> .
+        <0x4> <x> "1.5"^^<xs:float> .
+        <0x5> <when> "2021-01-01T00:00:00+00:00" .
+        <0x6> <when> "2021-01-01T01:00:00+01:00" .
+    ''', commit_now=True)
+    # distinct int64 keys above 2^53 stay distinct
+    out, _ = n.query('{ q(func: has(big)) @groupby(big) { count(uid) } }')
+    assert len(out["q"][0]["@groupby"]) == 2
+    # stored float NaN keeps its group
+    out, _ = n.query('{ q(func: has(x)) @groupby(x) { count(uid) } }')
+    assert len(out["q"][0]["@groupby"]) == 2
+    # same instant, different tz offsets: distinct display keys
+    out, _ = n.query('{ q(func: has(when)) @groupby(when) { count(uid) } }')
+    assert len(out["q"][0]["@groupby"]) == 2
