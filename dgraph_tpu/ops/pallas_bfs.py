@@ -256,6 +256,9 @@ class PullGraph(NamedTuple):
     num_edges: int
     chunks: int                 # bitmap chunks over the SRC-RANK space
     chunks_d: int               # bitmap chunks over the DST-RANK space
+    inv_order: np.ndarray | None = None  # HOST int32[E]: fwd edge position →
+    # dst-sorted edge position (the kernel's per-edge flag space); used to
+    # materialize per-source fresh-target lists lazily (recurse uidMatrix)
 
 
 def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
@@ -317,6 +320,8 @@ def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
         np.int32)                    # every dst IS in in_subjects
     snt = np.int32(np.iinfo(np.int32).max)
     map_d2s = host_rank_of(subjects, in_subjects, snt).astype(np.int32)
+    inv_order = np.empty(E, dtype=np.int32)
+    inv_order[order] = np.arange(E, dtype=np.int32)
     return PullGraph(jnp.asarray(src_pad), jnp.asarray(src_pad_d),
                      jnp.asarray(iptr),
                      jnp.asarray(subjects.astype(np.int32)),
@@ -325,7 +330,8 @@ def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
                      jnp.asarray(np.asarray(indptr).astype(np.int32)),
                      jnp.asarray(fwd_dst_rank),
                      jnp.asarray(map_d2s),
-                     int(num_nodes), int(E), int(chunks), int(chunks_d))
+                     int(num_nodes), int(E), int(chunks), int(chunks_d),
+                     inv_order)
 
 
 def pack_words(mask: jax.Array, chunks: int) -> jax.Array:
@@ -517,3 +523,95 @@ def k_hop_pull_pallas(g: PullGraph, seeds_mask: jax.Array, *, hops: int,
                        g.fwd_dst_rank, g.map_d2s, seeds_mask, seeds_ranks,
                        hops=hops, chunks=g.chunks, chunks_d=g.chunks_d,
                        num_nodes=g.num_nodes, have_seeds=have_seeds)
+
+
+# ---------------------------------------------------------------------------
+# edge-dedup traversal: the production @recurse path (reference
+# query/recurse.go:31-177 expandRecurse). Unlike BFS (node-visited), recurse
+# dedups EDGES: a node reached again over a never-traversed edge re-appears
+# at the deeper level. The kernel's fused active-prefix provides exactly the
+# per-edge active flags edge-dedup needs; "seen" is a bool vector over the
+# dst-sorted edge stream carried on device across levels.
+# ---------------------------------------------------------------------------
+
+
+def pull_graph_for(csr) -> PullGraph:
+    """Cached PullGraph for a storage PredCSR (one host prep per snapshot)."""
+    g = getattr(csr, "_pull_graph", None)
+    if g is None:
+        subjects, indptr, indices = csr.host_arrays()
+        hi = max(int(subjects[-1]) if len(subjects) else 0,
+                 int(indices.max()) if len(indices) else 0)
+        g = prep_pull(np.asarray(subjects), np.asarray(indptr),
+                      np.asarray(indices), hi + 1)
+        csr._pull_graph = g
+    return g
+
+
+def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
+                   frontier_mask, seen, *, chunks: int, num_nodes: int,
+                   allow_loop: bool):
+    """One recurse level: frontier mask (FULL uid space — multi-predicate
+    frontiers are not confined to this predicate's destinations) →
+    (dest_mask, traversed, seen', fresh). traversed counts EVERY out-edge of
+    every frontier node (the budget the reference charges, recurse.go:167);
+    fresh marks first-traversal edges; dest = nodes with >= 1 fresh in-edge."""
+    fbits = jnp.take(frontier_mask, subjects)              # [Ns] rank space
+    fcount = jnp.sum(fbits, dtype=jnp.int32)
+
+    def sparse_hop(f):
+        return active_prefix_sparse(_frontier_table(f), in_src_pad)
+
+    def dense_hop(f):
+        return active_prefix(pack_words(f, chunks), in_src_pad, chunks=chunks)
+
+    prefix = lax.cond(fcount <= SPARSE_MAX, sparse_hop, dense_hop, fbits)
+    traversed = prefix[-1]
+    prev = jnp.concatenate([jnp.zeros((1,), jnp.int32), prefix[:-1]])
+    active = (prefix - prev) > 0                           # bool[E_pad]
+    if allow_loop:
+        fresh, seen2 = active, seen
+    else:
+        fresh = active & ~seen
+        seen2 = seen | active
+    freshp = jnp.cumsum(fresh.astype(jnp.int32))
+    bounds = jnp.take(freshp, in_iptr_rank - 1, mode="clip")
+    bounds = jnp.where(in_iptr_rank == 0, 0, bounds)
+    reached = (bounds[1:] - bounds[:-1]) > 0               # [Nd]
+    dest_mask = jnp.zeros((num_nodes,), bool).at[in_subjects].set(
+        reached, mode="drop")
+    return dest_mask, traversed, seen2, fresh
+
+
+@partial(jax.jit, static_argnames=("chunks", "num_nodes", "allow_loop"))
+def recurse_step(in_src_pad, in_iptr_rank, subjects, in_subjects,
+                 frontier_mask, seen, *, chunks: int, num_nodes: int,
+                 allow_loop: bool):
+    """Single stepped level (used when filters / multiple recurse children
+    force host control between levels)."""
+    return _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
+                          frontier_mask, seen, chunks=chunks,
+                          num_nodes=num_nodes, allow_loop=allow_loop)
+
+
+@partial(jax.jit, static_argnames=("depth", "chunks", "num_nodes",
+                                   "allow_loop"))
+def recurse_fused(in_src_pad, in_iptr_rank, subjects, in_subjects,
+                  seeds_mask, seen0, *, depth: int, chunks: int,
+                  num_nodes: int, allow_loop: bool):
+    """All `depth` levels in ONE dispatch (lax.scan): no host round-trip —
+    and no relay sync — between levels. Returns stacked per-level
+    (dest_masks [D,N], traversed [D], fresh [D,E_pad]). Only for the
+    single-uid-child no-filter recurse shape (the common + benchmarked one);
+    anything needing host logic between levels uses recurse_step."""
+
+    def body(carry, _):
+        mask, seen = carry
+        dest, trav, seen2, fresh = _recurse_level(
+            in_src_pad, in_iptr_rank, subjects, in_subjects, mask, seen,
+            chunks=chunks, num_nodes=num_nodes, allow_loop=allow_loop)
+        return (dest, seen2), (dest, trav, fresh)
+
+    (_m, _s), (masks, trav, fresh) = lax.scan(
+        body, (seeds_mask, seen0), None, length=depth)
+    return masks, trav, fresh

@@ -3,27 +3,132 @@
 Reference semantics: query/recurse.go — expandRecurse (:31-177): loop per
 level, spawning copies of the original children as the new frontier's
 SubGraphs (:157-164); loop prevention via a reach-set of (attr, from, to)
-edges (:129-141) unless `loop: true`; bounded by the 1e6 edge budget (:167).
+edges (:129-141) unless `loop: true`; bounded by the edge budget (:167).
 
-TPU shape: each level is one batched expand per traversed predicate. The
-reach-set is NOT a per-edge Python set: an edge of one predicate is exactly
-one CSR position, so "seen" is a bool mask over the edge array and a level's
-dedup is one vectorized gather + mask update over the cached host CSR mirror
-(r4; the old per-edge dict loop was the engine's recursion bottleneck). The
-pure-device node-visited variant (ops/traversal.k_hop, used by bench and
-dist) intentionally does NOT back this path: recurse's reach-set dedups
-EDGES, so a node reached again over a new edge must re-appear at the deeper
-level in the output tree — node-visited semantics would drop it.
+TPU shape — one hot path, benched and served alike (worker/task.go:605):
+
+  * Large resident CSRs run the SAME Pallas active-prefix kernel the
+    benchmark measures (ops/pallas_bfs): per level, the kernel streams the
+    dst-sorted edge array against the VMEM frontier bitmap; the fused
+    per-edge prefix yields active flags, and edge-dedup is two streaming
+    masks on device (fresh = active & ~seen, seen |= active) plus a
+    node-sized bounds-diff for the next frontier. The reach-set of
+    recurse.go:129 is a device-resident bool vector over the edge stream.
+    The common single-child no-filter shape runs ALL levels in one
+    dispatch (recurse_fused lax.scan) — no relay sync between levels.
+    Per-source target lists (uidMatrix) stay CSR-shaped and deferred
+    (LazyRecurseMatrix): output encoders materialize on demand.
+  * Small CSRs keep the vectorized host-mirror gather (the size-adaptive
+    dispatch rule of task.HOST_EXPAND_MAX: below the device's fixed
+    dispatch+sync cost, host numpy wins).
+  * Tablet-routed (is_dist) predicates expand over the wire with
+    (attr, from, to) edge-key dedup, exactly recurse.go:129-141.
 """
 
 from __future__ import annotations
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import MAX_QUERY_EDGES, QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID
+
+# kernel-path admission: below this edge count the host mirror's vectorized
+# gather beats the kernel's fixed dispatch + per-chunk VPU cost. Tests set
+# the module global to 0 to force the kernel (interpret mode off-TPU).
+KERNEL_MIN_EDGES: int | None = None       # None = backend-dependent default
+_KERNEL_MIN_TPU = 1 << 20
+FUSED_MAX_DEPTH = 8   # fresh-flag buffer is depth × E_pad bools
+
+
+def _kernel_min() -> int:
+    if KERNEL_MIN_EDGES is not None:
+        return KERNEL_MIN_EDGES
+    if jax.default_backend() == "tpu":
+        return _KERNEL_MIN_TPU
+    return 1 << 62    # interpret-mode Pallas: host path always wins
+
+
+class LazyRecurseMatrix:
+    """A recurse level's uidMatrix in deferred CSR form.
+
+    The kernel path's native result is device state (per-edge fresh flags in
+    the dst-sorted stream + the next frontier mask); ragged per-source
+    target lists are materialized host-side only when an output encoder,
+    cascade, or count actually reads them (SURVEY §7: result
+    materialization is inherently ragged → host-side by design)."""
+
+    def __init__(self, csr, g, frontier: np.ndarray, fresh_dev, level,
+                 allow_loop: bool):
+        self._csr = csr
+        self._g = g
+        self._frontier = np.asarray(frontier, dtype=np.int64)
+        self._fresh_dev = fresh_dev      # [E_pad] or [depth, E_pad] stacked
+        self._level = level              # row of the stacked buffer, or None
+        self._allow_loop = allow_loop
+        self._rows: list[np.ndarray] | None = None
+
+    def _materialize(self) -> list[np.ndarray]:
+        if self._rows is not None:
+            return self._rows
+        from dgraph_tpu.ops import uidset as us
+
+        subjects, indptr, indices = self._csr.host_arrays()
+        rows = us.host_rank_of(subjects, self._frontier, -1)
+        ok = rows >= 0
+        rc = np.where(ok, rows, 0)
+        starts = np.where(ok, indptr[rc], 0).astype(np.int64)
+        ends = np.where(ok, indptr[rc + 1], 0).astype(np.int64)
+        counts = ends - starts
+        total = int(counts.sum())
+        offs = np.zeros(len(self._frontier) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        pos = np.repeat(starts - offs[:-1], counts) + np.arange(total)
+        targets = indices[pos].astype(np.int64)
+        if self._allow_loop:
+            keep = np.ones(total, dtype=bool)
+        else:
+            f = (self._fresh_dev if self._level is None
+                 else self._fresh_dev[self._level])
+            fresh_h = np.asarray(f)          # one fetch per level, cached
+            keep = fresh_h[self._g.inv_order[pos]]
+        self._rows = [targets[offs[i]: offs[i + 1]][keep[offs[i]: offs[i + 1]]]
+                      for i in range(len(self._frontier))]
+        return self._rows
+
+    def __len__(self) -> int:
+        return len(self._frontier)
+
+    def __bool__(self) -> bool:
+        return len(self._frontier) > 0
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+
+class LazyCounts:
+    """list-like per-source counts over a LazyRecurseMatrix."""
+
+    def __init__(self, m: LazyRecurseMatrix):
+        self._m = m
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def __bool__(self) -> bool:
+        return len(self._m) > 0
+
+    def __getitem__(self, i) -> int:
+        return len(self._m._materialize()[i])
+
+    def __iter__(self):
+        return (len(r) for r in self._m._materialize())
 
 
 def _expand_dedup(csr, frontier: np.ndarray, seen: np.ndarray,
@@ -55,6 +160,14 @@ def _expand_dedup(csr, frontier: np.ndarray, seen: np.ndarray,
     return matrix, total
 
 
+def _seeds_mask(uids: np.ndarray, num_nodes: int) -> jnp.ndarray:
+    sel = uids[uids < num_nodes].astype(np.int64)
+    m = jnp.zeros((num_nodes,), dtype=bool)
+    if len(sel):
+        m = m.at[jnp.asarray(sel)].set(True)
+    return m
+
+
 def recurse(ex, sg: SubGraph) -> None:
     gq = sg.gq
     spec = gq.recurse
@@ -65,7 +178,8 @@ def recurse(ex, sg: SubGraph) -> None:
                         and ex.snap.pred(c.attr).csr is not None)
                     or c.attr.startswith("~")]
     val_children = [c for c in gq.children if c not in uid_children]
-    seen_masks: dict[str, np.ndarray] = {}     # child attr -> bool[E]
+    seen_masks: dict[str, np.ndarray] = {}     # host path: attr -> bool[E]
+    kstates: dict[str, dict] = {}              # kernel path: attr -> g, seen
     seen_edges: set[tuple[str, int, int]] = set()   # dist-CSR fallback only
     edges = 0
 
@@ -76,6 +190,32 @@ def recurse(ex, sg: SubGraph) -> None:
         if pd is None:
             return None
         return pd.rev_csr if rev else pd.csr
+
+    def _use_kernel(csr) -> bool:
+        return (csr is not None and not getattr(csr, "is_dist", False)
+                and csr.num_edges >= _kernel_min())
+
+    def _kstate(attr: str, csr):
+        from dgraph_tpu.ops import pallas_bfs as pb
+
+        st = kstates.get(attr)
+        if st is None:
+            g = pb.pull_graph_for(csr)
+            st = kstates[attr] = {
+                "g": g,
+                "seen": jnp.zeros((g.in_src_pad.shape[0],), dtype=bool)}
+        return st
+
+    # ---- fused fast path: single uid child, no filters/val children -------
+    if (len(uid_children) == 1 and not val_children
+            and uid_children[0].filter is None
+            and depth <= FUSED_MAX_DEPTH):
+        cgq = uid_children[0]
+        csr = _csr_for(cgq)
+        if _use_kernel(csr):
+            _recurse_fused_path(ex, sg, cgq, csr, depth, spec.allow_loop)
+            ex._record_uid_var(gq, sg)
+            return
 
     def build_level(frontier: np.ndarray, remaining: int) -> list[SubGraph]:
         nonlocal edges
@@ -96,7 +236,29 @@ def recurse(ex, sg: SubGraph) -> None:
         for cgq in uid_children:
             child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
             csr = _csr_for(cgq)
-            if csr is not None and not getattr(csr, "is_dist", False):
+            if _use_kernel(csr) and len(frontier):
+                # PRODUCTION KERNEL PATH: one stepped Pallas level
+                from dgraph_tpu.ops import pallas_bfs as pb
+
+                st = _kstate(cgq.attr, csr)
+                g = st["g"]
+                fmask = _seeds_mask(frontier, g.num_nodes)
+                dest_mask, trav, seen2, fresh = pb.recurse_step(
+                    g.in_src_pad, g.in_iptr_rank, g.subjects, g.in_subjects,
+                    fmask, st["seen"], chunks=g.chunks,
+                    num_nodes=g.num_nodes, allow_loop=spec.allow_loop)
+                st["seen"] = seen2
+                edges += int(trav)
+                if edges > MAX_QUERY_EDGES:
+                    raise QueryError(
+                        "recurse exceeded edge budget (ErrTooBig)")
+                m = LazyRecurseMatrix(csr, g, frontier, fresh, None,
+                                      spec.allow_loop)
+                child.uid_matrix = m
+                child.counts = LazyCounts(m)
+                child.dest_uids = np.flatnonzero(
+                    np.asarray(dest_mask)).astype(np.int64)
+            elif csr is not None and not getattr(csr, "is_dist", False):
                 if cgq.attr not in seen_masks:
                     seen_masks[cgq.attr] = np.zeros(csr.num_edges, dtype=bool)
                 matrix, total = _expand_dedup(
@@ -105,6 +267,11 @@ def recurse(ex, sg: SubGraph) -> None:
                 if edges > MAX_QUERY_EDGES:
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
+                child.uid_matrix = matrix
+                child.counts = [len(m) for m in matrix]
+                child.dest_uids = (np.unique(np.concatenate(matrix))
+                                   if any(len(m) for m in matrix)
+                                   else np.zeros(0, np.int64))
             else:
                 # tablet-routed / missing CSR: expand over the wire, dedup
                 # on (attr, from, to) keys (reference recurse.go:129-141)
@@ -123,11 +290,11 @@ def recurse(ex, sg: SubGraph) -> None:
                         seen_edges.add(ek)
                         kept.append(int(t))
                     matrix.append(np.asarray(kept, dtype=np.int64))
-            child.uid_matrix = matrix
-            child.counts = [len(m) for m in matrix]
-            child.dest_uids = (np.unique(np.concatenate(matrix))
-                               if any(len(m) for m in matrix)
-                               else np.zeros(0, np.int64))
+                child.uid_matrix = matrix
+                child.counts = [len(m) for m in matrix]
+                child.dest_uids = (np.unique(np.concatenate(matrix))
+                                   if any(len(m) for m in matrix)
+                                   else np.zeros(0, np.int64))
             child.dest_uids = ex._apply_filter(cgq.filter, child.dest_uids)
             if len(child.dest_uids):
                 child.children = build_level(child.dest_uids, remaining - 1)
@@ -136,3 +303,39 @@ def recurse(ex, sg: SubGraph) -> None:
 
     sg.children = build_level(sg.dest_uids, depth)
     ex._record_uid_var(gq, sg)
+
+
+def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
+                        allow_loop: bool) -> None:
+    """All levels in one device dispatch; SubGraph chain built from the
+    stacked per-level masks. Matches build_level's output for the
+    single-uid-child no-filter shape exactly (tests equality-gate it)."""
+    from dgraph_tpu.ops import pallas_bfs as pb
+
+    g = pb.pull_graph_for(csr)
+    seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
+    e_pad = g.in_src_pad.shape[0]
+    masks, trav, fresh = pb.recurse_fused(
+        g.in_src_pad, g.in_iptr_rank, g.subjects, g.in_subjects,
+        _seeds_mask(seeds, g.num_nodes), jnp.zeros((e_pad,), dtype=bool),
+        depth=depth, chunks=g.chunks, num_nodes=g.num_nodes,
+        allow_loop=allow_loop)
+    trav_h = np.asarray(trav)            # ONE sync for the whole traversal
+    masks_h = np.asarray(masks)
+    frontier = seeds
+    attach = sg.children = []
+    cum = 0
+    for lvl in range(depth):
+        if len(frontier) == 0:
+            break
+        cum += int(trav_h[lvl])
+        if cum > MAX_QUERY_EDGES:
+            raise QueryError("recurse exceeded edge budget (ErrTooBig)")
+        child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
+        m = LazyRecurseMatrix(csr, g, frontier, fresh, lvl, allow_loop)
+        child.uid_matrix = m
+        child.counts = LazyCounts(m)
+        child.dest_uids = np.flatnonzero(masks_h[lvl]).astype(np.int64)
+        attach.append(child)
+        attach = child.children
+        frontier = child.dest_uids
