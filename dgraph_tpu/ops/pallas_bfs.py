@@ -262,7 +262,8 @@ class PullGraph(NamedTuple):
 
 
 def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
-              indices: np.ndarray, num_nodes: int) -> PullGraph:
+              indices: np.ndarray, num_nodes: int,
+              with_inv_order: bool = False) -> PullGraph:
     """Host-side once-per-snapshot prep: transpose to dst-sorted in-edges,
     remap both endpoints to rank spaces, pad the edge stream to the kernel
     block size pointing at an always-zero bitmap word."""
@@ -320,8 +321,10 @@ def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
         np.int32)                    # every dst IS in in_subjects
     snt = np.int32(np.iinfo(np.int32).max)
     map_d2s = host_rank_of(subjects, in_subjects, snt).astype(np.int32)
-    inv_order = np.empty(E, dtype=np.int32)
-    inv_order[order] = np.arange(E, dtype=np.int32)
+    inv_order = None
+    if with_inv_order:       # recurse materialization only — int32[E] host
+        inv_order = np.empty(E, dtype=np.int32)
+        inv_order[order] = np.arange(E, dtype=np.int32)
     return PullGraph(jnp.asarray(src_pad), jnp.asarray(src_pad_d),
                      jnp.asarray(iptr),
                      jnp.asarray(subjects.astype(np.int32)),
@@ -543,9 +546,38 @@ def pull_graph_for(csr) -> PullGraph:
         hi = max(int(subjects[-1]) if len(subjects) else 0,
                  int(indices.max()) if len(indices) else 0)
         g = prep_pull(np.asarray(subjects), np.asarray(indptr),
-                      np.asarray(indices), hi + 1)
+                      np.asarray(indices), hi + 1, with_inv_order=True)
         csr._pull_graph = g
     return g
+
+
+@jax.jit
+def pack_mask_rows(masks: jax.Array) -> jax.Array:
+    """Row-wise pack_mask for a stacked [D, n] bool buffer — ONE dispatch
+    and one fetch for every level's flags."""
+    return jax.vmap(lambda m: pack_words(m, pack_chunks(masks.shape[1])))(
+        masks)
+
+
+def pack_chunks(n: int) -> int:
+    """Minimal chunk count whose word capacity covers n bits (pure packing —
+    no kernel pad-rank slot needed)."""
+    return max(1, (n + NODES_PER_CHUNK - 1) // NODES_PER_CHUNK)
+
+
+@jax.jit
+def pack_mask(mask: jax.Array) -> jax.Array:
+    """Bit-pack a bool vector for a host fetch (8x fewer relay bytes)."""
+    return pack_words(mask, pack_chunks(mask.shape[0]))
+
+
+def unpack_words(words: np.ndarray, n: int) -> np.ndarray:
+    """Host inverse of pack_words' bit-plane layout: word [p, l] bit b holds
+    node p*4096 + b*128 + l. Device→host results ride the relay bit-packed
+    (~8x fewer bytes than bool; the relay moves ~6-8 MB/s — measured r5)."""
+    w = np.asarray(words)
+    bits = (w[:, None, :] >> np.arange(32, dtype=np.int32)[None, :, None]) & 1
+    return bits.reshape(-1)[:n].astype(bool)
 
 
 def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
@@ -588,30 +620,39 @@ def recurse_step(in_src_pad, in_iptr_rank, subjects, in_subjects,
                  frontier_mask, seen, *, chunks: int, num_nodes: int,
                  allow_loop: bool):
     """Single stepped level (used when filters / multiple recurse children
-    force host control between levels)."""
-    return _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
-                          frontier_mask, seen, chunks=chunks,
-                          num_nodes=num_nodes, allow_loop=allow_loop)
+    force host control between levels). Host-bound outputs (dest mask,
+    fresh flags) come back BIT-PACKED — the relay fetch is the latency
+    floor of a single query, not the kernel."""
+    dest, trav, seen2, fresh = _recurse_level(
+        in_src_pad, in_iptr_rank, subjects, in_subjects, frontier_mask, seen,
+        chunks=chunks, num_nodes=num_nodes, allow_loop=allow_loop)
+    dest_p = pack_words(dest, pack_chunks(num_nodes))
+    return dest_p, trav, seen2, fresh
 
 
 @partial(jax.jit, static_argnames=("depth", "chunks", "num_nodes",
                                    "allow_loop"))
 def recurse_fused(in_src_pad, in_iptr_rank, subjects, in_subjects,
-                  seeds_mask, seen0, *, depth: int, chunks: int,
+                  seeds_mask, *, depth: int, chunks: int,
                   num_nodes: int, allow_loop: bool):
     """All `depth` levels in ONE dispatch (lax.scan): no host round-trip —
     and no relay sync — between levels. Returns stacked per-level
-    (dest_masks [D,N], traversed [D], fresh [D,E_pad]). Only for the
-    single-uid-child no-filter recurse shape (the common + benchmarked one);
-    anything needing host logic between levels uses recurse_step."""
+    (dest_words [D,Cn*8,128] BIT-PACKED — the host fetches these every
+    query and the relay moves ~6-8 MB/s, so packed is 8x cheaper;
+    traversed [D]; fresh [D,E_pad] bools that STAY on device until a lazy
+    uidMatrix materialization packs+fetches them). Only for the
+    single-uid-child no-filter recurse shape (the common + benchmarked
+    one); anything needing host logic between levels uses recurse_step."""
 
     def body(carry, _):
         mask, seen = carry
         dest, trav, seen2, fresh = _recurse_level(
             in_src_pad, in_iptr_rank, subjects, in_subjects, mask, seen,
             chunks=chunks, num_nodes=num_nodes, allow_loop=allow_loop)
-        return (dest, seen2), (dest, trav, fresh)
+        dest_p = pack_words(dest, pack_chunks(num_nodes))
+        return (dest, seen2), (dest_p, trav, fresh)
 
-    (_m, _s), (masks, trav, fresh) = lax.scan(
+    seen0 = jnp.zeros((in_src_pad.shape[0],), dtype=bool)  # device-side alloc
+    (_m, _s), (masks_p, trav, fresh) = lax.scan(
         body, (seeds_mask, seen0), None, length=depth)
-    return masks, trav, fresh
+    return masks_p, trav, fresh

@@ -37,16 +37,11 @@ MAX_QUERY_EDGES = 1_000_000  # reference x/init.go:53 QueryEdgeLimit
 
 def set_query_edge_limit(n: int) -> None:
     """Set the per-query traversed-edge budget (the reference's
-    --query_edge_limit server flag, x/config.go:18-24). Rebinds the
-    module-level constant here and in the traversal modules that captured
-    it by value."""
+    --query_edge_limit server flag, x/config.go:18-24). Single binding:
+    every traversal module reads engine.MAX_QUERY_EDGES through the module
+    attribute."""
     global MAX_QUERY_EDGES
     MAX_QUERY_EDGES = int(n)
-    from dgraph_tpu.query import recurse as _rec
-    from dgraph_tpu.query import shortest as _sp
-
-    _rec.MAX_QUERY_EDGES = int(n)
-    _sp.MAX_QUERY_EDGES = int(n)
 
 
 class QueryError(ValueError):

@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from dgraph_tpu.query import dql
-from dgraph_tpu.query.engine import MAX_QUERY_EDGES, QueryError, SubGraph
+from dgraph_tpu.query import engine
+from dgraph_tpu.query.engine import QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID
 
@@ -52,6 +53,31 @@ def _kernel_min() -> int:
     return 1 << 62    # interpret-mode Pallas: host path always wins
 
 
+class FreshFlags:
+    """Host cache of a traversal's per-edge fresh flags, shared by every
+    level's LazyRecurseMatrix: ONE device pack + one bit-packed fetch for
+    the whole [depth, E_pad] (or [E_pad]) buffer, however many levels the
+    encoder materializes."""
+
+    def __init__(self, fresh_dev):
+        self._dev = fresh_dev            # [E_pad] or [depth, E_pad]
+        self._h: np.ndarray | None = None
+
+    def level(self, lvl) -> np.ndarray:
+        if self._h is None:
+            from dgraph_tpu.ops import pallas_bfs as pb
+
+            d = self._dev
+            if d.ndim == 1:
+                self._h = pb.unpack_words(np.asarray(pb.pack_mask(d)),
+                                          d.shape[0])
+            else:
+                packed = np.asarray(pb.pack_mask_rows(d))
+                self._h = np.stack([pb.unpack_words(packed[i], d.shape[1])
+                                    for i in range(d.shape[0])])
+        return self._h if self._dev.ndim == 1 else self._h[lvl]
+
+
 class LazyRecurseMatrix:
     """A recurse level's uidMatrix in deferred CSR form.
 
@@ -61,12 +87,12 @@ class LazyRecurseMatrix:
     cascade, or count actually reads them (SURVEY §7: result
     materialization is inherently ragged → host-side by design)."""
 
-    def __init__(self, csr, g, frontier: np.ndarray, fresh_dev, level,
-                 allow_loop: bool):
+    def __init__(self, csr, g, frontier: np.ndarray, fresh: FreshFlags,
+                 level, allow_loop: bool):
         self._csr = csr
         self._g = g
         self._frontier = np.asarray(frontier, dtype=np.int64)
-        self._fresh_dev = fresh_dev      # [E_pad] or [depth, E_pad] stacked
+        self._fresh = fresh
         self._level = level              # row of the stacked buffer, or None
         self._allow_loop = allow_loop
         self._rows: list[np.ndarray] | None = None
@@ -74,26 +100,11 @@ class LazyRecurseMatrix:
     def _materialize(self) -> list[np.ndarray]:
         if self._rows is not None:
             return self._rows
-        from dgraph_tpu.ops import uidset as us
-
-        subjects, indptr, indices = self._csr.host_arrays()
-        rows = us.host_rank_of(subjects, self._frontier, -1)
-        ok = rows >= 0
-        rc = np.where(ok, rows, 0)
-        starts = np.where(ok, indptr[rc], 0).astype(np.int64)
-        ends = np.where(ok, indptr[rc + 1], 0).astype(np.int64)
-        counts = ends - starts
-        total = int(counts.sum())
-        offs = np.zeros(len(self._frontier) + 1, dtype=np.int64)
-        np.cumsum(counts, out=offs[1:])
-        pos = np.repeat(starts - offs[:-1], counts) + np.arange(total)
-        targets = indices[pos].astype(np.int64)
+        pos, offs, targets = _gather_frontier_edges(self._csr, self._frontier)
         if self._allow_loop:
-            keep = np.ones(total, dtype=bool)
+            keep = np.ones(len(pos), dtype=bool)
         else:
-            f = (self._fresh_dev if self._level is None
-                 else self._fresh_dev[self._level])
-            fresh_h = np.asarray(f)          # one fetch per level, cached
+            fresh_h = self._fresh.level(self._level)
             keep = fresh_h[self._g.inv_order[pos]]
         self._rows = [targets[offs[i]: offs[i + 1]][keep[offs[i]: offs[i + 1]]]
                       for i in range(len(self._frontier))]
@@ -131,11 +142,9 @@ class LazyCounts:
         return (len(r) for r in self._m._materialize())
 
 
-def _expand_dedup(csr, frontier: np.ndarray, seen: np.ndarray,
-                  allow_loop: bool) -> tuple[list[np.ndarray], int]:
-    """One level of expansion with first-traversal edge dedup, vectorized:
-    the frontier's CSR edge positions are gathered in one shot, previously
-    seen positions masked out, and the seen mask updated in place."""
+def _gather_frontier_edges(csr, frontier: np.ndarray):
+    """The frontier's CSR edge positions, gathered in one vectorized shot:
+    (pos int64[total], offs int64[F+1], targets int64[total])."""
     from dgraph_tpu.ops import uidset as us
 
     subjects, indptr, indices = csr.host_arrays()
@@ -149,15 +158,33 @@ def _expand_dedup(csr, frontier: np.ndarray, seen: np.ndarray,
     offs = np.zeros(len(frontier) + 1, dtype=np.int64)
     np.cumsum(counts, out=offs[1:])
     pos = np.repeat(starts - offs[:-1], counts) + np.arange(total)
+    return pos, offs, indices[pos].astype(np.int64)
+
+
+def _expand_dedup(csr, frontier: np.ndarray, seen: np.ndarray,
+                  allow_loop: bool) -> tuple[list[np.ndarray], int]:
+    """One level of expansion with first-traversal edge dedup, vectorized:
+    previously seen positions masked out, seen mask updated in place."""
+    pos, offs, targets = _gather_frontier_edges(csr, frontier)
+    total = len(pos)
     if allow_loop:
         fresh = np.ones(total, dtype=bool)
     else:
         fresh = ~seen[pos]
         seen[pos] = True
-    targets = indices[pos].astype(np.int64)
     matrix = [targets[offs[i]: offs[i + 1]][fresh[offs[i]: offs[i + 1]]]
               for i in range(len(frontier))]
     return matrix, total
+
+
+def _set_list_result(child: SubGraph, matrix: list[np.ndarray]) -> None:
+    """Shared tail of the list-producing branches: uidMatrix + per-source
+    counts + merged dest set."""
+    child.uid_matrix = matrix
+    child.counts = [len(m) for m in matrix]
+    child.dest_uids = (np.unique(np.concatenate(matrix))
+                       if any(len(m) for m in matrix)
+                       else np.zeros(0, np.int64))
 
 
 def _seeds_mask(uids: np.ndarray, num_nodes: int) -> jnp.ndarray:
@@ -209,7 +236,7 @@ def recurse(ex, sg: SubGraph) -> None:
     # ---- fused fast path: single uid child, no filters/val children -------
     if (len(uid_children) == 1 and not val_children
             and uid_children[0].filter is None
-            and depth <= FUSED_MAX_DEPTH):
+            and depth <= FUSED_MAX_DEPTH and len(sg.dest_uids)):
         cgq = uid_children[0]
         csr = _csr_for(cgq)
         if _use_kernel(csr):
@@ -243,41 +270,41 @@ def recurse(ex, sg: SubGraph) -> None:
                 st = _kstate(cgq.attr, csr)
                 g = st["g"]
                 fmask = _seeds_mask(frontier, g.num_nodes)
-                dest_mask, trav, seen2, fresh = pb.recurse_step(
+                dest_words, trav, seen2, fresh = pb.recurse_step(
                     g.in_src_pad, g.in_iptr_rank, g.subjects, g.in_subjects,
                     fmask, st["seen"], chunks=g.chunks,
                     num_nodes=g.num_nodes, allow_loop=spec.allow_loop)
                 st["seen"] = seen2
-                edges += int(trav)
-                if edges > MAX_QUERY_EDGES:
+                dest_words_h, trav_h = jax.device_get((dest_words, trav))
+                edges += int(trav_h)
+                if edges > engine.MAX_QUERY_EDGES:
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
-                m = LazyRecurseMatrix(csr, g, frontier, fresh, None,
-                                      spec.allow_loop)
+                m = LazyRecurseMatrix(csr, g, frontier, FreshFlags(fresh),
+                                      None, spec.allow_loop)
                 child.uid_matrix = m
                 child.counts = LazyCounts(m)
-                child.dest_uids = np.flatnonzero(
-                    np.asarray(dest_mask)).astype(np.int64)
+                child.dest_uids = np.flatnonzero(pb.unpack_words(
+                    dest_words_h, g.num_nodes)).astype(np.int64)
             elif csr is not None and not getattr(csr, "is_dist", False):
-                if cgq.attr not in seen_masks:
+                # small CSR: vectorized host-mirror gather (size-adaptive)
+                if cgq.attr not in seen_masks and len(frontier):
                     seen_masks[cgq.attr] = np.zeros(csr.num_edges, dtype=bool)
-                matrix, total = _expand_dedup(
-                    csr, frontier, seen_masks[cgq.attr], spec.allow_loop)
+                matrix, total = (_expand_dedup(
+                    csr, frontier, seen_masks.get(cgq.attr),
+                    spec.allow_loop) if len(frontier)
+                    else ([], 0))
                 edges += total
-                if edges > MAX_QUERY_EDGES:
+                if edges > engine.MAX_QUERY_EDGES:
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
-                child.uid_matrix = matrix
-                child.counts = [len(m) for m in matrix]
-                child.dest_uids = (np.unique(np.concatenate(matrix))
-                                   if any(len(m) for m in matrix)
-                                   else np.zeros(0, np.int64))
+                _set_list_result(child, matrix)
             else:
                 # tablet-routed / missing CSR: expand over the wire, dedup
                 # on (attr, from, to) keys (reference recurse.go:129-141)
                 res = ex._dispatch(TaskQuery(cgq.attr, frontier=frontier))
                 edges += res.traversed_edges
-                if edges > MAX_QUERY_EDGES:
+                if edges > engine.MAX_QUERY_EDGES:
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
                 matrix = []
@@ -290,11 +317,7 @@ def recurse(ex, sg: SubGraph) -> None:
                         seen_edges.add(ek)
                         kept.append(int(t))
                     matrix.append(np.asarray(kept, dtype=np.int64))
-                child.uid_matrix = matrix
-                child.counts = [len(m) for m in matrix]
-                child.dest_uids = (np.unique(np.concatenate(matrix))
-                                   if any(len(m) for m in matrix)
-                                   else np.zeros(0, np.int64))
+                _set_list_result(child, matrix)
             child.dest_uids = ex._apply_filter(cgq.filter, child.dest_uids)
             if len(child.dest_uids):
                 child.children = build_level(child.dest_uids, remaining - 1)
@@ -314,14 +337,15 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
 
     g = pb.pull_graph_for(csr)
     seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
-    e_pad = g.in_src_pad.shape[0]
-    masks, trav, fresh = pb.recurse_fused(
+    masks_p, trav, fresh = pb.recurse_fused(
         g.in_src_pad, g.in_iptr_rank, g.subjects, g.in_subjects,
-        _seeds_mask(seeds, g.num_nodes), jnp.zeros((e_pad,), dtype=bool),
+        _seeds_mask(seeds, g.num_nodes),
         depth=depth, chunks=g.chunks, num_nodes=g.num_nodes,
         allow_loop=allow_loop)
-    trav_h = np.asarray(trav)            # ONE sync for the whole traversal
-    masks_h = np.asarray(masks)
+    # ONE relay round-trip for the whole traversal, bit-packed (fresh flags
+    # stay on device until a lazy uidMatrix materialization needs them)
+    masks_h, trav_h = jax.device_get((masks_p, trav))
+    shared_fresh = FreshFlags(fresh)
     frontier = seeds
     attach = sg.children = []
     cum = 0
@@ -329,13 +353,14 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
         if len(frontier) == 0:
             break
         cum += int(trav_h[lvl])
-        if cum > MAX_QUERY_EDGES:
+        if cum > engine.MAX_QUERY_EDGES:
             raise QueryError("recurse exceeded edge budget (ErrTooBig)")
         child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-        m = LazyRecurseMatrix(csr, g, frontier, fresh, lvl, allow_loop)
+        m = LazyRecurseMatrix(csr, g, frontier, shared_fresh, lvl, allow_loop)
         child.uid_matrix = m
         child.counts = LazyCounts(m)
-        child.dest_uids = np.flatnonzero(masks_h[lvl]).astype(np.int64)
+        child.dest_uids = np.flatnonzero(pb.unpack_words(
+            masks_h[lvl], g.num_nodes)).astype(np.int64)
         attach.append(child)
         attach = child.children
         frontier = child.dest_uids

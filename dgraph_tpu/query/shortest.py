@@ -22,7 +22,8 @@ import heapq
 import numpy as np
 
 from dgraph_tpu.query import dql
-from dgraph_tpu.query.engine import MAX_QUERY_EDGES, QueryError, SubGraph
+from dgraph_tpu.query import engine
+from dgraph_tpu.query.engine import QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID
 
@@ -56,7 +57,7 @@ def _build_adjacency(ex, sg: SubGraph, src: int, dst: int):
                            facet_keys=[facet_key] if facet_key else [])
             res = ex._dispatch(tq)
             edges += res.traversed_edges
-            if edges > MAX_QUERY_EDGES:
+            if edges > engine.MAX_QUERY_EDGES:
                 raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
             dests = res.dest_uids
             if cgq.filter is not None:
@@ -210,7 +211,7 @@ def _k_shortest(adj, src: int, dst: int, k: int):
     out = []
     pq = [(0.0, [src], [])]
     pops = 0
-    while pq and len(out) < k and pops < MAX_QUERY_EDGES:
+    while pq and len(out) < k and pops < engine.MAX_QUERY_EDGES:
         d, path, attrs = heapq.heappop(pq)
         pops += 1
         u = path[-1]

@@ -121,17 +121,17 @@ def test_recurse_edge_dedup_reappearing_node():
 
 
 def test_recurse_budget_still_enforced():
-    from dgraph_tpu.query import recurse as recmod
+    from dgraph_tpu.query import engine as eng
 
     n = Node()
     n.alter(schema_text="follows: [uid] .")
     quads = [f"<0x{a:x}> <follows> <0x{b:x}> ."
              for a in range(1, 30) for b in range(1, 30) if a != b]
     n.mutate(set_nquads="\n".join(quads), commit_now=True)
-    old = recmod.MAX_QUERY_EDGES
-    recmod.MAX_QUERY_EDGES = 10
+    old = eng.MAX_QUERY_EDGES
+    eng.set_query_edge_limit(10)
     try:
         with pytest.raises(Exception, match="ErrTooBig|edge budget"):
             n.query('{ q(func: uid(0x1)) @recurse(depth: 10) { follows } }')
     finally:
-        recmod.MAX_QUERY_EDGES = old
+        eng.set_query_edge_limit(old)

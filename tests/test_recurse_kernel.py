@@ -92,28 +92,33 @@ def test_fused_path_taken(rng, monkeypatch):
 
 def test_kernel_edge_budget(rng):
     """The budget error must fire on the kernel path too (recurse.go:167)."""
+    from dgraph_tpu.query import engine as eng
+
     node = _graph_node(rng)
     recmod.KERNEL_MIN_EDGES = 0
-    old = recmod.MAX_QUERY_EDGES
-    recmod.MAX_QUERY_EDGES = 5
+    old = eng.MAX_QUERY_EDGES
+    eng.set_query_edge_limit(5)
     try:
         with pytest.raises(Exception, match="ErrTooBig|edge budget"):
             node.query("{ q(func: uid(0x1, 0x2)) @recurse(depth: 3) "
                        "{ follow } }")
     finally:
-        recmod.MAX_QUERY_EDGES = old
+        eng.set_query_edge_limit(old)
         recmod.KERNEL_MIN_EDGES = None
 
 
-def test_set_query_edge_limit_updates_all_modules():
+def test_set_query_edge_limit_bounds_shortest(rng):
+    """Behavioral guard for the single-binding refactor: the setter must
+    bound the shortest-path expansion too (a by-value re-import in
+    shortest.py would silently escape it)."""
     from dgraph_tpu.query import engine as eng
-    from dgraph_tpu.query import shortest as sp
 
+    node = _graph_node(rng)
     old = eng.MAX_QUERY_EDGES
-    eng.set_query_edge_limit(77)
+    eng.set_query_edge_limit(2)
     try:
-        assert eng.MAX_QUERY_EDGES == 77
-        assert recmod.MAX_QUERY_EDGES == 77
-        assert sp.MAX_QUERY_EDGES == 77
+        with pytest.raises(Exception, match="ErrTooBig|edge budget"):
+            node.query("{ p as shortest(from: 0x1, to: 0x2f, numpaths: 2) "
+                       "{ follow } r(func: uid(p)) { uid } }")
     finally:
         eng.set_query_edge_limit(old)
