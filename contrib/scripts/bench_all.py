@@ -64,28 +64,9 @@ def config1():
 
 
 def _film_node(n_people=20000, follows=12):
-    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.models.film import film_node
 
-    node = Node()
-    node.alter(schema_text="name: string @index(exact) .\n"
-                           "age: int @index(int) .\n"
-                           "genre: string @index(exact) .\n"
-                           "follows: [uid] .")
-    rng = np.random.default_rng(2)
-    quads = []
-    genres = ["drama", "comedy", "noir", "scifi"]
-    for i in range(n_people):
-        quads.append(f'<0x{i + 1:x}> <name> "p{i}" .')
-        quads.append(f'<0x{i + 1:x}> <age> "{18 + i % 60}"^^<xs:int> .')
-        quads.append(f'<0x{i + 1:x}> <genre> "{genres[i % 4]}" .')
-    src = rng.integers(1, n_people + 1, n_people * follows)
-    dst = rng.integers(1, n_people + 1, n_people * follows)
-    for s, d in zip(src.tolist(), dst.tolist()):
-        quads.append(f"<0x{s:x}> <follows> <0x{d:x}> .")
-    for lo in range(0, len(quads), 50000):
-        node.mutate(set_nquads="\n".join(quads[lo: lo + 50000]),
-                    commit_now=True)
-    return node
+    return film_node(n_people=n_people, follows=follows)
 
 
 def main():

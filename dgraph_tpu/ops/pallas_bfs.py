@@ -259,11 +259,17 @@ class PullGraph(NamedTuple):
     inv_order: np.ndarray | None = None  # HOST int32[E]: fwd edge position →
     # dst-sorted edge position (the kernel's per-edge flag space); used to
     # materialize per-source fresh-target lists lazily (recurse uidMatrix)
+    host_in_iptr: np.ndarray | None = None     # HOST int32[Nd+1]
+    host_in_src: np.ndarray | None = None      # HOST int32[E] src ranks,
+    # dst-sorted — the in-adjacency the shortest-path backtrack walks
+    host_map_s2d: np.ndarray | None = None     # HOST int32[Ns]
+    host_in_subjects: np.ndarray | None = None  # HOST int64[Nd]
+    host_subjects: np.ndarray | None = None     # HOST int64[Ns]
 
 
 def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
               indices: np.ndarray, num_nodes: int,
-              with_inv_order: bool = False) -> PullGraph:
+              with_host_arrays: bool = False) -> PullGraph:
     """Host-side once-per-snapshot prep: transpose to dst-sorted in-edges,
     remap both endpoints to rank spaces, pad the edge stream to the kernel
     block size pointing at an always-zero bitmap word."""
@@ -321,10 +327,14 @@ def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
         np.int32)                    # every dst IS in in_subjects
     snt = np.int32(np.iinfo(np.int32).max)
     map_d2s = host_rank_of(subjects, in_subjects, snt).astype(np.int32)
-    inv_order = None
-    if with_inv_order:       # recurse materialization only — int32[E] host
+    inv_order = hi_iptr = hi_src = hi_m = hi_subs = hi_fsubs = None
+    if with_host_arrays:     # engine paths only (recurse materialization +
+        # shortest backtrack); bench/BFS callers skip the host RAM
         inv_order = np.empty(E, dtype=np.int32)
         inv_order[order] = np.arange(E, dtype=np.int32)
+        hi_iptr, hi_src = iptr, src_sorted
+        hi_m, hi_subs = map_s2d, in_subjects.astype(np.int64)
+        hi_fsubs = subjects.astype(np.int64)
     return PullGraph(jnp.asarray(src_pad), jnp.asarray(src_pad_d),
                      jnp.asarray(iptr),
                      jnp.asarray(subjects.astype(np.int32)),
@@ -334,7 +344,8 @@ def prep_pull(subjects: np.ndarray, indptr: np.ndarray,
                      jnp.asarray(fwd_dst_rank),
                      jnp.asarray(map_d2s),
                      int(num_nodes), int(E), int(chunks), int(chunks_d),
-                     inv_order)
+                     inv_order, hi_iptr, hi_src, hi_m, hi_subs,
+                     hi_fsubs)
 
 
 def pack_words(mask: jax.Array, chunks: int) -> jax.Array:
@@ -546,7 +557,7 @@ def pull_graph_for(csr) -> PullGraph:
         hi = max(int(subjects[-1]) if len(subjects) else 0,
                  int(indices.max()) if len(indices) else 0)
         g = prep_pull(np.asarray(subjects), np.asarray(indptr),
-                      np.asarray(indices), hi + 1, with_inv_order=True)
+                      np.asarray(indices), hi + 1, with_host_arrays=True)
         csr._pull_graph = g
     return g
 
@@ -580,6 +591,22 @@ def unpack_words(words: np.ndarray, n: int) -> np.ndarray:
     return bits.reshape(-1)[:n].astype(bool)
 
 
+def _prefix_for(frontier_bits, stream, n_chunks: int):
+    """Active-edge inclusive prefix for one frontier (sparse search-table
+    kernel below SPARSE_MAX set bits, dense bitmap kernel above)."""
+    fcount = jnp.sum(frontier_bits, dtype=jnp.int32)
+
+    def sparse_hop(f):
+        return active_prefix_sparse(_frontier_table(f), stream)
+
+    def dense_hop(f):
+        return active_prefix(pack_words(f, n_chunks), stream,
+                             chunks=n_chunks)
+
+    return lax.cond(fcount <= SPARSE_MAX, sparse_hop, dense_hop,
+                    frontier_bits)
+
+
 def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
                    frontier_mask, seen, *, chunks: int, num_nodes: int,
                    allow_loop: bool):
@@ -589,15 +616,7 @@ def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
     every frontier node (the budget the reference charges, recurse.go:167);
     fresh marks first-traversal edges; dest = nodes with >= 1 fresh in-edge."""
     fbits = jnp.take(frontier_mask, subjects)              # [Ns] rank space
-    fcount = jnp.sum(fbits, dtype=jnp.int32)
-
-    def sparse_hop(f):
-        return active_prefix_sparse(_frontier_table(f), in_src_pad)
-
-    def dense_hop(f):
-        return active_prefix(pack_words(f, chunks), in_src_pad, chunks=chunks)
-
-    prefix = lax.cond(fcount <= SPARSE_MAX, sparse_hop, dense_hop, fbits)
+    prefix = _prefix_for(fbits, in_src_pad, chunks)
     traversed = prefix[-1]
     prev = jnp.concatenate([jnp.zeros((1,), jnp.int32), prefix[:-1]])
     active = (prefix - prev) > 0                           # bool[E_pad]
@@ -628,6 +647,118 @@ def recurse_step(in_src_pad, in_iptr_rank, subjects, in_subjects,
         chunks=chunks, num_nodes=num_nodes, allow_loop=allow_loop)
     dest_p = pack_words(dest, pack_chunks(num_nodes))
     return dest_p, trav, seen2, fresh
+
+
+_DIST_BITS = 8          # BFS distance planes (max_hops clamped below 255)
+DIST_UNREACHED = (1 << _DIST_BITS) - 1
+
+
+@partial(jax.jit, static_argnames=("chunks", "chunks_d", "max_hops"))
+def bfs_dist(in_src_pad, in_src_pad_d, in_iptr_rank, subjects, in_subjects,
+             seeds_mask, dst_rank, *, chunks: int, chunks_d: int,
+             max_hops: int):
+    """Unweighted single-source BFS distances, early-exiting when dst is
+    reached — the kernel behind `shortest` on large CSRs (replaces the
+    Bellman-Ford E-gather of ops/traversal.sssp, which runs ~1000x below
+    HBM bandwidth per PERF.md; here each hop is one Pallas E-stream).
+
+    The whole hop loop runs in ONE dispatch (lax.while_loop); per-dst-rank
+    distances return BIT-PACKED as 8 bit planes (value DIST_UNREACHED =
+    never reached), so the host fetch is ~Nd bits, not Nd ints. The host
+    walks the predecessor chain itself from the distance labels (each
+    step scans one node's in-edge slice — microseconds)."""
+    nd = in_subjects.shape[0]
+    visited0 = jnp.take(seeds_mask, in_subjects)           # [Nd]
+    dist0 = jnp.where(visited0, 0, DIST_UNREACHED).astype(jnp.int32)
+    fresh0 = jnp.zeros((nd,), dtype=bool)
+
+    def cond(c):
+        h, fresh, _visited, _dist, found = c
+        return (~found) & (h < max_hops) & ((h == 0) | fresh.any())
+
+    def body(c):
+        h, fresh, visited, dist, _found = c
+
+        def first_hop(_):
+            return _prefix_for(jnp.take(seeds_mask, subjects), in_src_pad,
+                               chunks)
+
+        def later_hop(_):
+            # a hop>=2 frontier is a subset of destinations: gather bits
+            # straight from the fresh dst-rank mask (no remap gather)
+            return _prefix_for(fresh, in_src_pad_d, chunks_d)
+
+        prefix = lax.cond(h == 0, first_hop, later_hop, None)
+        bounds = jnp.take(prefix, in_iptr_rank - 1, mode="clip")
+        bounds = jnp.where(in_iptr_rank == 0, 0, bounds)
+        reached = (bounds[1:] - bounds[:-1]) > 0
+        fresh2 = reached & ~visited
+        visited2 = visited | fresh2
+        dist2 = jnp.where(fresh2, h + 1, dist)
+        found2 = jnp.take(visited2, dst_rank)
+        return h + 1, fresh2, visited2, dist2, found2
+
+    h, _f, _v, dist, found = lax.while_loop(
+        cond, body, (jnp.int32(0), fresh0, visited0, dist0,
+                     jnp.take(visited0, dst_rank)))
+    planes = jnp.stack([
+        pack_words(((dist >> b) & 1).astype(bool), pack_chunks(nd))
+        for b in range(_DIST_BITS)])
+    return planes, found, h
+
+
+def shortest_bfs(g: PullGraph, src: int, dst: int, max_hops: int):
+    """Host orchestration: run bfs_dist, fetch packed distances once, walk
+    the predecessor chain on the host in-adjacency. Returns the uid path
+    [src..dst] or None (unreachable within max_hops). Requires a PullGraph
+    built with host arrays (pull_graph_for)."""
+    nd = len(g.host_in_subjects)
+    if nd == 0:
+        return None
+    dr = int(np.searchsorted(g.host_in_subjects, dst))
+    if dr >= nd or g.host_in_subjects[dr] != dst:
+        return None              # dst has no in-edges: unreachable
+    max_hops = min(int(max_hops), DIST_UNREACHED - 1)
+    seeds_mask = jnp.zeros((g.num_nodes,), dtype=bool)
+    if src >= g.num_nodes:
+        return None
+    seeds_mask = seeds_mask.at[src].set(True)
+    planes, found, _h = bfs_dist(
+        g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
+        g.in_subjects, seeds_mask, jnp.int32(dr), chunks=g.chunks,
+        chunks_d=g.chunks_d, max_hops=max_hops)
+    planes_h, found_h = jax.device_get((planes, found))  # ONE round-trip
+    if not bool(found_h):
+        return None
+    dist = np.zeros(nd, dtype=np.int32)
+    for b in range(_DIST_BITS):
+        dist |= unpack_words(planes_h[b], nd).astype(np.int32) << b
+
+    iptr, in_src = g.host_in_iptr, g.host_in_src
+    map_s2d = g.host_map_s2d
+    sub_uids = g.host_subjects   # uid of a src rank
+
+    path = [dst]
+    v_rank = dr
+    for d in range(int(dist[dr]), 0, -1):
+        srcs = in_src[iptr[v_rank]: iptr[v_rank + 1]]     # src RANKS
+        if d == 1:
+            # predecessor must be the seed itself
+            cand = srcs[sub_uids[srcs] == src]
+            if len(cand) == 0:
+                return None      # inconsistent labels (cannot happen)
+            path.append(src)
+            break
+        m = map_s2d[srcs]
+        ok = (m < nd)
+        ok[ok] = dist[m[ok]] == d - 1
+        cand = srcs[ok]
+        if len(cand) == 0:
+            return None          # inconsistent labels (cannot happen)
+        u_rank = int(cand[0])
+        path.append(int(sub_uids[u_rank]))
+        v_rank = int(map_s2d[u_rank])
+    return path[::-1]
 
 
 @partial(jax.jit, static_argnames=("depth", "chunks", "num_nodes",

@@ -89,6 +89,20 @@ def _build_adjacency(ex, sg: SubGraph, src: int, dst: int):
 # rationale as task.HOST_EXPAND_MAX)
 DEVICE_SSSP_MIN_EDGES = 1 << 17
 
+# above this edge count the Pallas BFS kernel (ops/pallas_bfs.bfs_dist:
+# whole hop loop in one dispatch, bit-packed distance fetch) replaces the
+# Bellman-Ford E-gather of traversal.sssp. Tests set the module global to
+# 0 to force it (interpret mode off-TPU).
+SSSP_KERNEL_MIN: int | None = None
+
+
+def _sssp_kernel_min() -> int:
+    if SSSP_KERNEL_MIN is not None:
+        return SSSP_KERNEL_MIN
+    import jax
+
+    return (1 << 20) if jax.default_backend() == "tpu" else (1 << 62)
+
 
 def _device_csr(ex, sg: SubGraph):
     """The single predicate CSR eligible for the device sssp path, or None.
@@ -118,11 +132,30 @@ def _device_csr(ex, sg: SubGraph):
 
 
 def _device_shortest(attr: str, csr, src: int, dst: int, max_depth: int):
-    """Unweighted single-source shortest path as device edge relaxation
-    (ops/traversal.sssp — Bellman-Ford SpMSpV under jit), parent chain
-    walked on host. Work is bounded by iterations x E (the resident CSR),
-    so the reference's discovered-edge budget does not apply here."""
+    """Unweighted single-source shortest path on device, parent chain
+    walked on host. Two tiers: large CSRs run the Pallas BFS kernel
+    (ops/pallas_bfs.bfs_dist — one dispatch for the whole hop loop,
+    bit-packed distance fetch); mid-size ones keep the Bellman-Ford
+    relaxation (ops/traversal.sssp). Work is bounded by iterations x E
+    (the resident CSR), so the reference's discovered-edge budget does not
+    apply here."""
     from dgraph_tpu.ops import traversal
+
+    from dgraph_tpu.ops.pallas_bfs import DIST_UNREACHED
+
+    # depth > the kernel's distance-label range keeps the sssp tier (its
+    # max_iters honors any depth); 254+ hop shortest paths are vanishingly
+    # rare but must not silently go "unreachable"
+    if csr.num_edges >= _sssp_kernel_min() and max_depth < DIST_UNREACHED:
+        from dgraph_tpu.ops import pallas_bfs as pb
+
+        g = pb.pull_graph_for(csr)
+        if src == dst:
+            return (0.0, [src], [])
+        path = pb.shortest_bfs(g, src, dst, max_depth)
+        if path is None:
+            return None
+        return (float(len(path) - 1), path, [attr] * (len(path) - 1))
 
     subjects, indptr, indices = csr.host_arrays()
     hi = max(int(subjects[-1]) if len(subjects) else 0,

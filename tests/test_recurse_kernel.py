@@ -107,6 +107,64 @@ def test_kernel_edge_budget(rng):
         recmod.KERNEL_MIN_EDGES = None
 
 
+def test_shortest_kernel_bfs_matches_host(rng, monkeypatch):
+    """Large-CSR shortest runs the Pallas bfs_dist kernel; cost must equal
+    the host Dijkstra and the path must be a real edge path."""
+    from dgraph_tpu.query import shortest as sh
+
+    node = _graph_node(rng, n=60)
+    # pick reachable pairs from the host path first
+    monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 1 << 62)  # host Dijkstra
+    pairs = []
+    for dst in range(2, 40):
+        out, _ = node.query(
+            f"{{ p as shortest(from: 0x1, to: 0x{dst:x}) {{ follow }} "
+            f"  r(func: uid(p)) {{ uid }} }}")
+        if out.get("_path_"):
+            pairs.append((dst, out["_path_"][0]["_weight_"]))
+    assert pairs, "no reachable pairs in random graph"
+
+    monkeypatch.setattr(sh, "SSSP_KERNEL_MIN", 0)
+    monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 0)
+    from dgraph_tpu.ops import pallas_bfs as pb
+
+    calls = []
+    real = pb.shortest_bfs
+    monkeypatch.setattr(pb, "shortest_bfs",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    for dst, want_cost in pairs[:6]:
+        out, _ = node.query(
+            f"{{ p as shortest(from: 0x1, to: 0x{dst:x}) {{ follow }} "
+            f"  r(func: uid(p)) {{ uid }} }}")
+        assert out["_path_"], f"kernel path missed dst 0x{dst:x}"
+        assert out["_path_"][0]["_weight_"] == want_cost
+        # validate the path is a real edge chain
+        uids = []
+        nodep = out["_path_"][0]
+        while True:
+            uids.append(int(nodep["uid"], 16))
+            nxt = nodep.get("follow")
+            if not nxt:
+                break
+            nodep = nxt[0]
+        assert uids[0] == 0x1 and uids[-1] == dst
+    assert calls, "kernel shortest_bfs was not used"
+
+
+def test_shortest_kernel_unreachable(rng, monkeypatch):
+    from dgraph_tpu.query import shortest as sh
+
+    node = Node()
+    node.alter(schema_text="follow: uid .")
+    node.mutate(set_nquads="<0x1> <follow> <0x2> .\n<0x3> <follow> <0x4> .",
+                commit_now=True)
+    monkeypatch.setattr(sh, "SSSP_KERNEL_MIN", 0)
+    monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 0)
+    out, _ = node.query("{ p as shortest(from: 0x1, to: 0x4) { follow } "
+                        "  r(func: uid(p)) { uid } }")
+    assert not out.get("_path_")
+
+
 def test_set_query_edge_limit_bounds_shortest(rng):
     """Behavioral guard for the single-binding refactor: the setter must
     bound the shortest-path expansion too (a by-value re-import in
