@@ -454,17 +454,7 @@ def _k_hop_impl(in_src_pad: jax.Array, in_src_pad_d: jax.Array,
             # dst-rank space: a hop>=2 frontier is a subset of destinations,
             # so the fresh mask IS the kernel's bitmap — no remap gather
             frontier, stream, n_chunks = fresh_d, in_src_pad_d, chunks_d
-        fcount = jnp.sum(frontier, dtype=jnp.int32)
-
-        def sparse_hop(f):
-            return active_prefix_sparse(_frontier_table(f), stream)
-
-        def dense_hop(f):
-            return active_prefix(pack_words(f, n_chunks), stream,
-                                 chunks=n_chunks)
-
-        prefix = lax.cond(fcount <= SPARSE_MAX, sparse_hop, dense_hop,
-                          frontier)
+        prefix = _prefix_for(frontier, stream, n_chunks)
         traversed = traversed + prefix[-1]
         bounds = jnp.take(prefix, in_iptr_rank - 1,
                           mode="clip")               # prefix[iptr-1], iptr>=0
@@ -653,10 +643,9 @@ _DIST_BITS = 8          # BFS distance planes (max_hops clamped below 255)
 DIST_UNREACHED = (1 << _DIST_BITS) - 1
 
 
-@partial(jax.jit, static_argnames=("chunks", "chunks_d", "max_hops"))
+@partial(jax.jit, static_argnames=("chunks", "chunks_d"))
 def bfs_dist(in_src_pad, in_src_pad_d, in_iptr_rank, subjects, in_subjects,
-             seeds_mask, dst_rank, *, chunks: int, chunks_d: int,
-             max_hops: int):
+             seeds_mask, dst_rank, max_hops, *, chunks: int, chunks_d: int):
     """Unweighted single-source BFS distances, early-exiting when dst is
     reached — the kernel behind `shortest` on large CSRs (replaces the
     Bellman-Ford E-gather of ops/traversal.sssp, which runs ~1000x below
@@ -725,8 +714,8 @@ def shortest_bfs(g: PullGraph, src: int, dst: int, max_hops: int):
     seeds_mask = seeds_mask.at[src].set(True)
     planes, found, _h = bfs_dist(
         g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
-        g.in_subjects, seeds_mask, jnp.int32(dr), chunks=g.chunks,
-        chunks_d=g.chunks_d, max_hops=max_hops)
+        g.in_subjects, seeds_mask, jnp.int32(dr), jnp.int32(max_hops),
+        chunks=g.chunks, chunks_d=g.chunks_d)
     planes_h, found_h = jax.device_get((planes, found))  # ONE round-trip
     if not bool(found_h):
         return None

@@ -173,15 +173,13 @@ class GraphQuery:
     groupby: GroupBySpec | None = None
     recurse: RecurseSpec | None = None
     shortest: ShortestSpec | None = None
-    lang: str = ""               # name@en
-    langs: list[str] = field(default_factory=list)
+    lang: str = ""               # name@en (full chain "fr:es:.")
     is_count: bool = False       # count(pred)
     is_uid_node: bool = False    # the `uid` leaf
     expand: str = ""             # expand(_all_) / expand(val)
     math: MathTree | None = None
     val_ref: str = ""            # val(x) child
     checkpwd: str = ""           # checkpwd(pwd, "<candidate>") child
-    is_internal: bool = False
 
     def all_needs(self) -> list[str]:
         """Var names this block consumes (for dependency waves)."""
@@ -830,6 +828,10 @@ class _Parser:
                 gq.expand = self.name()
                 self.expect(")")
                 gq.attr = "expand"
+                if gq.expand != "_all_":
+                    # expand(var) consumes the variable: register it so the
+                    # wave scheduler orders the defining block first
+                    gq.needs_vars.append(gq.expand)
         # language tags: name@en / name@en:fr / name@.
         if self.accept("@"):
             langs = [self.name() if self.peek().kind == "name" else self.next().text]
@@ -848,7 +850,6 @@ class _Parser:
                             "recurse", "ignorereflex"):
                 self.i -= 2 if len(langs) == 1 else 0
             else:
-                gq.langs = langs
                 # the full chain travels in .lang ("fr:es:."): the task layer
                 # walks it and the output key mirrors it (name@fr:es:.)
                 gq.lang = ":".join(langs)

@@ -315,14 +315,20 @@ class Executor:
                            else np.zeros(0, np.int64))
 
     def _effective_children(self, gq: dql.GraphQuery, frontier: np.ndarray):
-        """expand(_all_) → concrete children (reference expandSubgraph :1736)."""
+        """expand(_all_) / expand(var) → concrete children (reference
+        expandSubgraph :1736: a variable must hold predicate-name values)."""
         out = []
         for c in gq.children:
             if c.expand:
-                preds = self.schema.predicates() if c.expand == "_all_" else []
-                if c.expand not in ("_all_",):
+                if c.expand == "_all_":
+                    preds = self.schema.predicates()
+                else:
                     vv = self.vars.get(c.expand)
-                    preds = []  # expand(var) unsupported-yet: empty
+                    if vv is None or vv.is_uid:
+                        raise QueryError(
+                            f"expand({c.expand}) needs _all_ or a value "
+                            f"variable holding predicate names")
+                    preds = sorted({str(v.value) for v in vv.vals.values()})
                 for p in preds:
                     sub = dql.GraphQuery(alias=p, attr=p)
                     sub.children = list(c.children)

@@ -56,8 +56,11 @@ def encode_result(ex, sg, out: dict) -> None:
         return
     nodes: list[dict] = []
     frontier = np.sort(sg.dest_uids)
+    # @ignorereflex: a node never appears in its own subtree — an ancestor
+    # stack is threaded through preTraverse (query/query.go:371,433,541)
+    parents: list[int] | None = [] if gq.ignore_reflex else None
     for u in sg.dest_uids:
-        node = pre_traverse(sg, frontier, int(u))
+        node = pre_traverse(sg, frontier, int(u), parents)
         if node:
             nodes.append(node)
     # block-level scalars: aggregates and count(uid) become their own objects
@@ -80,9 +83,15 @@ def encode_result(ex, sg, out: dict) -> None:
         out[alias] = nodes
 
 
-def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
-    """Build the response object for one uid at one level."""
+def pre_traverse(sg, frontier: np.ndarray, uid: int,
+                 parents: list[int] | None = None) -> dict:
+    """Build the response object for one uid at one level.
+
+    parents: the @ignorereflex ancestor stack (None = directive absent) —
+    pushed here, popped before return, reflexive targets skipped below."""
     node: dict = {}
+    if parents is not None:
+        parents.append(uid)
     idx = int(np.searchsorted(frontier, uid))
     in_frontier = idx < len(frontier) and frontier[idx] == uid
     for child in sg.children:
@@ -123,12 +132,17 @@ def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
             # the reference appends it as one more list entry (query.go:472)
             for cc in child.children:
                 if cc.gq.is_uid_node and cc.gq.is_count:
-                    n_kept = sum(1 for t in targets if int(t) in kept)
+                    n_kept = sum(1 for t in targets if int(t) in kept
+                                 and not (parents is not None
+                                          and int(t) in parents))
                     objs.append({cc.gq.alias or "count": n_kept})
             for j, t in enumerate(targets):
                 if int(t) not in kept:
                     continue  # pruned by child filter/pagination
-                obj = pre_traverse(child, sub_frontier, int(t)) if child.children else {}
+                if parents is not None and int(t) in parents:
+                    continue  # @ignorereflex: already on the ancestor path
+                obj = pre_traverse(child, sub_frontier, int(t),
+                                   parents) if child.children else {}
                 if not child.children:
                     obj = {"uid": _uid_hex(t)}
                 elif not obj:
@@ -164,6 +178,8 @@ def pre_traverse(sg, frontier: np.ndarray, uid: int) -> dict:
                                 and fk not in sel:
                             continue
                         node[f"{cgq.attr}|{sel.get(fk, fk)}"] = _val_json(fv)
+    if parents is not None:
+        parents.pop()
     return node
 
 

@@ -7,12 +7,13 @@ expansion (expandOut :134-261); edge cost from a facet else 1.0 (getCost
 item; capped by QueryEdgeLimit returning ErrTooBig (:214); result
 materialized as a `_path_` block (:598).
 
-TPU shape: a single-predicate unweighted `shortest` runs FULLY ON DEVICE —
-ops/traversal.sssp iterated edge relaxation over the predicate's resident
-CSR, parent chain walked host-side afterwards (r4; replaces the reference's
-per-level expandOut + host Dijkstra for the common case). Facet-weighted
-costs, multi-predicate blocks, child filters, and k-shortest keep the exact
-host path: the expansion there is still batched CSR expands per level.
+TPU shape: a single-predicate unweighted `shortest` runs FULLY ON DEVICE,
+size-adaptively — large CSRs through the Pallas BFS kernel
+(ops/pallas_bfs.bfs_dist: the whole hop loop in one dispatch, bit-packed
+distance fetch, host predecessor walk), mid-size ones through
+ops/traversal.sssp edge relaxation (r4). Facet-weighted costs,
+multi-predicate blocks, child filters, and k-shortest keep the exact host
+path: the expansion there is still batched CSR expands per level.
 """
 
 from __future__ import annotations
@@ -150,8 +151,6 @@ def _device_shortest(attr: str, csr, src: int, dst: int, max_depth: int):
         from dgraph_tpu.ops import pallas_bfs as pb
 
         g = pb.pull_graph_for(csr)
-        if src == dst:
-            return (0.0, [src], [])
         path = pb.shortest_bfs(g, src, dst, max_depth)
         if path is None:
             return None

@@ -106,7 +106,7 @@ def test_facets_variants():
 def test_lang_tags():
     req = dql.parse("{ q(func: uid(1)) { name@en name@en:fr friend { name } } }")
     c0, c1, _ = req.queries[0].children
-    assert c0.lang == "en" and c1.langs == ["en", "fr"]
+    assert c0.lang == "en" and c1.lang == "en:fr"
 
 
 def test_math_and_aggs():
