@@ -139,9 +139,17 @@ class ZeroOps:
     protocol worker/predicate_move.go:86-177)."""
 
     def __init__(self, svc: ZeroService) -> None:
+        import os
+
+        from ..parallel.remote import MOVE_CHUNK_BYTES
+
         self.svc = svc
         self.zero = svc.zero
         self._move_lock = threading.Lock()
+        # env override so systests can force many small chunks through the
+        # real wire path
+        self.chunk_bytes = int(os.environ.get("DGRAPH_TPU_MOVE_CHUNK",
+                                              MOVE_CHUNK_BYTES))
 
     def _leader_of(self, group: int):
         from ..parallel.remote import RemoteWorker
@@ -211,11 +219,28 @@ class ZeroOps:
                 move_st = self.zero.oracle.new_txn()
                 keys_b64 = []
                 try:
-                    resp = src.predicate_data(attr, read_ts,
-                                              move_st.start_ts)
-                    keys_b64 = [base64.b64encode(bytes(k)).decode()
-                                for k in resp.keys]
-                    dst.ingest_records(list(resp.records))
+                    # chunked stream: <=MOVE_CHUNK_BYTES per message
+                    # (reference predicate_move.go:187), resumable cursor,
+                    # count handshake before the map flips (:171-176)
+                    sent = ingested = 0
+                    cursor = b""
+                    while True:
+                        resp = src.predicate_data(
+                            attr, read_ts, move_st.start_ts, after=cursor,
+                            max_bytes=self.chunk_bytes)
+                        keys_b64.extend(base64.b64encode(bytes(k)).decode()
+                                        for k in resp.keys)
+                        sent += len(resp.records)
+                        if resp.records:
+                            ingested += dst.ingest_records(
+                                list(resp.records))
+                        if resp.done:
+                            break
+                        cursor = bytes(resp.next)
+                    if ingested != sent:
+                        raise MoveError(
+                            f"move count handshake failed: sent {sent} "
+                            f"records, destination ingested {ingested}")
                     commit_ts = self.zero.oracle.commit(move_st.start_ts)
                     crec = json.dumps(
                         {"t": "c", "s": move_st.start_ts, "ts": commit_ts,
@@ -241,7 +266,7 @@ class ZeroOps:
                     raise
                 self.zero.move_tablet(attr, dst_group)
                 src.delete_predicate(attr)
-                return {"moved_records": len(resp.records),
+                return {"moved_records": sent,
                         "aborted_txns": aborted, "tablet": attr,
                         "src": src_group, "dst": dst_group}
             finally:

@@ -31,12 +31,15 @@ from ..storage.store import _val_from_json, _val_to_json
 
 SERVICE = "dgraph_tpu.internal.Worker"
 
-# tablet payloads (predicate moves, snapshot streams) far exceed gRPC's 4 MB
-# default. The reference uses 4 GB (x/x.go:56 GrpcMaxSize); protobuf itself
-# caps a message at 2 GB, so 1 GiB is the practical single-message bound
-# here — tablets beyond it need the move chunked, not a bigger cap.
+# tablet payloads (snapshot streams) far exceed gRPC's 4 MB default. The
+# reference uses 4 GB (x/x.go:56 GrpcMaxSize); predicate moves chunk at
+# MOVE_CHUNK_BYTES so no single message approaches this cap.
 GRPC_OPTIONS = [("grpc.max_send_message_length", 1 << 30),
                 ("grpc.max_receive_message_length", 1 << 30)]
+
+# per-chunk budget for predicate moves (reference: <=32MB Raft-proposal
+# batches, worker/predicate_move.go:187)
+MOVE_CHUNK_BYTES = 32 << 20
 
 
 def _uids_to_bytes(a) -> bytes:
@@ -586,44 +589,87 @@ class WorkerService:
 
     def predicate_data(self, msg: ipb.PredicateDataRequest,
                        context) -> ipb.PredicateDataResponse:
-        """Source side: stream every key of the predicate at read_ts as WAL
-        'm' records under the move txn (movePredicateHelper :86-177)."""
+        """Source side: stream the predicate's keys at read_ts as WAL 'm'
+        records under the move txn, in resumable <=max_bytes chunks
+        (movePredicateHelper :86-177; the reference batches <=32MB per Raft
+        proposal, predicate_move.go:187). Cursor = 1 kind byte + key bytes
+        of the last key sent; the snapshot read_ts makes every chunk read
+        from the same immutable cut, so resumption is exact."""
         from ..storage import keys as K
         from ..storage.store import encode_record
 
+        import bisect
+
+        budget = int(msg.max_bytes) or MOVE_CHUNK_BYTES
+        kinds = (K.KeyKind.DATA, K.KeyKind.REVERSE,
+                 K.KeyKind.INDEX, K.KeyKind.COUNT)
+        # sorted key list cached per (attr, read_ts): writes are blocked for
+        # the whole move, so the set is stable; without this, each chunk's
+        # rescan would make a C-chunk move O(C * K log K)
+        ck = (msg.attr, int(msg.read_ts))
+        cached = getattr(self, "_move_keys_cache", None)
+        if cached is None or cached[0] != ck:
+            per_kind = [sorted(self.store.keys_of(kind, msg.attr))
+                        for kind in kinds]
+            self._move_keys_cache = cached = (ck, per_kind)
+        per_kind = cached[1]
+        resume_kind, resume_key = -1, b""
+        if msg.after:
+            resume_kind, resume_key = msg.after[0], bytes(msg.after[1:])
         records, keys = [], []
-        for kind in (K.KeyKind.DATA, K.KeyKind.REVERSE,
-                     K.KeyKind.INDEX, K.KeyKind.COUNT):
-            for kb in self.store.keys_of(kind, msg.attr):
+        sent = 0
+        last_kind, last_key = resume_kind, resume_key
+        more = False
+        for ki in range(max(resume_kind, 0), len(kinds)):
+            klist = per_kind[ki]
+            start = bisect.bisect_right(klist, resume_key) \
+                if ki == resume_kind else 0
+            for kb in klist[start:]:
+                if sent >= budget:
+                    more = True
+                    break
                 pl = self.store.lists.get(kb)
                 if pl is None:
                     continue
                 for p in pl.postings(msg.read_ts):
-                    records.append(encode_record(
-                        {"t": "m", "s": int(msg.start_ts), "k": kb, "p": p}))
+                    rec = encode_record(
+                        {"t": "m", "s": int(msg.start_ts), "k": kb, "p": p})
+                    records.append(rec)
+                    sent += len(rec)
                 keys.append(kb)
-        entry = self.store.schema.get(msg.attr)
-        if entry is not None:
-            records.append(json.dumps({"t": "s", "line": str(entry)},
-                                      separators=(",", ":")).encode())
-        return ipb.PredicateDataResponse(records=records, keys=keys)
+                last_kind, last_key = ki, kb
+            if more:
+                break
+        if not more:
+            entry = self.store.schema.get(msg.attr)
+            if entry is not None:
+                records.append(json.dumps({"t": "s", "line": str(entry)},
+                                          separators=(",", ":")).encode())
+            next_cursor = b""
+        else:
+            next_cursor = bytes([max(last_kind, 0)]) + last_key
+        return ipb.PredicateDataResponse(records=records, keys=keys,
+                                         next=next_cursor, done=not more)
 
     def ingest_records(self, msg: ipb.IngestRequest,
                        context) -> ipb.IngestResponse:
         """Destination side (ReceivePredicate): records flow through the
-        WAL path, so a replicated leader ships them to its own quorum."""
+        WAL path, so a replicated leader ships them to its own quorum.
+        Returns the applied count (the move's count handshake)."""
         if self.term > 0 and not self.is_leader:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           f"not leader (term {self.term})")
         structural = False
+        n = 0
         for data in msg.records:
             rec = decode_record(bytes(data))
             structural |= rec.get("t") in STRUCTURAL_RECORDS
             self.store.ingest_record(rec)
+            n += 1
         if structural:
             with self._lock:
                 self._assembler.invalidate()
-        return ipb.IngestResponse()
+        return ipb.IngestResponse(ingested=n)
 
     def delete_predicate(self, msg: ipb.DeletePredicateRequest,
                          context) -> ipb.DeletePredicateResponse:
@@ -779,13 +825,16 @@ class RemoteWorker:
             self._schema(ipb.SchemaRequest(preds=list(preds))).schema_json)
         return "\n".join(lines)
 
-    def predicate_data(self, attr: str, read_ts: int,
-                       start_ts: int) -> "ipb.PredicateDataResponse":
+    def predicate_data(self, attr: str, read_ts: int, start_ts: int,
+                       after: bytes = b"", max_bytes: int = 0,
+                       ) -> "ipb.PredicateDataResponse":
         return self._predicate_data(ipb.PredicateDataRequest(
-            attr=attr, read_ts=read_ts, start_ts=start_ts))
+            attr=attr, read_ts=read_ts, start_ts=start_ts, after=after,
+            max_bytes=max_bytes))
 
-    def ingest_records(self, records) -> None:
-        self._ingest(ipb.IngestRequest(records=list(records)))
+    def ingest_records(self, records) -> int:
+        return int(self._ingest(
+            ipb.IngestRequest(records=list(records))).ingested)
 
     def delete_predicate(self, attr: str) -> None:
         self._delete_pred(ipb.DeletePredicateRequest(attr=attr))

@@ -506,3 +506,52 @@ def test_in_memory_leader_buffer_never_evicts():
     rw.close()
     for s in servers:
         s.stop(0)
+
+
+def test_chunked_predicate_data_stream():
+    """Predicate moves stream in <=max_bytes chunks with a resumable cursor
+    (reference predicate_move.go:187 <=32MB batches) and the destination
+    returns applied counts (the :171-176 count handshake)."""
+    src = _mk_store("name: string @index(exact, term) .",
+                    "\n".join(f'<0x{i:x}> <name> "person{i}" .'
+                              for i in range(1, 60)))
+    server, port = serve_worker(src, "localhost:0")
+    rw = RemoteWorker(f"localhost:{port}")
+    try:
+        full = rw.predicate_data("name", read_ts=10, start_ts=100)
+        assert full.done and not full.next
+        assert len(full.records) > 60        # data + index rows + schema
+
+        records, keys, chunks = [], [], 0
+        cursor = b""
+        while True:
+            resp = rw.predicate_data("name", 10, 100, after=cursor,
+                                     max_bytes=256)
+            records.extend(bytes(r) for r in resp.records)
+            keys.extend(bytes(k) for k in resp.keys)
+            chunks += 1
+            if resp.done:
+                assert not resp.next
+                break
+            assert resp.next
+            cursor = bytes(resp.next)
+        assert chunks > 3, "chunking did not engage"
+        assert records == [bytes(r) for r in full.records]
+        assert keys == [bytes(k) for k in full.keys]
+
+        # count handshake: destination reports exactly what it applied
+        dst = _mk_store("name: string @index(exact, term) .",
+                        '<0x1> <name> "seed" .')
+        server2, port2 = serve_worker(dst, "localhost:0")
+        rw2 = RemoteWorker(f"localhost:{port2}")
+        try:
+            ingested = 0
+            for lo in range(0, len(records), 7):
+                ingested += rw2.ingest_records(records[lo: lo + 7])
+            assert ingested == len(records)
+        finally:
+            rw2.close()
+            server2.stop(0)
+    finally:
+        rw.close()
+        server.stop(0)

@@ -246,6 +246,8 @@ def test_process_move_tablet_and_rebalance(tmp_path, procs):
     env = dict(_os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONUNBUFFERED"] = "1"
+    # force MANY small chunks through the wire move (predicate_move.go:187)
+    env["DGRAPH_TPU_MOVE_CHUNK"] = "256"
     p = _sp.Popen([_sys.executable, "-m", "dgraph_tpu"] + env_extra,
                   stdout=_sp.PIPE, stderr=_sp.STDOUT, text=True, env=env,
                   cwd="/root/repo")
