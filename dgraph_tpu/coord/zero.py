@@ -406,6 +406,9 @@ class Zero:
         return {
             "maxTxnTs": self.oracle.max_assigned,
             "maxLeaseId": self.uids.max_leased,
+            # per-tablet last commit ts: the replica-read floor hedged
+            # reads carry (TaskRequest.min_applied)
+            "predCommit": dict(self.oracle.pred_commit),
             "groups": {str(g): {"tablets": sorted(
                 a for a, gg in self.tablets().items() if gg == g)}
                 for g in range(self.n_groups)},

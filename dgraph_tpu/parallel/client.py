@@ -78,40 +78,35 @@ class ClusterClient:
     def __init__(self, zero_addr: str,
                  groups: dict[int, list[str]]) -> None:
         """groups: group id -> replica worker addresses (leader discovered
-        via Status polling, re-discovered on failover)."""
+        via Status polling, re-discovered on failover). Each group is a
+        HedgedReplicas set: reads hedge to a second replica after a grace
+        period, a background echo loop feeds routing (worker/task.go:75,
+        conn/pool.go:153)."""
+        from .remote import HedgedReplicas
+
         self.zero = _CachedZero(ZeroClient(zero_addr))
-        self.groups = {g: [RemoteWorker(a) for a in addrs]
-                       for g, addrs in groups.items()}
+        self.replicas = {g: HedgedReplicas(addrs)
+                         for g, addrs in groups.items()}
+        self.groups = {g: hr.workers for g, hr in self.replicas.items()}
         self._leases = _LeaseAdapter(self.zero)
-        self._leaders: dict[int, tuple[float, RemoteWorker]] = {}
         self._schema: tuple[float, SchemaState] | None = None
 
     def _invalidate(self) -> None:
-        self._leaders.clear()
+        for hr in self.replicas.values():
+            hr.mark_stale()       # force leader re-discovery
         self._schema = None
         self.zero.invalidate()
 
     # -- leadership ----------------------------------------------------------
 
     def leader_of(self, g: int) -> RemoteWorker:
-        """Current leader of a group: the replica reporting leader=True
-        (single-replica groups lead themselves at term 0). Cached briefly —
-        the mutate retry path invalidates on failure."""
-        replicas = self.groups[g]
-        if len(replicas) == 1:
-            return replicas[0]
-        now = time.monotonic()
-        hit = self._leaders.get(g)
-        if hit is not None and now - hit[0] <= self.CACHE_TTL:
-            return hit[1]
-        for rw in replicas:
-            try:
-                if rw.status().leader:
-                    self._leaders[g] = (now, rw)
-                    return rw
-            except Exception:
-                continue
-        raise RuntimeError(f"group {g} has no live leader")
+        """Current leader of a group — delegated to the HedgedReplicas
+        echo state (one discovery mechanism; the mutate retry path calls
+        _invalidate to force a re-poll)."""
+        try:
+            return self.replicas[g].leader_worker()
+        except RuntimeError:
+            raise RuntimeError(f"group {g} has no live leader")
 
     # -- schema --------------------------------------------------------------
 
@@ -224,19 +219,21 @@ class ClusterClient:
             from ..utils.schema import schema_json
 
             return {"schema": schema_json(schema, parsed.schema_request)}
-        read_ts = int(self.zero.state().get("maxTxnTs", 0))
+        zstate = self.zero.state()
+        read_ts = int(zstate.get("maxTxnTs", 0))
+        floors = {k: int(v)
+                  for k, v in zstate.get("predCommit", {}).items()}
         dispatcher = NetworkDispatcher(
             self.zero, local_group=-1,
             local_snap_fn=lambda ts: GraphSnapshot(ts),
-            remotes={g: self.leader_of(g) for g in self.groups},
-            schema=schema)
+            remotes=dict(self.replicas),
+            schema=schema, pred_floors=floors)
         snap = GraphSnapshot(read_ts)
         ex = Executor(snap, schema,
                       dispatch=lambda tq: dispatcher.process_task(tq, read_ts))
         return ex.execute(parsed)
 
     def close(self) -> None:
-        for rws in self.groups.values():
-            for rw in rws:
-                rw.close()
+        for hr in self.replicas.values():
+            hr.close()
         self.zero.close()

@@ -13,6 +13,8 @@ per-element parse); typed values/facets as the store's JSON value encoding.
 from __future__ import annotations
 
 import json
+import threading
+import time
 from concurrent import futures
 
 import numpy as np
@@ -102,14 +104,15 @@ def decode_result(msg: ipb.TaskResponse) -> TaskResult:
     return res
 
 
-def encode_task(q: TaskQuery, read_ts: int) -> ipb.TaskRequest:
+def encode_task(q: TaskQuery, read_ts: int,
+                min_applied: int = 0) -> ipb.TaskRequest:
     return ipb.TaskRequest(
         attr=q.attr, has_frontier=q.frontier is not None,
         frontier=_uids_to_bytes(q.frontier) if q.frontier is not None else b"",
         func_name=q.func[0] if q.func else "",
         func_args_json=json.dumps(q.func[1]) if q.func else "",
         lang=q.lang, facet_keys=list(q.facet_keys), first=q.first,
-        reverse=q.reverse, read_ts=read_ts)
+        reverse=q.reverse, read_ts=read_ts, min_applied=min_applied)
 
 
 def decode_task(msg: ipb.TaskRequest) -> tuple[TaskQuery, int]:
@@ -180,6 +183,9 @@ class WorkerService:
         self.store = store
         self._assembler = SnapshotAssembler(store)
         self._lock = threading.Lock()
+        # replica-read gate concurrency cap (see serve_task convoy guard)
+        self._gate_slots = threading.BoundedSemaphore(2)
+        self._move_keys_cache = None
         # replication role. _rlock guards follower-side state ONLY; the
         # leader-side _ship path deliberately takes no service lock (it runs
         # under the store lock — taking _rlock there would ABBA-deadlock
@@ -219,8 +225,35 @@ class WorkerService:
         with self._lock:
             return self._assembler.snapshot(read_ts)
 
+    # replica-read gate: how long a follower waits for its applied
+    # per-tablet watermark to reach the task's min_applied before telling
+    # the caller to go elsewhere (WaitForMinProposal analog)
+    APPLIED_WAIT = 2.0
+
     def serve_task(self, msg: ipb.TaskRequest, context) -> ipb.TaskResponse:
         q, read_ts = decode_task(msg)
+        if msg.min_applied:
+            attr = q.attr[1:] if q.attr.startswith("~") else q.attr
+            if self.store.pred_commit_ts.get(attr, 0) < msg.min_applied:
+                # bounded waiters: gated reads must not occupy the whole
+                # server pool and starve the Append/Decide RPCs that would
+                # advance the watermark (convoy guard)
+                if not self._gate_slots.acquire(blocking=False):
+                    context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  f"replica busy catching up on {attr!r}")
+                try:
+                    deadline = time.monotonic() + self.APPLIED_WAIT
+                    while self.store.pred_commit_ts.get(attr, 0) \
+                            < msg.min_applied:
+                        if time.monotonic() >= deadline:
+                            context.abort(
+                                grpc.StatusCode.FAILED_PRECONDITION,
+                                f"replica behind on {attr!r}: applied "
+                                f"{self.store.pred_commit_ts.get(attr, 0)}"
+                                f" < {msg.min_applied}")
+                        time.sleep(0.01)
+                finally:
+                    self._gate_slots.release()
         res = process_task(self._snapshot(read_ts), q, self.store.schema)
         return encode_result(res)
 
@@ -646,6 +679,7 @@ class WorkerService:
                 records.append(json.dumps({"t": "s", "line": str(entry)},
                                           separators=(",", ":")).encode())
             next_cursor = b""
+            self._move_keys_cache = None   # release the sorted key lists
         else:
             next_cursor = bytes([max(last_kind, 0)]) + last_key
         return ipb.PredicateDataResponse(records=records, keys=keys,
@@ -839,8 +873,10 @@ class RemoteWorker:
     def delete_predicate(self, attr: str) -> None:
         self._delete_pred(ipb.DeletePredicateRequest(attr=attr))
 
-    def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
-        return decode_result(self._serve(encode_task(q, read_ts)))
+    def process_task(self, q: TaskQuery, read_ts: int,
+                     min_applied: int = 0) -> TaskResult:
+        return decode_result(self._serve(
+            encode_task(q, read_ts, min_applied)))
 
     def membership(self) -> ipb.MembershipResponse:
         return self._membership(ipb.MembershipRequest())
@@ -857,17 +893,185 @@ class RemoteWorker:
         self.channel.close()
 
 
+class HedgedReplicas:
+    """One group's replica set with tail-latency hedging + health echo.
+
+    Reference: worker/task.go:75-132 processWithBackupRequest — a read RPC
+    goes to one replica and, after a grace period, is hedged to a second
+    (Jeff-Dean-style backup requests); conn/pool.go:153-186 runs a
+    background Echo loop per connection feeding routing. Here Status is the
+    echo; the loop marks replicas healthy/unhealthy and remembers which one
+    leads. Reads prefer the leader but fail over / hedge to any healthy
+    replica; staleness is prevented by the min_applied gate in serve_task
+    (the follower waits for its applied watermark or refuses)."""
+
+    HEDGE_GRACE = 0.3        # seconds before the backup request fires
+    HEALTH_INTERVAL = 2.0    # echo loop period
+
+    def __init__(self, addrs: list[str]) -> None:
+        self.addrs = list(addrs)
+        self.workers = [RemoteWorker(a) for a in addrs]
+        self._ok = [True] * len(addrs)
+        self._leader_idx = 0
+        self._leader_confirmed = False
+        self._pool = futures.ThreadPoolExecutor(
+            max_workers=max(2, 2 * len(addrs)))
+        self._stop = threading.Event()
+        self._thread = None
+        if len(addrs) > 1:
+            self._poll_once()    # routing is correct from the first read
+            self._thread = threading.Thread(target=self._echo_loop,
+                                            daemon=True)
+            self._thread.start()
+
+    # -- health echo ---------------------------------------------------------
+
+    def _poll_once(self) -> None:
+        saw_leader = False
+        for i, rw in enumerate(self.workers):
+            try:
+                st = rw.status(timeout=1.0)
+                self._ok[i] = True
+                if st.leader:
+                    self._leader_idx = i
+                    saw_leader = True
+            except Exception:
+                self._ok[i] = False
+        self._leader_confirmed = saw_leader
+
+    def _echo_loop(self) -> None:
+        while not self._stop.wait(self.HEALTH_INTERVAL):
+            self._poll_once()
+
+    def mark_stale(self) -> None:
+        """Force the next leader_worker() to re-discover (mutate-retry
+        invalidation)."""
+        self._leader_confirmed = False
+
+    def leader_worker(self) -> "RemoteWorker":
+        """The group's current leader (single-replica groups lead
+        themselves). Re-polls when unconfirmed; raises when no live replica
+        claims leadership."""
+        if len(self.workers) == 1:
+            return self.workers[0]
+        if not (self._leader_confirmed and self._ok[self._leader_idx]):
+            self._poll_once()
+        if self._leader_confirmed:
+            return self.workers[self._leader_idx]
+        raise RuntimeError("group has no live leader")
+
+    # -- routing -------------------------------------------------------------
+
+    def _order(self) -> list[int]:
+        """Primary first (leader if healthy, else first healthy), then the
+        healthy rest, then unhealthy as a last resort."""
+        n = len(self.workers)
+        healthy = [i for i in range(n) if self._ok[i]]
+        if not healthy:
+            healthy = list(range(n))
+        if self._leader_idx in healthy:
+            order = [self._leader_idx] + \
+                [i for i in healthy if i != self._leader_idx]
+        else:
+            order = healthy
+        order += [i for i in range(n) if i not in order]
+        return order
+
+    @staticmethod
+    def _is_behind(e: Exception) -> bool:
+        return (isinstance(e, grpc.RpcError)
+                and e.code() == grpc.StatusCode.FAILED_PRECONDITION)
+
+    def _leader_only(self, q, read_ts: int) -> TaskResult:
+        try:
+            rw = self.leader_worker()
+        except RuntimeError:
+            rw = self.workers[self._order()[0]]
+        return rw.process_task(q, read_ts, 0)
+
+    def process_task(self, q: TaskQuery, read_ts: int,
+                     min_applied: int = 0) -> TaskResult:
+        order = self._order()
+        if len(order) == 1:
+            return self.workers[order[0]].process_task(q, read_ts,
+                                                       min_applied)
+        if min_applied <= 0:
+            # no commit floor known for this tablet (cold cluster / Zero
+            # restart): only the leader is guaranteed current, so don't
+            # hedge to followers — same routing as the pre-hedging client
+            return self._leader_only(q, read_ts)
+        errs: list[Exception] = []
+        res = self._hedged_pair(q, read_ts, min_applied, order, errs)
+        if res is not None:
+            return res
+        for idx in order[2:]:    # remaining replicas, sequentially
+            try:
+                return self.workers[idx].process_task(q, read_ts,
+                                                      min_applied)
+            except Exception as e:
+                errs.append(e)
+        if errs and all(self._is_behind(e) for e in errs):
+            # every replica is behind the floor: the commit's Decide
+            # fan-out was lost (client died between Zero commit and
+            # Decide). The undelivered decision is invisible by the
+            # reference's semantics — serve the leader's best state
+            # instead of wedging reads until the next write heals it.
+            return self._leader_only(q, read_ts)
+        raise errs[-1]
+
+    def _hedged_pair(self, q, read_ts, min_applied, order,
+                     errs) -> TaskResult | None:
+        f1 = self._pool.submit(self.workers[order[0]].process_task, q,
+                               read_ts, min_applied)
+        try:
+            return f1.result(timeout=self.HEDGE_GRACE)
+        except futures.TimeoutError:
+            pending = {f1}       # slow primary: fire the backup request
+        except Exception as e:
+            errs.append(e)
+            pending = set()
+        pending.add(self._pool.submit(self.workers[order[1]].process_task,
+                                      q, read_ts, min_applied))
+        while pending:
+            done, pending = futures.wait(
+                pending, return_when=futures.FIRST_COMPLETED)
+            for f in done:
+                try:
+                    return f.result()
+                except Exception as e:
+                    errs.append(e)
+        return None
+
+    def sort(self, *a, **kw):
+        return self.workers[self._order()[0]].sort(*a, **kw)
+
+    def schema(self, preds=()):
+        return self.workers[self._order()[0]].schema(preds)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3.0)
+        self._pool.shutdown(wait=False)
+        for rw in self.workers:
+            rw.close()
+
+
 class NetworkDispatcher:
     """ProcessTaskOverNetwork: route each task by its tablet's owner —
     local group short-circuits, remote groups go over the wire."""
 
     def __init__(self, zero, local_group: int, local_snap_fn,
-                 remotes: dict[int, RemoteWorker], schema) -> None:
+                 remotes: dict[int, RemoteWorker], schema,
+                 pred_floors: dict[str, int] | None = None) -> None:
         self.zero = zero
         self.local_group = local_group
         self.local_snap_fn = local_snap_fn     # read_ts -> GraphSnapshot
-        self.remotes = remotes
+        self.remotes = remotes                 # RemoteWorker or HedgedReplicas
         self.schema = schema
+        # per-tablet commit floors (Zero oracle): hedged replica reads wait
+        # for (or refuse below) this applied watermark
+        self.pred_floors = pred_floors or {}
 
     def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
         attr = q.attr[1:] if q.attr.startswith("~") else q.attr
@@ -882,7 +1086,8 @@ class NetworkDispatcher:
             # data that exists — surface the unreachable group instead
             raise RuntimeError(
                 f"no connection to group {group} serving {attr!r}")
-        return rw.process_task(q, read_ts)
+        return rw.process_task(q, read_ts,
+                               min_applied=self.pred_floors.get(attr, 0))
 
     def sort_over_network(self, attr: str, uids, desc: bool, lang: str,
                           read_ts: int, need: int = 0):
