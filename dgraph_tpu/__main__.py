@@ -229,7 +229,7 @@ def _apply_env_defaults(sp: argparse.ArgumentParser) -> None:
         action.required = False
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dgraph_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -320,7 +320,11 @@ def main(argv=None) -> int:
 
     for sp_ in (sp, bp, ep, lp, cp, wp, zp):
         _apply_env_defaults(sp_)
-    args = p.parse_args(argv)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
     return args.fn(args)
 
 

@@ -1,0 +1,52 @@
+"""Kubernetes manifests stay structurally valid and consistent with the
+CLI surface (reference contrib/config/kubernetes)."""
+
+import yaml
+
+
+def _docs():
+    with open("contrib/config/kubernetes/dgraph-tpu.yaml") as f:
+        return list(yaml.safe_load_all(f))
+
+
+def test_manifest_topology():
+    docs = _docs()
+    kinds = {(d["kind"], d["metadata"]["name"]) for d in docs}
+    assert ("Service", "dgraph-tpu-zero") in kinds
+    assert ("StatefulSet", "dgraph-tpu-zero") in kinds
+    assert ("StatefulSet", "dgraph-tpu-g0") in kinds
+    assert ("StatefulSet", "dgraph-tpu-g1") in kinds
+    groups = [d for d in docs if d["kind"] == "StatefulSet"
+              and d["metadata"]["name"].startswith("dgraph-tpu-g")]
+    assert all(d["spec"]["replicas"] == 3 for d in groups)
+
+
+def test_selectors_match_template_labels():
+    for d in _docs():
+        if d["kind"] != "StatefulSet":
+            continue
+        sel = d["spec"]["selector"]["matchLabels"]
+        tmpl = d["spec"]["template"]["metadata"]["labels"]
+        assert all(tmpl.get(k) == v for k, v in sel.items())
+
+
+def test_args_are_real_cli_flags():
+    """Every --flag in the manifests must exist in the argparse surface."""
+    import argparse
+
+    from dgraph_tpu.__main__ import build_parser
+
+    parser = build_parser()
+    subs = next(a for a in parser._actions
+                if isinstance(a, argparse._SubParsersAction))
+    known = {}
+    for name, sp in subs.choices.items():
+        known[name] = {opt for a in sp._actions for opt in a.option_strings}
+    for d in _docs():
+        if d["kind"] != "StatefulSet":
+            continue
+        for c in d["spec"]["template"]["spec"]["containers"]:
+            cmd = c["args"][0]
+            flags = [a for a in c["args"] if a.startswith("--")]
+            for fl in flags:
+                assert fl in known[cmd], f"{cmd} has no flag {fl}"
