@@ -23,6 +23,9 @@ from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID, Val
 
 
+VECTORIZE = True    # tests flip to force the per-uid reference path
+
+
 def process_groupby(ex, sg) -> None:
     """Fill sg.group_result for a level with @groupby."""
     gq = sg.gq
@@ -41,6 +44,20 @@ def process_groupby(ex, sg) -> None:
         sg.group_result = _assemble_rows(
             ex, gq, [{alias: kv} for kv in keys_sorted], members_per)
         return
+
+    # vectorized GENERAL path (r5): every column — string/bool/datetime
+    # value keys and multi-valued uid keys alike — factorizes to dense int
+    # codes (one cached pass per predicate per snapshot), multi-key groups
+    # are a vectorized cartesian join of the code columns (mixed-radix
+    # packed), and members come from one argsort. Per-uid Python only
+    # remains for lang-tagged keys, [list] scalar keys, and remote value
+    # tablets (the dict fallback below).
+    if VECTORIZE:
+        vec = _vectorized_groups(ex, gq, uids)
+        if vec is not None:
+            row_seeds, members_per = vec
+            sg.group_result = _assemble_rows(ex, gq, row_seeds, members_per)
+            return
 
     # group keys per uid, one column per groupby attr
     columns: list[tuple[str, dict[int, Any]]] = []  # (alias, uid -> key val)
@@ -94,6 +111,165 @@ def process_groupby(ex, sg) -> None:
             row[alias] = kv if not isinstance(kv, tuple) else kv[1]
         seeds.append(row)
     sg.group_result = _assemble_rows(ex, gq, seeds, members_per)
+
+
+def _pred_value_codes(pd):
+    """Factorize a predicate's stored (untagged, non-list) values to dense
+    codes — ONCE per immutable snapshot, cached on the PredData. Returns
+    (value_subjects int64[N], codes int64[N], displays list, ok bool[N])
+    where ok=False marks lang-only subjects (no untagged value). Group
+    identity is the display (_val_json) value, exactly like _group_key."""
+    got = getattr(pd, "_gb_codes", None)
+    if got is not None:
+        return got
+    if pd.value_subjects_host is None:
+        return None
+    from dgraph_tpu.query.outputnode import _val_json
+
+    vsub = pd.value_subjects_host
+    code_of: dict = {}
+    displays: list = []
+    codes = np.zeros(len(vsub), dtype=np.int64)
+    ok = np.ones(len(vsub), dtype=bool)
+    for i, u in enumerate(vsub.tolist()):
+        v = pd.host_values.get(int(u))
+        if v is None:
+            ok[i] = False
+            continue
+        j = _val_json(v)
+        k = j if isinstance(j, (str, int, float, bool)) else repr(j)
+        c = code_of.get(k)
+        if c is None:
+            c = code_of[k] = len(displays)
+            displays.append(j)
+        codes[i] = c
+    pd._gb_codes = (vsub, codes, displays, ok)
+    return pd._gb_codes
+
+
+def _cartesian_join(a_uidx, a_code, b_uidx, b_code, kb: int, n_uids: int):
+    """Per-uid cartesian of two (uidx, code) entry columns (both sorted by
+    uidx): every (a, b) pair of the same uid, codes packed a*kb + b."""
+    if len(b_uidx) == 0 or len(a_uidx) == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    if np.all(np.diff(b_uidx) > 0):
+        # single-valued right column (the common multi-key shape): the
+        # cartesian is a merge-join — one searchsorted, no repeat machinery
+        if len(b_uidx) == n_uids:
+            # b covers every uid: b_uidx IS arange(n) — identity join
+            return a_uidx, a_code * kb + b_code[a_uidx]
+        pos = np.searchsorted(b_uidx, a_uidx)
+        posc = np.clip(pos, 0, len(b_uidx) - 1)
+        hit = b_uidx[posc] == a_uidx
+        return a_uidx[hit], a_code[hit] * kb + b_code[posc[hit]]
+    cnt_b = np.bincount(b_uidx, minlength=n_uids)
+    b_start = np.zeros(n_uids + 1, dtype=np.int64)
+    np.cumsum(cnt_b, out=b_start[1:])
+    rep = cnt_b[a_uidx]
+    total = int(rep.sum())
+    offs = np.zeros(len(a_uidx) + 1, dtype=np.int64)
+    np.cumsum(rep, out=offs[1:])
+    idx_a = np.repeat(np.arange(len(a_uidx)), rep)
+    within = np.arange(total) - np.repeat(offs[:-1], rep)
+    out_uidx = a_uidx[idx_a]
+    b_idx = b_start[out_uidx] + within
+    return out_uidx, a_code[idx_a] * kb + b_code[b_idx]
+
+
+def _vectorized_groups(ex, gq, uids: np.ndarray):
+    """(row_seeds, members_per) for the general multi-key case, or None
+    when a column needs the per-uid fallback."""
+    from dgraph_tpu.ops.uidset import host_rank_of
+
+    if not gq.groupby.attrs:
+        return None            # empty @groupby(): dict path's shape
+    # eligibility pre-pass BEFORE any dispatch — a late fallback would make
+    # the dict path re-run every uid traversal already paid here
+    for _alias, attr, lang in gq.groupby.attrs:
+        if lang or ex.schema.is_list(attr):
+            return None
+        pd = ex.snap.pred(attr)
+        tid = ex.schema.type_of(attr)
+        is_uid = tid == TypeID.UID or (pd is not None and pd.csr is not None)
+        if not is_uid and (pd is None or _pred_value_codes(pd) is None):
+            return None        # remote / no value table: dict path
+
+    n = len(uids)
+    cols = []        # (alias, uidx int64[], code int64[], displays, single)
+    for alias, attr, lang in gq.groupby.attrs:
+        pd = ex.snap.pred(attr)
+        tid = ex.schema.type_of(attr)
+        if tid == TypeID.UID or (pd is not None and pd.csr is not None):
+            res = ex._dispatch(TaskQuery(attr, frontier=uids))
+            counts = np.asarray([len(r) for r in res.uid_matrix], np.int64)
+            flat = (np.concatenate([np.asarray(r, np.int64)
+                                    for r in res.uid_matrix])
+                    if counts.sum() else np.zeros(0, np.int64))
+            uidx = np.repeat(np.arange(n), counts)
+            targets, code = np.unique(flat, return_inverse=True)
+            displays = [hex(int(t)) for t in targets]
+            single = False          # multi-valued: dedup members later
+        else:
+            vsub, vcodes, displays, vok = _pred_value_codes(pd)
+            if len(vsub) == n and vsub[0] == uids[0] \
+                    and vsub[-1] == uids[-1] and np.array_equal(vsub, uids):
+                # aligned case: every uid has a value slot — no rank search
+                uidx = np.flatnonzero(vok)
+                code = vcodes[vok]
+            else:
+                pos = host_rank_of(vsub, uids, -1)
+                keep = (pos >= 0)
+                keep[keep] = vok[pos[keep]]
+                uidx = np.flatnonzero(keep)
+                code = vcodes[pos[keep]]
+            single = True           # <= one entry per uid by construction
+        cols.append((alias or attr, uidx.astype(np.int64),
+                     np.asarray(code, dtype=np.int64), displays, single))
+
+    import math
+
+    _alias0, uidx, code, _d0, _s0 = cols[0]
+    bases = [len(cols[0][3])]
+    for _alias_k, uidx_k, code_k, disp_k, _sk in cols[1:]:
+        kb = max(len(disp_k), 1)
+        if math.prod(max(b, 1) for b in bases) * kb > 2 ** 62:
+            return None          # packed code would overflow: fallback
+        uidx, code = _cartesian_join(uidx, code, uidx_k, code_k, kb, n)
+        bases.append(kb)
+    if len(uidx) == 0:
+        return [], []
+
+    # one stable sort does both factorization and member extraction;
+    # uidx is already ascending, so within a group members come out sorted
+    if code.size and int(code.max()) < 2 ** 31:
+        code = code.astype(np.int32)   # radix-sorts ~2x faster
+    order = np.argsort(code, kind="stable")
+    sc = code[order]
+    brk = np.flatnonzero(np.concatenate(
+        [np.ones(1, bool), sc[1:] != sc[:-1]]))
+    gkeys = sc[brk]
+    bounds = np.concatenate([brk, [len(sc)]])
+    multi = any(not c[4] for c in cols)   # any multi-valued (uid) column
+    members_per = []
+    for i in range(len(gkeys)):
+        m = uids[uidx[order[bounds[i]: bounds[i + 1]]]]
+        members_per.append(np.unique(m) if multi else m)
+    rows = []
+    for gk in gkeys.tolist():
+        parts = []
+        for kb in reversed(bases[1:]):
+            parts.append(gk % kb)
+            gk //= kb
+        parts.append(gk)
+        parts.reverse()
+        row = {}
+        for (alias, _u, _c, displays, _s), p in zip(cols, parts):
+            row[alias] = displays[int(p)]
+        rows.append(row)
+    # match the dict path's group order: repr of the key tuple
+    perm = sorted(range(len(rows)),
+                  key=lambda i: repr(tuple(rows[i].values())))
+    return [rows[i] for i in perm], [members_per[i] for i in perm]
 
 
 def _host_segment_reduce(op: str, seg: np.ndarray, vals: np.ndarray,

@@ -1,0 +1,155 @@
+"""Vectorized multi-key/string-key @groupby (VERDICT r4 #9): dense-code
+factorization + vectorized cartesian join must be output-identical to the
+per-uid dict path, and 100k-subject grouping must run in single-digit ms
+(cache-warm)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import groupby as gbmod
+
+
+@pytest.fixture()
+def node(rng):
+    n = Node()
+    n.alter(schema_text="name: string .\ngenre: string @index(exact) .\n"
+                        "age: int @index(int) .\ncity: string .\n"
+                        "likes: [uid] .\ntags: [string] .")
+    quads = []
+    genres = ["a", "b", "c"]
+    cities = ["x", "y"]
+    for i in range(1, 61):
+        quads.append(f'<0x{i:x}> <name> "p{i}" .')
+        if i % 7:     # leave some uids without a genre
+            quads.append(f'<0x{i:x}> <genre> "{genres[i % 3]}" .')
+        quads.append(f'<0x{i:x}> <city> "{cities[i % 2]}" .')
+        quads.append(f'<0x{i:x}> <age> "{20 + i % 5}"^^<xs:int> .')
+        for _ in range(2):
+            t = int(rng.integers(1, 61))
+            quads.append(f"<0x{i:x}> <likes> <0x{t:x}> .")
+        quads.append(f'<0x{i:x}> <tags> "t{i % 4}" .')
+    n.mutate(set_nquads="\n".join(quads), commit_now=True)
+    return n
+
+
+QUERIES = [
+    # single string key
+    '{ q(func: has(name)) @groupby(genre) { count(uid) } }',
+    # multi-key: string x string
+    '{ q(func: has(name)) @groupby(genre, city) { count(uid) } }',
+    # string x numeric
+    '{ q(func: has(name)) @groupby(city, age) { count(uid) } }',
+    # uid key (multi-valued) alone and crossed with a value key
+    '{ q(func: has(name)) @groupby(likes) { count(uid) } }',
+    '{ q(func: has(name)) @groupby(genre, likes) { count(uid) } }',
+    # with aggregates
+    '{ q(func: has(name)) @groupby(genre, city) { count(uid) '
+    '  m: max(val(ag)) s: sum(val(ag)) } '
+    '  var(func: has(name)) { ag as age } }',
+    # aliased keys
+    '{ q(func: has(name)) @groupby(g: genre) { count(uid) } }',
+]
+
+
+@pytest.mark.parametrize("qidx", range(len(QUERIES)))
+def test_vectorized_matches_dict_path(node, qidx):
+    q = QUERIES[qidx]
+    vec_out, _ = node.query(q)
+    gbmod.VECTORIZE = False
+    try:
+        ref_out, _ = node.query(q)
+    finally:
+        gbmod.VECTORIZE = True
+    assert json.dumps(vec_out, sort_keys=True, default=str) == \
+        json.dumps(ref_out, sort_keys=True, default=str)
+
+
+def test_list_and_lang_keys_fall_back(node):
+    """[string] list keys keep the dict path (first-value semantics)."""
+    out, _ = node.query(
+        '{ q(func: has(name)) @groupby(tags) { count(uid) } }')
+    gbmod.VECTORIZE = False
+    try:
+        ref, _ = node.query(
+            '{ q(func: has(name)) @groupby(tags) { count(uid) } }')
+    finally:
+        gbmod.VECTORIZE = True
+    assert json.dumps(out, sort_keys=True, default=str) == \
+        json.dumps(ref, sort_keys=True, default=str)
+
+
+def test_100k_subject_groupby_ms():
+    """100k subjects, string key x 4 values + city x 2: grouping itself
+    must be single-digit ms once the per-snapshot factorization is warm."""
+    from dgraph_tpu.query.engine import Executor, SubGraph
+    from dgraph_tpu.query import dql
+    from dgraph_tpu.storage.csr_build import GraphSnapshot, PredData
+    from dgraph_tpu.utils.schema import SchemaState, parse_schema
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    n = 100_000
+    rng = np.random.default_rng(5)
+    uids = np.arange(1, n + 1, dtype=np.int64)
+    genres = np.asarray(["g%d" % i for i in range(4)])
+    cities = np.asarray(["c%d" % i for i in range(2)])
+    snap = GraphSnapshot(1)
+    schema = SchemaState()
+    for e in parse_schema("genre: string .\ncity: string ."):
+        schema.set(e)
+
+    for attr, choices in (("genre", genres), ("city", cities)):
+        pd = PredData(attr, TypeID.STRING)
+        pick = choices[rng.integers(0, len(choices), n)]
+        pd.value_subjects_host = uids.copy()
+        pd.host_values = {int(u): Val(TypeID.STRING, str(v))
+                          for u, v in zip(uids, pick)}
+        snap.preds[attr] = pd
+
+    req = dql.parse(
+        "{ q(func: uid(%s)) @groupby(genre, city) { count(uid) } }"
+        % "0x1")   # placeholder; seed via sg.dest_uids directly below
+    ex = Executor(snap, schema)
+    sg = SubGraph(gq=req.queries[0], attr="q")
+    sg.dest_uids = uids
+
+    gbmod.process_groupby(ex, sg)      # warm the factorization cache
+    dt = float("inf")
+    for _ in range(3):                 # min-of-3: box load must not flake
+        t0 = time.perf_counter()
+        gbmod.process_groupby(ex, sg)
+        dt = min(dt, (time.perf_counter() - t0) * 1e3)
+    rows = sg.group_result
+    assert len(rows) == 8
+    assert sum(r["count"] for r in rows) == n
+    assert dt < 10.0, f"groupby took {dt:.1f} ms"
+
+    # golden-equal vs the dict path on a subset (full dict path is slow)
+    sub = SubGraph(gq=req.queries[0], attr="q")
+    sub.dest_uids = uids[:2000]
+    gbmod.process_groupby(ex, sub)
+    vec_rows = sub.group_result
+    gbmod.VECTORIZE = False
+    try:
+        sub2 = SubGraph(gq=req.queries[0], attr="q")
+        sub2.dest_uids = uids[:2000]
+        gbmod.process_groupby(ex, sub2)
+    finally:
+        gbmod.VECTORIZE = True
+    assert json.dumps(vec_rows, sort_keys=True) == \
+        json.dumps(sub2.group_result, sort_keys=True)
+
+
+def test_empty_groupby_keeps_dict_shape(node):
+    out, _ = node.query('{ q(func: has(name)) @groupby() { count(uid) } }')
+    gbmod.VECTORIZE = False
+    try:
+        ref, _ = node.query(
+            '{ q(func: has(name)) @groupby() { count(uid) } }')
+    finally:
+        gbmod.VECTORIZE = True
+    assert json.dumps(out, sort_keys=True, default=str) == \
+        json.dumps(ref, sort_keys=True, default=str)
