@@ -9,8 +9,16 @@ import sys
 import tempfile
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU. The env var is NOT enough: the TPU plugin's sitecustomize
+# imports jax at interpreter startup (freezing jax_platforms before this
+# line), so first-query numbers would silently bill ~6s of relay
+# transfers. Override through the config API too (same as tests/conftest).
+os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, os.getcwd())
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np                                       # noqa: E402
 

@@ -132,6 +132,74 @@ def unpack(pl) -> np.ndarray:
     return out
 
 
+def unpack_many_flat(pls) -> tuple[np.ndarray, np.ndarray]:
+    """Batched unpack WITHOUT per-row slicing: (flat uint64 uids, int64
+    per-row counts). The snapshot fold consumes rows as spans of the flat
+    array — materializing 100k+ tiny arrays is the 10M-scale fold cliff."""
+    from dgraph_tpu.storage import packed
+
+    R = len(pls)
+    counts = np.fromiter((p.count for p in pls), np.int64, count=R)
+    if R == 0:
+        return np.zeros(0, np.uint64), counts
+    lib = _load()
+    if lib is None:
+        rows = packed.unpack_many(pls)
+        return (np.concatenate(rows) if rows else np.zeros(0, np.uint64),
+                counts)
+    nbs = np.fromiter((p.nblocks for p in pls), dtype=np.int64, count=R)
+    if int(nbs.sum()) == 0:
+        return np.zeros(0, np.uint64), counts
+    nz = [p for p in pls if p.nblocks]
+    word_lens = np.fromiter((len(p.words) for p in nz), np.int64,
+                            count=len(nz))
+    word_base_nz = np.zeros(len(nz), np.int64)
+    np.cumsum(word_lens[:-1], out=word_base_nz[1:])
+    words = np.empty(int(word_lens.sum()) + 2, np.uint32)
+    for p, b in zip(nz, word_base_nz):
+        words[int(b): int(b) + len(p.words)] = p.words
+    words[-2:] = 0
+    row_word_start = np.zeros(R, np.int64)
+    row_word_start[nbs > 0] = word_base_nz
+    bfirst = np.concatenate([p.block_first for p in nz]).astype(
+        np.uint64, copy=False)
+    bcount = np.concatenate([p.block_count for p in nz]).astype(
+        np.int32, copy=False)
+    bwidth = np.concatenate([p.block_width for p in nz]).astype(
+        np.int32, copy=False)
+    boff = np.concatenate([p.block_off for p in nz]).astype(
+        np.int64, copy=False)
+    out = np.empty(int(counts.sum()), np.uint64)
+    k = lib.dgt_unpack_many(
+        np.ascontiguousarray(bfirst), np.ascontiguousarray(bcount),
+        np.ascontiguousarray(bwidth), np.ascontiguousarray(boff),
+        words, nbs, row_word_start, R, out)
+    assert k == len(out)
+    return out, counts
+
+
+def unpack_columns(tp, total: int) -> np.ndarray | None:
+    """Decode a whole TabletPacked in ONE native call (zero per-list
+    marshalling — the cold-open fold hot path). None when the native
+    library is unavailable (caller falls back to per-list decode)."""
+    lib = _load()
+    if lib is None:
+        return None
+    words = np.empty(len(tp.words) + 2, np.uint32)   # decode pair-read pad
+    words[: len(tp.words)] = tp.words
+    words[-2:] = 0
+    out = np.empty(total, np.uint64)
+    k = lib.dgt_unpack_many(
+        np.ascontiguousarray(tp.bfirst, np.uint64),
+        np.ascontiguousarray(tp.bcount, np.int32),
+        np.ascontiguousarray(tp.bwidth, np.int32),
+        np.ascontiguousarray(tp.boff, np.int64),
+        words, np.ascontiguousarray(tp.nbs, np.int64),
+        np.ascontiguousarray(tp.row_word_start, np.int64), tp.n, out)
+    assert k == total
+    return out
+
+
 def unpack_many(pls) -> list[np.ndarray]:
     """Native batched unpack; same per-row arrays as packed.unpack_many."""
     from dgraph_tpu.storage import packed

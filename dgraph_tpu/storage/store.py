@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import base64
 import contextlib
+import dataclasses
 import io
 import json
 import os
@@ -95,6 +96,26 @@ _Q = struct.Struct("<q")
 _HDR_M = struct.Struct("<q I")        # start_ts, key len
 _HDR_C = struct.Struct("<q q I")      # start_ts, commit_ts, n keys
 _HDR_A = struct.Struct("<q I")        # start_ts, n keys
+
+
+@dataclasses.dataclass
+class TabletPacked:
+    """One tablet's packed columns as contiguous slices of the snapshot's
+    shared buffers (DGTS2 is key-sorted, so a tablet is one run). `pure`
+    means no row carried base_postings at load; any later write drops the
+    whole entry, so a surviving entry implies layer-free lists too."""
+
+    n: int
+    counts: np.ndarray            # int64[n]
+    nbs: np.ndarray               # int64[n] blocks per row
+    row_word_start: np.ndarray    # int64[n] word base per row (tablet-rel)
+    bfirst: np.ndarray
+    bcount: np.ndarray
+    bwidth: np.ndarray
+    boff: np.ndarray
+    words: np.ndarray
+    pure: bool
+    max_base_ts: int              # reads below this must raise (isolation)
 
 
 def _key_bytes(k) -> bytes:
@@ -223,6 +244,12 @@ class Store:
         # read-through; here clean predicates reuse device arrays)
         self.pred_commit_ts: dict[str, int] = {}
         self.pred_replay_seq: dict[str, int] = {}   # below-watermark commits
+        # cold-open fold accelerator: per-(kind, attr) CONTIGUOUS packed
+        # columns captured at snapshot load (the DGTS2 layout is already
+        # tablet-ordered). While an entry survives — dropped on the first
+        # write touching its tablet — the snapshot fold decodes the whole
+        # tablet in ONE native call with zero per-list marshalling.
+        self._packed_tablets: dict[tuple[int, str], "TabletPacked"] = {}
         self.snapshot_ts = 0  # commits at/below this are folded into bases
         # records currently in wal.log (an up-to-dateness signal for
         # elections; NOT the replication ship index — that is a per-term
@@ -244,7 +271,17 @@ class Store:
                 pl = PostingList()
                 self.lists[kb] = pl
                 self.by_pred.setdefault((int(key.kind), key.attr), set()).add(kb)
+                self._drop_packed(int(key.kind), key.attr)
             return pl
+
+    def _drop_packed(self, kind: int, attr: str) -> None:
+        """Invalidate the cold-open fold cache for one tablet (any write
+        breaks the contiguous-and-pure contract of TabletPacked)."""
+        if self._packed_tablets:
+            self._packed_tablets.pop((kind, attr), None)
+
+    def packed_tablet(self, kind: int, attr: str) -> TabletPacked | None:
+        return self._packed_tablets.get((kind, attr))
 
     def get_no_store(self, key: K.Key) -> PostingList | None:
         """Read-only peek (reference posting/lists.go GetNoStore :274)."""
@@ -293,6 +330,7 @@ class Store:
 
     def add_mutation(self, start_ts: int, key: K.Key, p: Posting) -> None:
         self._wal_write({"t": "m", "s": start_ts, "k": key.encode(), "p": p})
+        self._drop_packed(int(key.kind), key.attr)
         self.get(key).add_mutation(start_ts, p)
         self.dirty.add(key.encode())
 
@@ -345,6 +383,7 @@ class Store:
 
     def _drop_kind_mem(self, attr: str, kind: K.KeyKind) -> None:
         with self._lock:
+            self._drop_packed(int(kind), attr)
             for kb in self.by_pred.pop((int(kind), attr), set()):
                 self.lists.pop(kb, None)
                 self.dirty.discard(kb)
@@ -352,6 +391,7 @@ class Store:
     def _delete_predicate_mem(self, attr: str) -> None:
         with self._lock:
             for kind in list(K.KeyKind):
+                self._drop_packed(int(kind), attr)
                 for kb in self.by_pred.pop((int(kind), attr), set()):
                     self.lists.pop(kb, None)
                     self.dirty.discard(kb)
@@ -407,6 +447,7 @@ class Store:
         afterwards so durability comes from the snapshot, not per-posting
         WAL records."""
         with self._lock:
+            self._packed_tablets.clear()   # direct installs bypass get()
             for kb, pl in lists.items():
                 key = K.parse_key(kb)
                 self.lists[kb] = pl
@@ -494,6 +535,8 @@ class Store:
         t = rec["t"]
         if t == "m":
             kb = _key_bytes(rec["k"])
+            if self._packed_tablets:
+                self._drop_packed(*K.kind_attr_of(kb))
             pl = self.lists.get(kb)
             if pl is None:      # full parse only on first sight of the key
                 key = K.parse_key(kb)
@@ -541,6 +584,7 @@ class Store:
         Uncommitted txns and layers above upto_ts survive via the fresh WAL.
         (Reference: worker/draft.go snapshot at min pending-txn ts.)
         """
+        self._packed_tablets.clear()   # rollup replaces packed bases
         if self.dir is None:
             for pl in list(self.lists.values()):
                 pl.rollup(upto_ts)
@@ -689,6 +733,35 @@ class Store:
         bends = np.cumsum(nblocks.astype(np.int64))
         wends = np.cumsum(word_lens.astype(np.int64))
         pends = np.cumsum(post_lens.astype(np.int64))
+
+        # tablet-run capture: keys are globally sorted, so a (kind, attr)
+        # occupies one contiguous row run — record its column slices for
+        # the one-call cold-open fold (csr_build._fold_uid_tablet)
+        run_key: tuple[int, str] | None = None
+        run_start = 0
+        wstarts = wends - word_lens.astype(np.int64)
+        bstarts = bends - nblocks.astype(np.int64)
+
+        def flush_run(end: int) -> None:
+            if run_key is None or end <= run_start:
+                return
+            r0, r1 = run_start, end
+            bb0, bb1 = int(bstarts[r0]), int(bends[r1 - 1])
+            ww0, ww1 = int(wstarts[r0]), int(wends[r1 - 1])
+            if run_key[0] not in (int(K.KeyKind.DATA),
+                                  int(K.KeyKind.REVERSE)):
+                return       # only uid-edge tablets consult the cache
+            self._packed_tablets[run_key] = TabletPacked(
+                n=r1 - r0,
+                counts=counts[r0:r1].astype(np.int64),
+                nbs=nblocks[r0:r1].astype(np.int64),
+                row_word_start=wstarts[r0:r1] - ww0,
+                bfirst=bfirst[bb0:bb1], bcount=bcount[bb0:bb1],
+                bwidth=bwidth[bb0:bb1], boff=boff[bb0:bb1],
+                words=words[ww0:ww1],
+                pure=not post_lens[r0:r1].any(),
+                max_base_ts=int(base_ts[r0:r1].max()) if r1 > r0 else 0)
+
         k0 = b0 = w0 = p0 = 0
         for i in range(N):
             k1, b1 = int(kends[i]), int(bends[i])
@@ -708,7 +781,11 @@ class Store:
             kind, attr = K.kind_attr_of(kb)
             self.lists[kb] = pl
             self.by_pred.setdefault((kind, attr), set()).add(kb)
+            if (kind, attr) != run_key:
+                flush_run(i)
+                run_key, run_start = (kind, attr), i
             k0, b0, w0, p0 = k1, b1, w1, p1
+        flush_run(N)
 
     def _load_v1(self, raw: bytes) -> None:
         """Row-format reader kept for snapshots written before DGTS2."""

@@ -118,7 +118,7 @@ def test_100k_subject_groupby_ms():
 
     gbmod.process_groupby(ex, sg)      # warm the factorization cache
     dt = float("inf")
-    for _ in range(3):                 # min-of-3: box load must not flake
+    for _ in range(5):                 # min-of-N: box load must not flake
         t0 = time.perf_counter()
         gbmod.process_groupby(ex, sg)
         dt = min(dt, (time.perf_counter() - t0) * 1e3)
