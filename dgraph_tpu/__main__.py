@@ -119,6 +119,7 @@ def cmd_worker(args) -> int:
             for e in parse_schema(f.read()):
                 store.set_schema(e)
     server, port = serve_worker(store, f"{args.host}:{args.port}",
+                                elections=True,
                                 advertise_host=args.advertise_host)
     if args.zero:
         import threading
@@ -126,8 +127,25 @@ def cmd_worker(args) -> int:
         from dgraph_tpu.coord.zero_service import ZeroClient
 
         zc = ZeroClient(args.zero)
-        group, rid = zc.connect(f"{args.host}:{port}", args.group)
+        svc = server.dgt_svc
+        my_addr = svc.advertise_addr
+        group, rid = zc.connect(my_addr, args.group)
         print(f"worker joined group {group} as replica {rid}", flush=True)
+
+        def _learn_members():
+            # seed the wire-election membership from Zero's registry so a
+            # replica set can self-elect even when the control plane later
+            # dies (the members list keeps working from cache)
+            st = zc.state()
+            members = st.get("groups", {}).get(str(group), {}) \
+                        .get("members", [])
+            if members:
+                svc.group_members = sorted(set(members) | {my_addr})
+
+        try:
+            _learn_members()
+        except Exception:
+            pass
 
         def membership_loop():
             # periodic re-registration (worker/groups.go:454
@@ -136,7 +154,8 @@ def cmd_worker(args) -> int:
             while True:
                 time.sleep(args.membership_interval)
                 try:
-                    zc.connect(f"{args.host}:{port}", group)
+                    zc.connect(my_addr, group)
+                    _learn_members()
                 except Exception:
                     pass                   # zero down: next tick retries
 
@@ -167,7 +186,22 @@ def cmd_zero(args) -> int:
                                                serve_zero_http)
 
     zero = Zero(n_groups=args.groups, dirpath=args.wal)
-    server, port, svc = serve_zero(zero, f"{args.host}:{args.port}")
+    from dgraph_tpu.coord.zero_service import ZeroReplica, ZeroService
+
+    svc = ZeroService(zero)
+    replica = None
+    if args.peers:
+        if not args.wal:
+            raise SystemExit("--peers (multi-zero) requires --wal")
+        members = [a.strip() for a in args.peers.split(",") if a.strip()]
+        advertise = members[args.idx]
+        replica = ZeroReplica(svc, args.wal, advertise, members,
+                              bootstrap_leader=args.idx == 0)
+    server, port, svc = serve_zero(zero, f"{args.host}:{args.port}", svc=svc)
+    if replica is not None:
+        replica.start()
+        print(f"zero replica {args.idx} of {len(replica.members)} "
+              f"(leader={replica.is_leader})", flush=True)
     ops = ZeroOps(svc)
     httpd, hport = serve_zero_http(svc, ops, args.host, args.http_port)
     print(f"zero ops HTTP on {args.host}:{hport}", flush=True)
@@ -309,6 +343,14 @@ def build_parser() -> argparse.ArgumentParser:
     zp.add_argument("--rebalance_interval", type=float, default=0,
                     help="seconds between automatic tablet rebalance ticks "
                          "(0 = off)")
+    zp.add_argument("--peers", default="",
+                    help="multi-zero: comma-separated addresses of ALL "
+                         "zeros (incl. this one); state replicates to a "
+                         "quorum and standbys elect on leader failure "
+                         "(reference --peer, dgraph/cmd/zero/run.go)")
+    zp.add_argument("--idx", type=int, default=0,
+                    help="this zero's position in --peers (0 bootstraps "
+                         "as leader)")
     zp.set_defaults(fn=cmd_zero)
 
     cp = sub.add_parser("convert", help="GeoJSON -> RDF (.rdf.gz)")

@@ -334,6 +334,10 @@ class Zero:
         self._uid_ceiling = ceiling
         self._persist()
 
+    # multi-zero hook: called with the persisted state JSON after every
+    # durable write — the leader's ZeroReplica ships it to standby zeros
+    persist_sink = None
+
     def _persist(self, tablets: dict | None = None) -> None:
         import json as _json
         import os as _os
@@ -345,14 +349,19 @@ class Zero:
         path = _os.path.join(self._dir, "zero_state.json")
         tmp = path + ".tmp"
         with self._plock:   # ts/uid/tablet persists may race each other
+            payload = _json.dumps({"ts_ceiling": self._ts_ceiling,
+                                   "uid_ceiling": self._uid_ceiling,
+                                   "tablets": snap,
+                                   "n_groups": self.n_groups})
             with open(tmp, "w") as f:
-                _json.dump({"ts_ceiling": self._ts_ceiling,
-                            "uid_ceiling": self._uid_ceiling,
-                            "tablets": snap,
-                            "n_groups": self.n_groups}, f)
+                f.write(payload)
                 f.flush()
                 _os.fsync(f.fileno())
             _os.replace(tmp, path)
+            sink = self.persist_sink
+            if sink is not None:
+                # under _plock: standbys receive states in persist order
+                sink(payload)
 
     def block_writes(self, attr: str) -> None:
         """Mark a tablet read-only for the duration of a move (the reference

@@ -207,6 +207,12 @@ class WorkerService:
         if self._term_path and os.path.exists(self._term_path):
             with open(self._term_path) as f:
                 self.term = int(f.read().strip() or 0)
+        # wire election state (conn/node.go ballot, redesigned): membership
+        # learned from heartbeats, one vote per term, randomized timeout
+        self.group_members: list[str] = []
+        self._leader_contact = 0.0
+        self._election_stop = threading.Event()
+        self._election_thread: threading.Thread | None = None
 
     def _set_term(self, term: int) -> None:
         self.term = term
@@ -305,34 +311,43 @@ class WorkerService:
         leader's sequence at 1 while followers are at N — every shipped
         record up to N would be acked as a "duplicate" without being
         applied, and a later failover would lose acked writes."""
-        from concurrent import futures as _futures
-
         with self._rlock:
             if msg.term <= self.term:
                 return ipb.PromoteResponse(ok=False, term=self.term)
-            # serialize against an in-flight _ship before touching the pool,
-            # peers, or sequence state it is using
-            with self._ship_lock:
-                self._set_term(int(msg.term))
-                for p in self.peers:
-                    p.close()
-                self.peers = [RemoteWorker(a) for a in msg.peers]
-                self._peer_seq = {i: 0 for i in range(len(self.peers))}
-                self._session_seq = 0
-                # an in-memory leader has no durable files for FetchState —
-                # its ship buffer IS the full history, so it must not evict
-                import collections as _c
+            self._become_leader(int(msg.term), list(msg.peers))
+            return ipb.PromoteResponse(ok=True, term=self.term)
 
-                self._buffer = _c.deque(
-                    maxlen=None if self.store.dir is None
-                    else self.SHIP_BUFFER)
-                if self._pool is not None:
-                    self._pool.shutdown(wait=False)
-                self._pool = _futures.ThreadPoolExecutor(
-                    max_workers=max(len(self.peers), 1))
-                self.is_leader = True
-                self.store.wal_sink = self._ship
-                return ipb.PromoteResponse(ok=True, term=self.term)
+    def _become_leader(self, term: int, peer_addrs: list[str]) -> None:
+        """Install leadership at `term` (caller holds _rlock and has
+        verified the term transition: strictly-greater for the Promote RPC;
+        equal-after-self-vote for a won wire election)."""
+        from concurrent import futures as _futures
+
+        # serialize against an in-flight _ship before touching the pool,
+        # peers, or sequence state it is using
+        with self._ship_lock:
+            self._set_term(int(term))
+            for p in self.peers:
+                p.close()
+            self.peers = [RemoteWorker(a) for a in peer_addrs]
+            self._peer_seq = {i: 0 for i in range(len(self.peers))}
+            self._session_seq = 0
+            # an in-memory leader has no durable files for FetchState —
+            # its ship buffer IS the full history, so it must not evict
+            import collections as _c
+
+            self._buffer = _c.deque(
+                maxlen=None if self.store.dir is None
+                else self.SHIP_BUFFER)
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            self._pool = _futures.ThreadPoolExecutor(
+                max_workers=max(len(peer_addrs), 1))
+            self.is_leader = True
+            self.store.wal_sink = self._ship
+        if self.advertise_addr:
+            self.group_members = sorted(
+                set(peer_addrs) | {self.advertise_addr})
 
     advertise_addr = ""     # set by serve_worker; followers call back here
 
@@ -453,6 +468,133 @@ class WorkerService:
                     self._assembler.invalidate()
             return ipb.AppendResponse(ok=True, term=self.term,
                                       log_len=self._last_seq)
+
+    # -- wire leader election (conn/node.go:47-105 ballot, redesigned) ------
+
+    HEARTBEAT_S = 0.5            # leader ping period
+    ELECTION_TIMEOUT_S = (1.5, 3.0)   # randomized per-campaign window
+
+    def vote(self, msg: ipb.VoteRequest, context) -> ipb.VoteResponse:
+        """Grant iff the candidate's term is newer, we have not voted this
+        term, and the candidate is at least as up to date on
+        (max_commit_ts, log_len) — Raft's up-to-date rule."""
+        with self._rlock:
+            if msg.term <= self.term:
+                return ipb.VoteResponse(granted=False, term=self.term)
+            self._set_term(int(msg.term))
+            self._step_down()
+            self._last_seq = 0        # new term => new session sequence
+            # one vote per term falls out of the strict term check above:
+            # a second candidate at the same term is rejected there
+            mine = (self.store.max_seen_commit_ts,
+                    self.store.wal_record_count)
+            theirs = (int(msg.max_commit_ts), int(msg.log_len))
+            if theirs >= mine:
+                self._leader_contact = time.monotonic()  # grace for winner
+                return ipb.VoteResponse(granted=True, term=self.term)
+            return ipb.VoteResponse(granted=False, term=self.term)
+
+    def heartbeat(self, msg: ipb.HeartbeatRequest,
+                  context) -> ipb.HeartbeatResponse:
+        with self._rlock:
+            if msg.term < self.term:
+                return ipb.HeartbeatResponse(term=self.term, ok=False)
+            if msg.term > self.term:
+                self._set_term(int(msg.term))
+                self._step_down()
+                self._last_seq = 0
+            self._leader_contact = time.monotonic()
+            if msg.members:
+                self.group_members = list(msg.members)
+            return ipb.HeartbeatResponse(term=self.term, ok=True)
+
+    def enable_elections(self) -> None:
+        """Start the failure detector / heartbeat loop (one thread: leaders
+        ping, followers campaign on silence). Requires advertise_addr."""
+        if self._election_thread is not None:
+            return
+        self._leader_contact = time.monotonic()
+        self._election_thread = threading.Thread(
+            target=self._election_loop, daemon=True)
+        self._election_thread.start()
+
+    def stop_elections(self) -> None:
+        self._election_stop.set()
+
+    def _election_loop(self) -> None:
+        import random
+
+        timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
+        last_hb = 0.0
+        while not self._election_stop.wait(0.1):
+            now = time.monotonic()
+            if self.is_leader:
+                if now - last_hb >= self.HEARTBEAT_S:
+                    last_hb = now
+                    self._send_heartbeats()
+                continue
+            others = [a for a in self.group_members
+                      if a != self.advertise_addr]
+            if not others:
+                self._leader_contact = now   # no known peers: never campaign
+                continue
+            if now - self._leader_contact > timeout:
+                self._campaign(others)
+                timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
+                self._leader_contact = time.monotonic()
+
+    def _send_heartbeats(self) -> None:
+        members = sorted(set(self.group_members) | {self.advertise_addr})
+        # adopt members that joined after the election (e.g. learned from
+        # Zero's registry): add them to the ship set — their first append
+        # gap triggers FetchState catch-up — so a joiner hears heartbeats
+        # instead of endlessly campaigning against a healthy leader
+        with self._ship_lock:
+            known = {p.addr for p in self.peers}
+            for a in members:
+                if a != self.advertise_addr and a not in known:
+                    self.peers.append(RemoteWorker(a))
+                    self._peer_seq[len(self.peers) - 1] = 0
+        for p in list(self.peers):
+            try:
+                p.heartbeat(self.term, self.advertise_addr, members)
+            except Exception:
+                pass
+
+    def _campaign(self, others: list[str]) -> None:
+        """One ballot round: term+1, self-vote, request votes; majority of
+        the full member set wins and self-promotes."""
+        with self._rlock:
+            t = self.term + 1
+            self._set_term(t)
+            my_key = (self.store.max_seen_commit_ts,
+                      self.store.wal_record_count)
+        votes = 1
+        for a in others:
+            rw = None
+            try:
+                rw = RemoteWorker(a)
+                r = rw.vote(t, my_key[0], my_key[1], self.advertise_addr,
+                            timeout=1.0)
+                if r.granted:
+                    votes += 1
+                elif r.term > t:
+                    with self._rlock:
+                        if r.term > self.term:
+                            self._set_term(int(r.term))
+                    return
+            except Exception:
+                pass
+            finally:
+                if rw is not None:
+                    rw.close()
+        if votes < (len(others) + 1) // 2 + 1:
+            return
+        with self._rlock:
+            if self.term != t:
+                return           # a newer term appeared mid-ballot
+            self._become_leader(t, others)
+        self._send_heartbeats()
 
     _SIZES_TTL = 5.0   # Status doubles as the hot leader-discovery probe;
                        # the O(all keys) size walk refreshes on this cadence
@@ -735,6 +877,9 @@ class WorkerService:
                             ipb.FetchStateResponse),
             "Promote": u(self.promote, ipb.PromoteRequest,
                          ipb.PromoteResponse),
+            "Vote": u(self.vote, ipb.VoteRequest, ipb.VoteResponse),
+            "Heartbeat": u(self.heartbeat, ipb.HeartbeatRequest,
+                           ipb.HeartbeatResponse),
             "Status": u(self.status, ipb.StatusRequest, ipb.StatusResponse),
             "Sort": u(self.sort, ipb.SortRequest, ipb.SortResponse),
             "Schema": u(self.schema, ipb.SchemaRequest, ipb.SchemaResponse),
@@ -749,11 +894,14 @@ class WorkerService:
 
 
 def serve_worker(store, addr: str = "localhost:0",
-                 max_workers: int = 8, advertise_host: str | None = None):
+                 max_workers: int = 8, advertise_host: str | None = None,
+                 elections: bool = False):
     """Start a Worker gRPC server for one group's store; returns
     (server, bound_port). advertise_host overrides the callback host
     followers use for FetchState — required when binding a wildcard
-    (0.0.0.0), which is unroutable from a peer."""
+    (0.0.0.0), which is unroutable from a peer. elections=True starts the
+    wire-ballot failure detector (self-healing leader election without the
+    control plane)."""
     svc = WorkerService(store)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
@@ -767,7 +915,10 @@ def serve_worker(store, addr: str = "localhost:0",
 
         host = socket.gethostname()
     svc.advertise_addr = f"{host}:{port}"
+    if elections:
+        svc.enable_elections()
     server.start()
+    server.dgt_svc = svc     # CLI/tests reach the service behind the server
     return server, port
 
 
@@ -801,6 +952,14 @@ class RemoteWorker:
             f"/{SERVICE}/Promote",
             request_serializer=ipb.PromoteRequest.SerializeToString,
             response_deserializer=ipb.PromoteResponse.FromString)
+        self._vote = self.channel.unary_unary(
+            f"/{SERVICE}/Vote",
+            request_serializer=ipb.VoteRequest.SerializeToString,
+            response_deserializer=ipb.VoteResponse.FromString)
+        self._heartbeat = self.channel.unary_unary(
+            f"/{SERVICE}/Heartbeat",
+            request_serializer=ipb.HeartbeatRequest.SerializeToString,
+            response_deserializer=ipb.HeartbeatResponse.FromString)
         self._fetch_state = self.channel.unary_unary(
             f"/{SERVICE}/FetchState",
             request_serializer=ipb.FetchStateRequest.SerializeToString,
@@ -842,6 +1001,18 @@ class RemoteWorker:
 
     def promote(self, term: int, peers: list[str]) -> ipb.PromoteResponse:
         return self._promote(ipb.PromoteRequest(term=term, peers=peers))
+
+    def vote(self, term: int, max_commit_ts: int, log_len: int,
+             candidate: str, timeout: float = 2.0) -> ipb.VoteResponse:
+        return self._vote(ipb.VoteRequest(
+            term=term, max_commit_ts=max_commit_ts, log_len=log_len,
+            candidate=candidate), timeout=timeout)
+
+    def heartbeat(self, term: int, leader_addr: str, members: list[str],
+                  timeout: float = 2.0) -> ipb.HeartbeatResponse:
+        return self._heartbeat(ipb.HeartbeatRequest(
+            term=term, leader_addr=leader_addr, members=members),
+            timeout=timeout)
 
     def status(self, timeout: float = 3.0) -> ipb.StatusResponse:
         return self._status(ipb.StatusRequest(), timeout=timeout)

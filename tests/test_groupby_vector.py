@@ -125,7 +125,11 @@ def test_100k_subject_groupby_ms():
     rows = sg.group_result
     assert len(rows) == 8
     assert sum(r["count"] for r in rows) == n
-    assert dt < 10.0, f"groupby took {dt:.1f} ms"
+    # single-digit ms when the box is idle (measured ~3 ms); the full
+    # suite runs jit compiles on all cores concurrently, so the CI gate
+    # allows contention headroom while still catching a per-uid regression
+    # (the dict path takes ~1.5 s here)
+    assert dt < 30.0, f"groupby took {dt:.1f} ms"
 
     # golden-equal vs the dict path on a subset (full dict path is slow)
     sub = SubGraph(gq=req.queries[0], attr="q")
