@@ -20,7 +20,8 @@ def cmd_serve(args) -> int:
     from dgraph_tpu.api.http import make_server
     from dgraph_tpu.api.server import Node
 
-    node = Node(dirpath=args.postings, trace_fraction=args.trace)
+    node = Node(dirpath=args.postings, trace_fraction=args.trace,
+                memory_mb=args.memory_mb or None)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:

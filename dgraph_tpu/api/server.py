@@ -70,8 +70,13 @@ class Node:
     """One embedded server (store + zero + snapshot cache)."""
 
     def __init__(self, dirpath: str | None = None, n_groups: int = 1,
-                 trace_fraction: float = 1.0) -> None:
-        self.store = Store(dirpath)
+                 trace_fraction: float = 1.0,
+                 memory_mb: int | None = None) -> None:
+        # memory_mb enables the PAGED store: snapshot mmap'd, lists
+        # materialize lazily, clean entries evict under the budget
+        self.store = Store(dirpath,
+                           memory_budget=(memory_mb * (1 << 20))
+                           if memory_mb else None)
         self.zero = Zero(n_groups)
         self.metrics = metrics.Registry()
         self.traces = metrics.TraceStore(fraction=trace_fraction)
@@ -124,6 +129,14 @@ class Node:
     def _max_uid_in_store(self) -> int:
         ts = self.store.max_seen_commit_ts
         m = 0
+        if self.store.paged:
+            # segment-backed keys never enter by_pred: recover their max
+            # from packed metadata without materializing any list
+            def _uid_typed(attr):
+                e = self.store.schema.get(attr)
+                return e is None or e.type_id.name in ("UID", "DEFAULT")
+
+            m = self.store.segment_max_uid(_uid_typed, self._SLOT_BITS)
         for (kind, attr), keys in self.store.by_pred.items():
             if kind not in (int(K.KeyKind.DATA), int(K.KeyKind.REVERSE)):
                 continue

@@ -195,6 +195,7 @@ class ZeroReplica:
         self._stop = threading.Event()
         self._bootstrap = bootstrap_leader
         self._peer_cache: dict[str, ZeroClient] = {}
+        self._ping_fail_rounds = 0
         svc.replica = self
 
     # -- durable meta --------------------------------------------------------
@@ -258,10 +259,13 @@ class ZeroReplica:
 
             mp = os.path.join(self.dir, "zero_members.json")
             if os.path.exists(mp):
-                reg = json.loads(open(mp).read())
-                with self.svc._lock:
-                    self.svc._members = {int(g): list(a)
-                                         for g, a in reg.items()}
+                try:
+                    reg = json.loads(open(mp).read())
+                    with self.svc._lock:
+                        self.svc._members = {int(g): list(a)
+                                             for g, a in reg.items()}
+                except (ValueError, OSError):
+                    pass    # torn legacy file: workers re-register anyway
             self.is_leader = True
 
     def _ship(self, state_json: str) -> None:
@@ -305,15 +309,37 @@ class ZeroReplica:
             if self.is_leader:
                 if now - last_ping >= self.PING_S:
                     last_ping = now
+                    acked = 1            # self
                     for c in self._peer_clients():
                         try:
-                            c.zero_ping(self.term, self.advertise,
-                                        self.members)
+                            r = c.zero_ping(self.term, self.advertise,
+                                            self.members)
+                            if r.term <= self.term:
+                                acked += 1
+                            else:        # deposed: a newer term exists
+                                with self._lock:
+                                    self.term = int(r.term)
+                                    self.is_leader = False
+                                    self._save_meta()
+                                break
                         except Exception:
                             pass
+                    if acked < len(self.members) // 2 + 1:
+                        self._ping_fail_rounds += 1
+                        if self._ping_fail_rounds >= 3:
+                            # partitioned from the quorum: stop deciding —
+                            # two live oracles must never coexist (the
+                            # worker path's NoQuorum step-down, for pings)
+                            with self._lock:
+                                self.is_leader = False
+                    else:
+                        self._ping_fail_rounds = 0
                 continue
             if now - self._leader_contact > timeout:
-                self._campaign()
+                try:
+                    self._campaign()
+                except Exception:
+                    pass     # the loop must survive any campaign failure
                 timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
                 self._leader_contact = time.monotonic()
 
@@ -371,8 +397,11 @@ class ZeroReplica:
             os.replace(tmp, path)
             if msg.members_json:
                 mp = os.path.join(self.dir, "zero_members.json")
-                with open(mp, "w") as f:
+                with open(mp + ".tmp", "w") as f:
                     f.write(msg.members_json)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(mp + ".tmp", mp)
             self.seq = int(msg.seq)
             self._save_meta()
             return ipb.ZeroShipResponse(ok=True, term=self.term,

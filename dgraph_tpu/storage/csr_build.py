@@ -192,13 +192,16 @@ _UNPACK_CHUNK = 16384   # lists decoded per vectorized unpack_many call
 
 
 def _tablet_uids(store: Store, kbs: list[bytes], read_ts: int,
-                 own: int | None) -> list[np.ndarray]:
+                 own: int | None,
+                 pls: list | None = None) -> list[np.ndarray]:
     """uids() for every key of a tablet, batching pure-base lists through one
     vectorized decode (packed.unpack_many) — per-list numpy overhead
     dominates a 100k-list snapshot build otherwise."""
     # .get: a predicate dropped mid-build (follower live-apply) reads as
     # empty rather than KeyError; the reader's version bump rebuilds after
-    pls = [store.lists.get(kb) or PostingList() for kb in kbs]
+    if pls is None:
+        pls = [store.lists.get(kb) for kb in kbs]
+    pls = [pl if pl is not None else PostingList() for pl in pls]
     out: list[np.ndarray | None] = [None] * len(pls)
     batch_idx: list[int] = []
     for i, pl in enumerate(pls):
@@ -361,10 +364,10 @@ def build_pred(store: Store, attr: str, read_ts: int,
         pd.csr = _fold_uid_tablet(store, kbs, read_ts, own, pd,
                                   kind=int(K.KeyKind.DATA))
         kbs = []
-    tablet_uids = _tablet_uids(store, kbs, read_ts, own)
-    for kb, u in zip(kbs, tablet_uids):
+    tablet_pls = store.tablet_lists(int(K.KeyKind.DATA), attr, kbs)
+    tablet_uids = _tablet_uids(store, kbs, read_ts, own, pls=tablet_pls)
+    for kb, u, pl in zip(kbs, tablet_uids, tablet_pls):
         subj = K.uid_of(kb)        # DATA key: partial parse, hot loop
-        pl = store.lists.get(kb)
         if pl is None:             # predicate dropped mid-build (follower
             continue               # live-apply); version bump rebuilds
         if uid_typed and not pl.layers and not pl.uncommitted \
@@ -444,7 +447,9 @@ def build_pred(store: Store, attr: str, read_ts: int,
             name: [] for name in entry.tokenizers}
         ident_to_name = {tokmod.get(n).ident: n for n in entry.tokenizers}
         ikbs = store.keys_of(K.KeyKind.INDEX, attr)
-        for kb, u in zip(ikbs, _tablet_uids(store, ikbs, read_ts, own)):
+        ipls = store.tablet_lists(int(K.KeyKind.INDEX), attr, ikbs)
+        for kb, u in zip(ikbs, _tablet_uids(store, ikbs, read_ts, own,
+                                            pls=ipls)):
             key = K.parse_key(kb)
             if not key.term or not len(u):
                 continue

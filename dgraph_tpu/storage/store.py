@@ -118,6 +118,121 @@ class TabletPacked:
     max_base_ts: int              # reads below this must raise (isolation)
 
 
+@dataclasses.dataclass
+class SegmentRun:
+    """One tablet's rows in the mmap'd snapshot (paged mode): everything a
+    PostingList needs, as FILE-BACKED views the OS pages in and out. The
+    badger-LSM role (SURVEY §2.1): datasets larger than host RAM, served
+    through lazy per-key materialization + eviction of clean lists."""
+
+    n: int
+    uid_keyed: bool                # DATA/REVERSE: fixed-len keys ending in
+    # a big-endian uid (enables the vectorized find index)
+    keys_blob: "np.ndarray"        # uint8 view of this run's key bytes
+    kends: "np.ndarray"            # int64[n] key end offsets (run-relative)
+    base_ts: "np.ndarray"
+    counts: "np.ndarray"
+    nbs: "np.ndarray"              # blocks per row
+    bstarts: "np.ndarray"          # int64[n+1] block offsets (run-relative)
+    wstarts: "np.ndarray"          # int64[n+1] word offsets (run-relative)
+    pstarts: "np.ndarray"          # int64[n+1] postings-json offsets
+    bfirst: "np.ndarray"
+    blast: "np.ndarray"
+    bcount: "np.ndarray"
+    bwidth: "np.ndarray"
+    boff: "np.ndarray"
+    words: "np.ndarray"
+    post_blob: "np.ndarray"        # uint8 view
+
+    def key_at(self, i: int) -> bytes:
+        k0 = int(self.kends[i - 1]) if i else 0
+        return bytes(self.keys_blob[k0: int(self.kends[i])])
+
+    def _uid_index(self):
+        """For fixed-length uid-keyed runs (DATA/REVERSE): the sorted
+        big-endian uid column, built lazily ONCE — find() becomes one
+        numpy searchsorted instead of ~log2(n) Python byte compares."""
+        idx = getattr(self, "_uids", None)
+        if idx is None:
+            L = int(self.kends[0])
+            if not self.uid_keyed or self.n * L != int(self.kends[-1]):
+                self._uids = False        # variable-length keys (index)
+            else:
+                blob = np.ascontiguousarray(
+                    np.asarray(self.keys_blob).reshape(self.n, L)[:, -8:])
+                self._uids = blob.view(">u8").ravel().astype(np.uint64)
+            idx = self._uids
+        return idx
+
+    def find(self, kb: bytes) -> int:
+        """Binary search (keys are sorted); -1 = absent."""
+        uids = self._uid_index()
+        if uids is not False:
+            u = np.uint64(int.from_bytes(kb[-8:], "big"))
+            i = int(np.searchsorted(uids, u))
+            return i if i < self.n and uids[i] == u else -1
+        lo, hi = 0, self.n - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            k = self.key_at(mid)
+            if k == kb:
+                return mid
+            if k < kb:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return -1
+
+    def iter_keys(self):
+        for i in range(self.n):
+            yield self.key_at(i)
+
+    def build_list(self, i: int) -> PostingList:
+        b0, b1 = int(self.bstarts[i]), int(self.bstarts[i + 1])
+        w0, w1 = int(self.wstarts[i]), int(self.wstarts[i + 1])
+        p0, p1 = int(self.pstarts[i]), int(self.pstarts[i + 1])
+        pl = PostingList()
+        pl.base_ts = int(self.base_ts[i])
+        pl.base_packed = packed.PackedUidList(
+            int(self.counts[i]), self.bfirst[b0:b1], self.blast[b0:b1],
+            self.bcount[b0:b1], self.bwidth[b0:b1], self.boff[b0:b1],
+            self.words[w0:w1])
+        if p1 > p0:
+            pl.base_postings = {
+                p.uid: p for p in map(
+                    posting_from_json,
+                    json.loads(bytes(self.post_blob[p0:p1])))}
+        pl._seg_ts = pl.base_ts      # eviction safety marker
+        return pl
+
+
+class LazyLists(dict):
+    """store.lists in paged mode: a plain dict of materialized lists whose
+    misses fall through to the snapshot segments. Mutation paths write
+    through normal dict assignment; eviction drops CLEAN entries (the
+    segment row can reproduce them exactly)."""
+
+    def __init__(self, store: "Store") -> None:
+        super().__init__()
+        self._store = store
+
+    def get(self, kb, default=None):
+        pl = super().get(kb)
+        if pl is None:
+            pl = self._store._materialize(kb)
+        return pl if pl is not None else default
+
+    def __getitem__(self, kb):
+        pl = self.get(kb)
+        if pl is None:
+            raise KeyError(kb)
+        return pl
+
+    def __contains__(self, kb) -> bool:
+        return super().__contains__(kb) or \
+            self._store._segment_find(kb) is not None
+
+
 def _key_bytes(k) -> bytes:
     return k if isinstance(k, (bytes, bytearray)) else base64.b64decode(k)
 
@@ -229,9 +344,21 @@ def decode_record(raw: bytes) -> dict:
 class Store:
     """One group's posting store (the `pstore` of a server node)."""
 
-    def __init__(self, dirpath: str | None = None) -> None:
+    def __init__(self, dirpath: str | None = None,
+                 memory_budget: int | None = None) -> None:
+        """memory_budget (bytes): enables PAGED mode — the snapshot is
+        mmap'd, posting lists materialize lazily per key, and clean lists
+        are evicted once the resident estimate exceeds the budget. The
+        badger-LSM role: the dataset no longer has to fit in host RAM."""
         self.dir = dirpath
-        self.lists: dict[bytes, PostingList] = {}
+        self.paged = memory_budget is not None
+        self.memory_budget = int(memory_budget or 0)
+        self._segments: dict[tuple[int, str], SegmentRun] = {}
+        self._touched: set[tuple[int, str]] = set()   # tablets with writes
+        self._lazy_bytes = 0           # resident estimate (paged mode)
+        self._evict_tick = 0
+        self.lists: dict[bytes, PostingList] = \
+            LazyLists(self) if self.paged else {}
         self.by_pred: dict[tuple[int, str], set[bytes]] = {}
         self.schema = SchemaState()
         self.dirty: set[bytes] = set()
@@ -276,21 +403,164 @@ class Store:
 
     def _drop_packed(self, kind: int, attr: str) -> None:
         """Invalidate the cold-open fold cache for one tablet (any write
-        breaks the contiguous-and-pure contract of TabletPacked)."""
+        breaks the contiguous-and-pure contract of TabletPacked — and the
+        paged bulk fold's pristine-segment assumption)."""
         if self._packed_tablets:
             self._packed_tablets.pop((kind, attr), None)
+        if self._segments:
+            self._touched.add((kind, attr))
 
     def packed_tablet(self, kind: int, attr: str) -> TabletPacked | None:
         return self._packed_tablets.get((kind, attr))
+
+    def _purge_cached(self, kind: int, attr: str) -> None:
+        """Drop materialized segment-backed lists of a dropped tablet —
+        they never entered by_pred, so the by_pred purge misses them."""
+        if not self.paged:
+            return
+        for kb in [k for k in dict.keys(self.lists)
+                   if K.kind_attr_of(k) == (kind, attr)]:
+            pl = dict.pop(self.lists, kb, None)
+            if pl is not None:
+                self._lazy_bytes -= pl.approx_bytes()
+        self._lazy_bytes = max(self._lazy_bytes, 0)
+
+    # -- paged mode (segments + lazy lists + eviction) ----------------------
+
+    def _segment_find(self, kb: bytes):
+        if not self._segments:
+            return None
+        seg = self._segments.get(K.kind_attr_of(kb))
+        if seg is None:
+            return None
+        i = seg.find(kb)
+        return (seg, i) if i >= 0 else None
+
+    def _materialize(self, kb: bytes, cache: bool = True):
+        """Build a PostingList from its snapshot segment row; None when the
+        key has no segment backing. Cached copies count toward the resident
+        estimate and are evictable while clean."""
+        hit = self._segment_find(kb)
+        if hit is None:
+            return None
+        seg, i = hit
+        pl = seg.build_list(i)
+        if cache:
+            dict.__setitem__(self.lists, kb, pl)
+            self._lazy_bytes += pl.approx_bytes()
+            self._evict_tick += 1
+            if self._evict_tick >= 512:
+                self._evict_tick = 0
+                self._evict_clean()
+        return pl
+
+    def _evict_clean(self) -> None:
+        """Drop clean segment-backed lists until under budget. Clean =
+        reproducible from the segment row bit-for-bit: no layers, no
+        uncommitted txns, base untouched since materialization, not dirty.
+        Readers holding a reference keep a valid object (drop only unlinks
+        from the map — the read-through contract of posting/lists.go)."""
+        if self.memory_budget <= 0 or self._lazy_bytes <= self.memory_budget:
+            return
+        import sys
+
+        target = int(self.memory_budget * 0.8)
+        for kb, pl in list(self.lists.items()):
+            if self._lazy_bytes <= target:
+                break
+            if (getattr(pl, "_seg_ts", None) == pl.base_ts
+                    and not pl.layers and not pl.uncommitted
+                    and kb not in self.dirty):
+                # a writer may hold this object between Store.get and its
+                # add_mutation: external references (> the 4 we create:
+                # dict slot, items() snapshot, loop var, getrefcount arg)
+                # mean in-flight use — skip
+                if sys.getrefcount(pl) > 4:
+                    continue
+                dict.pop(self.lists, kb, None)
+                if pl.layers or pl.uncommitted or kb in self.dirty:
+                    # lost the race after all: reinstate, never drop a write
+                    dict.__setitem__(self.lists, kb, pl)
+                    continue
+                self._lazy_bytes -= pl.approx_bytes()
+        self._lazy_bytes = max(self._lazy_bytes, 0)
+
+    def segment_max_uid(self, uid_typed_fn, slot_bits: int) -> int:
+        """Max uid across segment-backed rows without materializing them
+        (paged-mode uid-lease recovery): subject uids from each run's last
+        key, object uids from packed block_last metadata. Rows whose
+        metadata is slot-tagged (>= slot_bits: value postings) decode
+        transiently — the max REAL uid hides below the slots."""
+        m = 0
+        for (kind, attr), seg in self._segments.items():
+            if kind not in (int(K.KeyKind.DATA), int(K.KeyKind.REVERSE)) \
+                    or seg.n == 0:
+                continue
+            m = max(m, K.uid_of(seg.key_at(seg.n - 1)))
+            if kind != int(K.KeyKind.DATA) or not uid_typed_fn(attr):
+                continue
+            bl = np.asarray(seg.blast)
+            if len(bl) == 0:
+                continue
+            mx = int(bl.max())
+            if mx < slot_bits:
+                m = max(m, mx)
+                continue
+            # per-row last-block max (vectorized): decode ONLY slot-tagged
+            # rows — one tagged list must not force an O(edges) scan
+            nz = np.flatnonzero(np.asarray(seg.nbs) > 0)
+            row_last = bl[np.asarray(seg.bstarts)[nz + 1] - 1]
+            clean = row_last < slot_bits
+            if clean.any():
+                m = max(m, int(row_last[clean].max()))
+            for i in nz[~clean].tolist():   # tagged rows: transient decode
+                pl = seg.build_list(i)
+                u = pl.uids(max(self.max_seen_commit_ts, pl.base_ts))
+                real = u[u < slot_bits]
+                if len(real):
+                    m = max(m, int(real[-1]))
+        return m
+
+    def tablet_lists(self, kind: int, attr: str,
+                     kbs: list[bytes]) -> list:
+        """PostingLists for a whole tablet scan (fold paths). Paged mode
+        with no post-snapshot writes on the tablet serves the segment rows
+        IN ORDER — transient objects, no per-key search, no cache churn;
+        any other shape falls back to per-key lookup."""
+        seg = self._segments.get((kind, attr))
+        if (seg is not None and seg.n == len(kbs)
+                and (kind, attr) not in self._touched
+                and not self.by_pred.get((kind, attr))):
+            return [seg.build_list(i) for i in range(seg.n)]
+        return [self.lists.get(kb) for kb in kbs]
+
+    def iter_all_keys(self):
+        """Every key: segment-backed plus materialized/new — globally
+        sorted (checkpoint's stable write order)."""
+        extra = set(dict.keys(self.lists))
+        if not self._segments:
+            return sorted(extra)
+        seen = set()
+        for seg in self._segments.values():
+            seen.update(seg.iter_keys())
+        return sorted(seen | extra)
 
     def get_no_store(self, key: K.Key) -> PostingList | None:
         """Read-only peek (reference posting/lists.go GetNoStore :274)."""
         return self.lists.get(key.encode())
 
     def keys_of(self, kind: K.KeyKind, attr: str) -> list[bytes]:
-        """All keys of one (kind, predicate) — a tablet scan."""
+        """All keys of one (kind, predicate) — a tablet scan. Paged mode
+        merges the snapshot segment's keys (not resident in by_pred) with
+        keys created by later writes."""
         with self._lock:
-            return sorted(self.by_pred.get((int(kind), attr), ()))
+            extra = self.by_pred.get((int(kind), attr), ())
+            seg = self._segments.get((int(kind), attr))
+            if seg is None:
+                return sorted(extra)
+            if not extra:
+                return list(seg.iter_keys())   # already sorted
+            return sorted(set(seg.iter_keys()) | set(extra))
 
     def memory_stats(self) -> dict:
         """Approximate host memory held by posting lists (the accounting
@@ -302,12 +572,19 @@ class Store:
         for pl in pls:
             total += pl.approx_bytes()
             layers += pl.layer_count()
-        return {"bytes": total, "lists": len(pls), "layers": layers}
+        out = {"bytes": total, "lists": len(pls), "layers": layers}
+        if self.paged:
+            out["paged"] = True
+            out["segment_keys"] = sum(s.n for s in self._segments.values())
+        return out
 
     def predicates(self) -> list[str]:
         with self._lock:
-            return sorted({attr for (kind, attr) in self.by_pred
-                           if kind == int(K.KeyKind.DATA)})
+            out = {attr for (kind, attr) in self.by_pred
+                   if kind == int(K.KeyKind.DATA)}
+            out |= {attr for (kind, attr) in self._segments
+                    if kind == int(K.KeyKind.DATA)}
+            return sorted(out)
 
     def tablet_sizes(self) -> dict[str, int]:
         """Approximate bytes served per predicate, across every key space it
@@ -384,17 +661,21 @@ class Store:
     def _drop_kind_mem(self, attr: str, kind: K.KeyKind) -> None:
         with self._lock:
             self._drop_packed(int(kind), attr)
+            self._segments.pop((int(kind), attr), None)
             for kb in self.by_pred.pop((int(kind), attr), set()):
                 self.lists.pop(kb, None)
                 self.dirty.discard(kb)
+            self._purge_cached(int(kind), attr)
 
     def _delete_predicate_mem(self, attr: str) -> None:
         with self._lock:
             for kind in list(K.KeyKind):
                 self._drop_packed(int(kind), attr)
+                self._segments.pop((int(kind), attr), None)
                 for kb in self.by_pred.pop((int(kind), attr), set()):
                     self.lists.pop(kb, None)
                     self.dirty.discard(kb)
+                self._purge_cached(int(kind), attr)
             self.schema.delete(attr)
 
     # -- bulk ingest ---------------------------------------------------------
@@ -643,11 +924,19 @@ class Store:
                 "max_commit_ts": self.max_seen_commit_ts}
         mb = json.dumps(meta).encode()
         f.write(_U32.pack(len(mb)) + mb)
-        keys = sorted(self.lists)
+        keys = self.iter_all_keys() if self.paged else sorted(self.lists)
         pls = []
         for kb in keys:
-            pl = self.lists[kb]
-            pl.rollup(upto_ts)
+            pl = dict.get(self.lists, kb)
+            if pl is None:         # paged: transient, not cached — a
+                pl = self._materialize(kb, cache=False)   # checkpoint must
+            had_fold = any(l.commit_ts <= upto_ts for l in pl.layers)
+            pl.rollup(upto_ts)     # not blow the memory budget
+            if not had_fold and hasattr(pl, "_seg_ts"):
+                # content unchanged (only the watermark moved): keep the
+                # list evictable, or the first checkpoint would pin every
+                # resident list for the life of the process
+                pl._seg_ts = pl.base_ts
             pls.append(pl)
         N = len(keys)
         f.write(_U32.pack(N))
@@ -682,12 +971,21 @@ class Store:
     def _load(self) -> None:
         snap = os.path.join(self.dir, "snapshot.bin")
         if os.path.exists(snap):
-            with open(snap, "rb") as f:
-                raw = f.read()
-            if raw[:5] == b"DGTS2":
-                self._load_v2(raw)
+            if self.paged and os.path.getsize(snap) > 5:
+                # mmap: columns become file-backed views the OS pages in
+                # and out — the dataset no longer has to fit in RAM
+                raw = np.memmap(snap, dtype=np.uint8, mode="r")
+                if bytes(raw[:5]) == b"DGTS2":
+                    self._load_v2(raw)
+                else:
+                    self._load_v1(bytes(raw))     # legacy format: eager
             else:
-                self._load_v1(raw)
+                with open(snap, "rb") as f:
+                    raw = f.read()
+                if raw[:5] == b"DGTS2":
+                    self._load_v2(raw)
+                else:
+                    self._load_v1(raw)
         self._replay_wal(os.path.join(self.dir, "wal.log"))
 
     def _load_v2(self, raw: bytes) -> None:
@@ -696,7 +994,7 @@ class Store:
         off += 8
         (n,) = _U32.unpack_from(raw, off)
         off += 4
-        meta = json.loads(raw[off : off + n])
+        meta = json.loads(bytes(raw[off : off + n]))
         off += n
         for e in parse_schema(meta.get("schema", "")):
             self.schema.set(e)
@@ -704,18 +1002,29 @@ class Store:
         (N,) = _U32.unpack_from(raw, off)
         off += 4
 
+        paged = self.paged and isinstance(raw, np.memmap)
+
         def col(dt):
             nonlocal off
             (blen,) = struct.unpack_from("<Q", raw, off)
             off += 8
-            # per-column copy: a view into `raw` would pin the ENTIRE
-            # snapshot bytes for as long as any single list survives
-            arr = np.frombuffer(raw[off: off + blen], dtype=dt)
+            if paged:
+                # file-backed view: the OS pages it; nothing is pinned in
+                # anonymous memory. Downcast to plain ndarray (same buffer,
+                # the mmap stays alive via .base): every later slice of a
+                # memmap subclass pays ~2us of __array_finalize__, and the
+                # fold slices these millions of times
+                arr = raw[off: off + blen].view(dt).view(np.ndarray)
+            else:
+                # per-column copy: a view into `raw` would pin the ENTIRE
+                # snapshot bytes for as long as any single list survives
+                arr = np.frombuffer(raw[off: off + blen], dtype=dt)
             off += blen
             return arr
 
         key_lens = col(np.uint32)
-        keys_blob = col(np.uint8).tobytes()
+        keys_blob_arr = col(np.uint8)
+        keys_blob = keys_blob_arr if paged else keys_blob_arr.tobytes()
         base_ts = col(np.uint64)
         counts = col(np.uint32)
         nblocks = col(np.uint32)
@@ -727,7 +1036,8 @@ class Store:
         word_lens = col(np.uint64)
         words = col(np.uint32)
         post_lens = col(np.uint32)
-        post_blob = col(np.uint8).tobytes()
+        post_blob_arr = col(np.uint8)
+        post_blob = post_blob_arr if paged else post_blob_arr.tobytes()
 
         kends = np.cumsum(key_lens)
         bends = np.cumsum(nblocks.astype(np.int64))
@@ -742,15 +1052,41 @@ class Store:
         wstarts = wends - word_lens.astype(np.int64)
         bstarts = bends - nblocks.astype(np.int64)
 
+        pstarts = pends - post_lens.astype(np.int64)
+        kstarts = kends - key_lens.astype(np.int64)
+
         def flush_run(end: int) -> None:
             if run_key is None or end <= run_start:
                 return
             r0, r1 = run_start, end
             bb0, bb1 = int(bstarts[r0]), int(bends[r1 - 1])
             ww0, ww1 = int(wstarts[r0]), int(wends[r1 - 1])
+            if paged:
+                # paged mode: the lazy-materialization segment (ALL kinds)
+                pp0, pp1 = int(pstarts[r0]), int(pends[r1 - 1])
+                kk0, kk1 = int(kstarts[r0]), int(kends[r1 - 1])
+                bst = np.concatenate(
+                    [bstarts[r0:r1] - bb0, [bb1 - bb0]]).astype(np.int64)
+                wst = np.concatenate(
+                    [wstarts[r0:r1] - ww0, [ww1 - ww0]]).astype(np.int64)
+                pst = np.concatenate(
+                    [pstarts[r0:r1] - pp0, [pp1 - pp0]]).astype(np.int64)
+                self._segments[run_key] = SegmentRun(
+                    n=r1 - r0,
+                    uid_keyed=run_key[0] in (int(K.KeyKind.DATA),
+                                             int(K.KeyKind.REVERSE)),
+                    keys_blob=keys_blob_arr[kk0:kk1],
+                    kends=(kends[r0:r1] - kk0).astype(np.int64),
+                    base_ts=base_ts[r0:r1], counts=counts[r0:r1],
+                    nbs=nblocks[r0:r1], bstarts=bst, wstarts=wst,
+                    pstarts=pst,
+                    bfirst=bfirst[bb0:bb1], blast=blast[bb0:bb1],
+                    bcount=bcount[bb0:bb1], bwidth=bwidth[bb0:bb1],
+                    boff=boff[bb0:bb1], words=words[ww0:ww1],
+                    post_blob=post_blob_arr[pp0:pp1])
             if run_key[0] not in (int(K.KeyKind.DATA),
                                   int(K.KeyKind.REVERSE)):
-                return       # only uid-edge tablets consult the cache
+                return       # only uid-edge tablets consult the fold cache
             self._packed_tablets[run_key] = TabletPacked(
                 n=r1 - r0,
                 counts=counts[r0:r1].astype(np.int64),
@@ -766,21 +1102,24 @@ class Store:
         for i in range(N):
             k1, b1 = int(kends[i]), int(bends[i])
             w1, p1 = int(wends[i]), int(pends[i])
-            kb = keys_blob[k0:k1]
-            pl = PostingList()
-            pl.base_ts = int(base_ts[i])
-            # zero-copy slices of the shared (read-only) buffers: packed
-            # bases are immutable — rollup REPLACES base_packed wholesale
-            pl.base_packed = packed.PackedUidList(
-                int(counts[i]), bfirst[b0:b1], blast[b0:b1], bcount[b0:b1],
-                bwidth[b0:b1], boff[b0:b1], words[w0:w1])
-            if p1 > p0:
-                pl.base_postings = {
-                    p.uid: p for p in map(posting_from_json,
-                                          json.loads(post_blob[p0:p1]))}
+            kb = bytes(keys_blob[k0:k1]) if paged else keys_blob[k0:k1]
             kind, attr = K.kind_attr_of(kb)
-            self.lists[kb] = pl
-            self.by_pred.setdefault((kind, attr), set()).add(kb)
+            if not paged:
+                pl = PostingList()
+                pl.base_ts = int(base_ts[i])
+                # zero-copy slices of the shared (read-only) buffers: packed
+                # bases are immutable — rollup REPLACES base_packed wholesale
+                pl.base_packed = packed.PackedUidList(
+                    int(counts[i]), bfirst[b0:b1], blast[b0:b1],
+                    bcount[b0:b1], bwidth[b0:b1], boff[b0:b1], words[w0:w1])
+                if p1 > p0:
+                    pl.base_postings = {
+                        p.uid: p for p in map(posting_from_json,
+                                              json.loads(post_blob[p0:p1]))}
+                self.lists[kb] = pl
+                self.by_pred.setdefault((kind, attr), set()).add(kb)
+            # paged: keys stay in the segment — no per-key object, no
+            # per-key registry entry (the LSM role: RAM ∝ touched keys)
             if (kind, attr) != run_key:
                 flush_run(i)
                 run_key, run_start = (kind, attr), i

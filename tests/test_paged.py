@@ -1,0 +1,153 @@
+"""Paged (spill-to-disk) posting store (VERDICT r4 #4 — badger's LSM role):
+the snapshot is mmap'd, posting lists materialize lazily per key, clean
+lists evict under the memory budget, and every query path stays correct —
+including writes on top of segment-backed keys, checkpoint round-trips,
+and uid-lease recovery without materialization."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage.store import Store
+
+
+def _build_dataset(tmp_path, n=400):
+    """An eager Node writes + checkpoints a dataset, then closes."""
+    d = str(tmp_path / "p")
+    node = Node(dirpath=d)
+    node.alter(schema_text="name: string @index(exact) .\n"
+                           "age: int @index(int) .\nfriend: [uid] .")
+    rng = np.random.default_rng(11)
+    quads = []
+    for i in range(1, n + 1):
+        quads.append(f'<0x{i:x}> <name> "p{i}" .')
+        quads.append(f'<0x{i:x}> <age> "{20 + i % 50}"^^<xs:int> .')
+        for _ in range(3):
+            t = int(rng.integers(1, n + 1))
+            quads.append(f"<0x{i:x}> <friend> <0x{t:x}> .")
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+    node.store.checkpoint(node.store.max_seen_commit_ts)
+    node.close()
+    return d
+
+
+QUERIES = [
+    '{ q(func: eq(name, "p7")) { name age friend { name } } }',
+    '{ q(func: ge(age, 60), orderasc: name, first: 5) { name age } }',
+    '{ q(func: uid(0x1)) @recurse(depth: 2) { friend } }',
+    '{ q(func: has(friend)) { count(uid) } }',
+]
+
+
+def test_paged_node_matches_eager(tmp_path):
+    d = _build_dataset(tmp_path)
+    eager = Node(dirpath=d)
+    outs_e = [eager.query(q)[0] for q in QUERIES]
+    eager.close()
+
+    paged = Node(dirpath=d, memory_mb=64)
+    assert paged.store.paged and paged.store._segments
+    outs_p = [paged.query(q)[0] for q in QUERIES]
+    for a, b in zip(outs_e, outs_p):
+        assert json.dumps(a, sort_keys=True, default=str) == \
+            json.dumps(b, sort_keys=True, default=str)
+    paged.close()
+
+
+def test_paged_lazy_and_eviction(tmp_path):
+    d = _build_dataset(tmp_path)
+    s = Store(d, memory_budget=1)      # 1 byte: evict everything clean
+    assert s.paged
+    seg_keys = sum(seg.n for seg in s._segments.values())
+    assert seg_keys > 400
+    assert len(dict.keys(s.lists)) == 0        # nothing materialized yet
+
+    kb = K.data_key("friend", 3).encode()
+    pl = s.lists.get(kb)
+    assert pl is not None and pl.base_packed.count >= 1
+    # repeated materializations trigger eviction back under budget
+    for u in range(1, 300):
+        s.lists.get(K.data_key("friend", u).encode())
+    s._evict_clean()
+    assert len(dict.keys(s.lists)) < 300
+    # re-access after eviction reproduces the same content
+    pl2 = s.lists.get(kb)
+    np.testing.assert_array_equal(pl2.uids(10), pl.uids(10))
+    s.close()
+
+
+def test_paged_write_then_read_and_checkpoint(tmp_path):
+    d = _build_dataset(tmp_path)
+    node = Node(dirpath=d, memory_mb=64)
+    # a write on top of a segment-backed key merges with its base
+    node.mutate(set_nquads="<0x3> <friend> <0x190> .", commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x3)) { friend { uid } } }')
+    uids = {x["uid"] for x in out["q"][0]["friend"]}
+    assert "0x190" in uids and len(uids) >= 2   # old base edges survive
+
+    # new blank-node writes: uid lease recovered WITHOUT materialization
+    node.mutate(set_nquads='_:n <name> "fresh" .', commit_now=True)
+    out, _ = node.query('{ q(func: eq(name, "fresh")) { uid name } }')
+    new_uid = int(out["q"][0]["uid"], 16)
+    assert new_uid > 400       # never collides with segment-backed uids
+
+    # checkpoint under paging: transient materialization, then reopen
+    node.store.checkpoint(node.store.max_seen_commit_ts)
+    node.close()
+    node2 = Node(dirpath=d, memory_mb=64)
+    out, _ = node2.query('{ q(func: uid(0x3)) { friend { uid } } }')
+    assert "0x190" in {x["uid"] for x in out["q"][0]["friend"]}
+    out, _ = node2.query('{ q(func: eq(name, "fresh")) { name } }')
+    assert out["q"][0]["name"] == "fresh"
+    node2.close()
+
+
+def test_paged_delete_predicate_drops_segment(tmp_path):
+    d = _build_dataset(tmp_path)
+    s = Store(d, memory_budget=1 << 20)
+    assert (int(K.KeyKind.DATA), "friend") in s._segments
+    s.delete_predicate("friend")
+    assert (int(K.KeyKind.DATA), "friend") not in s._segments
+    assert s.lists.get(K.data_key("friend", 3).encode()) is None
+    assert "friend" not in s.predicates()
+    s.close()
+
+
+def test_paged_memory_stays_bounded(tmp_path):
+    """The done-gate shape in miniature: query battery under a cap far
+    below the dataset's eager resident size."""
+    d = _build_dataset(tmp_path, n=800)
+    eager = Store(d)
+    full_bytes = eager.memory_stats()["bytes"]
+    eager.close()
+
+    cap = full_bytes // 2
+    node = Node(dirpath=d, memory_mb=max(1, cap // (1 << 20)))
+    node.store.memory_budget = cap     # byte-precise for the assertion
+    for q in QUERIES:
+        node.query(q)
+    node.store._evict_clean()
+    stats = node.store.memory_stats()
+    assert stats["paged"]
+    assert stats["bytes"] <= cap, (stats, cap)
+    node.close()
+
+
+def test_paged_write_to_existing_value_key_visible(tmp_path):
+    """Review regression: a committed UPDATE to an existing segment-backed
+    VALUE key must appear in fold-built query results (the pristine bulk
+    fold must step aside once the tablet is touched)."""
+    d = _build_dataset(tmp_path)
+    node = Node(dirpath=d, memory_mb=64)
+    out, _ = node.query('{ q(func: uid(0x5)) { age } }')
+    old_age = out["q"][0]["age"]
+    node.mutate(set_nquads='<0x5> <age> "99"^^<xs:int> .', commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x5)) { age } }')
+    assert out["q"][0]["age"] == 99 != old_age
+    # index fold sees it too
+    out, _ = node.query('{ q(func: eq(age, 99)) { uid } }')
+    assert {x["uid"] for x in out["q"]} == {"0x5"}
+    node.close()
