@@ -94,6 +94,7 @@ def cmd_live(args) -> int:
             node.alter(schema_text=f.read())
     try:
         stats = live_load(node, args.files, batch=args.batch,
+                          xidmap_path=args.xidmap,
                           progress=lambda n: print(f"  {n} quads...",
                                                    flush=True))
     finally:
@@ -309,6 +310,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="durable posting dir (an in-memory load would be "
                          "discarded at exit)")
     lp.add_argument("--batch", type=int, default=1000)
+    lp.add_argument("--xidmap", default=None,
+                    help="crash-resumable identity log: re-running an "
+                         "interrupted load reuses already-assigned uids")
     lp.set_defaults(fn=cmd_live)
 
     wp = sub.add_parser("worker", help="serve one group's tablets over the "

@@ -28,16 +28,27 @@ class LiveStats:
 
 def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
               retries: int = 3, workers: int = 1,
-              xm: XidMap | None = None, progress=None) -> LiveStats:
-    """Stream RDF file(s) into a node as committed transactions."""
+              xm: XidMap | None = None, xidmap_path: str | None = None,
+              progress=None) -> LiveStats:
+    """Stream RDF file(s) into a node as committed transactions.
+
+    xidmap_path: crash-resumable identity log (xidmap/xidmap.go's
+    badger-persisted map, in append-log form) — assignments are fsynced
+    BEFORE each txn commits, so a re-run of an interrupted load reuses
+    every identity it had already assigned instead of minting duplicates.
+    """
     paths = [rdf_paths] if isinstance(rdf_paths, str) else list(rdf_paths)
-    xm = xm or XidMap(node.zero.uids)
+    own_xm = xm is None
+    if own_xm:
+        xm = (XidMap.open(xidmap_path, node.zero.uids) if xidmap_path
+              else XidMap(node.zero.uids))
     stats = LiveStats()
     pending: list = []
 
     def flush():
         if not pending:
             return
+        xm.sync()   # identities durable before the txn that uses them
         for attempt in range(retries + 1):
             try:
                 node.mutate_quads(pending, commit_now=True)
@@ -63,4 +74,6 @@ def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
             if progress and stats.quads % 100000 < batch:
                 progress(stats.quads)
     flush()
+    if own_xm:
+        xm.close()
     return stats

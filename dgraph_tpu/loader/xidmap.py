@@ -4,8 +4,14 @@ Reference semantics: xidmap/xidmap.go:30 — loaders map RDF node names
 (blank nodes, IRIs) to uids, leasing uid ranges from Zero; names that parse
 as uids ("0x2a", "123") pass through and advance the lease so later leased
 blocks can never collide. The reference shards an LRU over badger; here the
-map is an in-memory dict with JSON save/load (bulk outputs persist it next
-to the posting snapshot so a follow-up live load keeps identities).
+map is an in-memory dict with TWO durability modes:
+
+  - JSON save/load (bulk outputs persist it next to the posting snapshot
+    so a follow-up live load keeps identities), and
+  - an append-only assignment LOG (`wal_path`): every NEW mapping appends
+    one record, fsynced per live-load batch (`sync()`), and `open()`
+    replays it — a crashed live load RESUMES with every identity it had
+    already assigned (the reference's badger-persisted map, in log form).
 """
 
 from __future__ import annotations
@@ -33,6 +39,57 @@ class XidMap:
         self._taken: set[int] = set()   # explicit uids seen (never hand out)
         self._next = 0
         self._end = -1   # exhausted
+        self._wal = None   # set ONLY by open(): appending to an existing
+        # log without replaying it would mint divergent duplicate uids
+
+    @classmethod
+    def open(cls, wal_path: str, lease: UidLease,
+             block: int = LEASE_BLOCK) -> "XidMap":
+        """Crash-resumable map: replay the assignment log, then append.
+        A torn trailing record (crash mid-write) is dropped — its xid was
+        never acked, so the loader re-assigns it."""
+        xm = cls(lease, block)
+        if os.path.exists(wal_path):
+            with open(wal_path, "rb") as f:
+                raw = f.read()
+            # a record is durable only when newline-terminated: ANY
+            # unterminated tail is torn (a truncated uid still parses as
+            # a valid shorter number — parseability cannot detect it) and
+            # must be truncated away so the next append cannot fuse onto it
+            keep_upto = raw.rfind(b"\n") + 1
+            for line in raw[:keep_upto].split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    xid_b, uid_b = line.rsplit(b"\t", 1)
+                    xm._map[xid_b.decode("utf-8")] = int(uid_b)
+                except (ValueError, UnicodeDecodeError):
+                    continue         # unparseable complete line: skip
+            if keep_upto < len(raw):
+                with open(wal_path, "r+b") as f:
+                    f.truncate(keep_upto)
+            if xm._map:
+                lease.bump_to(max(xm._map.values()))
+        xm._wal = open(wal_path, "ab")
+        return xm
+
+    def _log(self, xid: str, uid: int) -> None:
+        if self._wal is not None:
+            self._wal.write(xid.encode("utf-8") + b"\t" +
+                            str(uid).encode() + b"\n")
+
+    def sync(self) -> None:
+        """Make all assignments so far durable (call per committed batch:
+        an identity must never be re-assigned after its txn was acked)."""
+        if self._wal is not None:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self.sync()
+            self._wal.close()
+            self._wal = None
 
     def uid(self, xid: str) -> int:
         u = self._map.get(xid)
@@ -47,7 +104,7 @@ class XidMap:
             self._taken.add(explicit)
             self._lease.bump_to(explicit)
             self._map[xid] = explicit
-            return explicit
+            return explicit          # literal uids need no log (stateless)
         while True:
             if self._next > self._end:
                 self._next, self._end = self._lease.assign(self._block)
@@ -56,6 +113,7 @@ class XidMap:
             if u not in self._taken:
                 break
         self._map[xid] = u
+        self._log(xid, u)
         return u
 
     def __len__(self) -> int:
