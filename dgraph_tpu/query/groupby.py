@@ -25,6 +25,10 @@ from dgraph_tpu.utils.types import TypeID, Val
 
 VECTORIZE = True    # tests flip to force the per-uid reference path
 
+# below this member count a vectorized HOST segmented reduction beats the
+# device dispatch's fixed + sync latency (~100-150 ms through the relay)
+_HOST_AGG_MAX = 1 << 17
+
 
 def process_groupby(ex, sg) -> None:
     """Fill sg.group_result for a level with @groupby."""
@@ -331,8 +335,11 @@ def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
         posc = np.clip(pos, 0, max(len(vuids) - 1, 0))
         hit = (len(vuids) > 0) & (vuids[posc] == flat)
         all_int = tids <= {TypeID.INT}
-        if all_int and np.abs(vals64).sum() < 2 ** 24:
-            # exact in f32: one fused device reduction
+        if (all_int and np.abs(vals64).sum() < 2 ** 24
+                and len(flat) > _HOST_AGG_MAX):
+            # exact in f32: one fused device reduction (only worth the
+            # fixed dispatch+sync cost above the host crossover — the
+            # same size-adaptive rule as task.HOST_EXPAND_MAX)
             x = np.where(hit, vals64[posc], np.nan).astype(np.float32)
             res = segs.group_reduce(op, seg_ids, x, ng)
         else:

@@ -7,13 +7,14 @@ expansion (expandOut :134-261); edge cost from a facet else 1.0 (getCost
 item; capped by QueryEdgeLimit returning ErrTooBig (:214); result
 materialized as a `_path_` block (:598).
 
-TPU shape: a single-predicate unweighted `shortest` runs FULLY ON DEVICE,
-size-adaptively — large CSRs through the Pallas BFS kernel
+TPU shape: a single-predicate unweighted `shortest` runs FULLY ON DEVICE —
+on TPU the Pallas BFS kernel covers the whole device range
 (ops/pallas_bfs.bfs_dist: the whole hop loop in one dispatch, bit-packed
-distance fetch, host predecessor walk), mid-size ones through
-ops/traversal.sssp edge relaxation (r4). Facet-weighted costs,
-multi-predicate blocks, child filters, and k-shortest keep the exact host
-path: the expansion there is still batched CSR expands per level.
+distance fetch, host predecessor walk); ops/traversal.sssp edge relaxation
+remains the device path for extreme depths (>= 254 hops) and for non-TPU
+backends/tests. Facet-weighted costs, multi-predicate blocks, child
+filters, and k-shortest keep the exact host path: the expansion there is
+still batched CSR expands per level.
 """
 
 from __future__ import annotations
@@ -97,12 +98,21 @@ DEVICE_SSSP_MIN_EDGES = 1 << 17
 SSSP_KERNEL_MIN: int | None = None
 
 
+_SSSP_KERNEL_MIN_TPU = 1 << 17   # == the device tier's default floor —
+# the kernel's bit-packed distance fetch (~Nd/8 bytes) beats Bellman-
+# Ford's dist+parent fetch (8 B/node) through the relay at every size the
+# device path serves. A SEPARATE constant: tests monkeypatch
+# DEVICE_SSSP_MIN_EDGES to force the sssp tier on tiny graphs, and the
+# kernel floor must not follow it down.
+
+
 def _sssp_kernel_min() -> int:
     if SSSP_KERNEL_MIN is not None:
         return SSSP_KERNEL_MIN
     import jax
 
-    return (1 << 20) if jax.default_backend() == "tpu" else (1 << 62)
+    return _SSSP_KERNEL_MIN_TPU if jax.default_backend() == "tpu" \
+        else (1 << 62)
 
 
 def _device_csr(ex, sg: SubGraph):
@@ -134,12 +144,12 @@ def _device_csr(ex, sg: SubGraph):
 
 def _device_shortest(attr: str, csr, src: int, dst: int, max_depth: int):
     """Unweighted single-source shortest path on device, parent chain
-    walked on host. Two tiers: large CSRs run the Pallas BFS kernel
-    (ops/pallas_bfs.bfs_dist — one dispatch for the whole hop loop,
-    bit-packed distance fetch); mid-size ones keep the Bellman-Ford
-    relaxation (ops/traversal.sssp). Work is bounded by iterations x E
-    (the resident CSR), so the reference's discovered-edge budget does not
-    apply here."""
+    walked on host. On TPU the Pallas BFS kernel serves the whole device
+    range (bfs_dist — one dispatch for the whole hop loop, bit-packed
+    distance fetch); the Bellman-Ford relaxation (ops/traversal.sssp)
+    serves extreme depths (>= 254) and non-TPU backends. Work is bounded
+    by iterations x E (the resident CSR), so the reference's
+    discovered-edge budget does not apply here."""
     from dgraph_tpu.ops import traversal
 
     from dgraph_tpu.ops.pallas_bfs import DIST_UNREACHED

@@ -157,3 +157,17 @@ def test_empty_groupby_keeps_dict_shape(node):
         gbmod.VECTORIZE = True
     assert json.dumps(out, sort_keys=True, default=str) == \
         json.dumps(ref, sort_keys=True, default=str)
+
+
+def test_device_aggregation_branch(node, monkeypatch):
+    """The f32 device segmented-reduction branch (taken in production only
+    above _HOST_AGG_MAX members) must stay golden-equal to the host one."""
+    monkeypatch.setattr(gbmod, "_HOST_AGG_MAX", 0)   # force device branch
+    q = ('{ q(func: has(name)) @groupby(genre) { count(uid) '
+         '  s: sum(val(ag)) m: max(val(ag)) } '
+         '  var(func: has(name)) { ag as age } }')
+    dev_out, _ = node.query(q)
+    monkeypatch.setattr(gbmod, "_HOST_AGG_MAX", 1 << 17)
+    host_out, _ = node.query(q)
+    assert json.dumps(dev_out, sort_keys=True, default=str) == \
+        json.dumps(host_out, sort_keys=True, default=str)
