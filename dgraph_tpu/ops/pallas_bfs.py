@@ -597,16 +597,10 @@ def _prefix_for(frontier_bits, stream, n_chunks: int):
                     frontier_bits)
 
 
-def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
-                   frontier_mask, seen, *, chunks: int, num_nodes: int,
-                   allow_loop: bool):
-    """One recurse level: frontier mask (FULL uid space — multi-predicate
-    frontiers are not confined to this predicate's destinations) →
-    (dest_mask, traversed, seen', fresh). traversed counts EVERY out-edge of
-    every frontier node (the budget the reference charges, recurse.go:167);
-    fresh marks first-traversal edges; dest = nodes with >= 1 fresh in-edge."""
-    fbits = jnp.take(frontier_mask, subjects)              # [Ns] rank space
-    prefix = _prefix_for(fbits, in_src_pad, chunks)
+def _recurse_tail(prefix, in_iptr_rank, seen, allow_loop: bool):
+    """Shared prefix→(reached_d, traversed, seen', fresh) tail: edge-dedup
+    plus the bounds-diff reachability (the exactness-critical piece, kept
+    in ONE place for the fused and stepped paths alike)."""
     traversed = prefix[-1]
     prev = jnp.concatenate([jnp.zeros((1,), jnp.int32), prefix[:-1]])
     active = (prefix - prev) > 0                           # bool[E_pad]
@@ -619,6 +613,28 @@ def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
     bounds = jnp.take(freshp, in_iptr_rank - 1, mode="clip")
     bounds = jnp.where(in_iptr_rank == 0, 0, bounds)
     reached = (bounds[1:] - bounds[:-1]) > 0               # [Nd]
+    return reached, traversed, seen2, fresh
+
+
+def _recurse_level_core(fbits, stream, n_chunks: int, in_iptr_rank, seen,
+                        allow_loop: bool):
+    """One recurse level in RANK space: frontier bits (in the stream's
+    source-ID space) → (reached_d [Nd], traversed, seen', fresh).
+    traversed counts EVERY out-edge of every frontier node (the budget the
+    reference charges, recurse.go:167); fresh marks first-traversal edges;
+    reached_d = dst ranks with >= 1 fresh in-edge."""
+    prefix = _prefix_for(fbits, stream, n_chunks)
+    return _recurse_tail(prefix, in_iptr_rank, seen, allow_loop)
+
+
+def _recurse_level(in_src_pad, in_iptr_rank, subjects, in_subjects,
+                   frontier_mask, seen, *, chunks: int, num_nodes: int,
+                   allow_loop: bool):
+    """Full-uid-space recurse level (stepped path: multi-predicate
+    frontiers are not confined to this predicate's destinations)."""
+    fbits = jnp.take(frontier_mask, subjects)              # [Ns] rank space
+    reached, traversed, seen2, fresh = _recurse_level_core(
+        fbits, in_src_pad, chunks, in_iptr_rank, seen, allow_loop)
     dest_mask = jnp.zeros((num_nodes,), bool).at[in_subjects].set(
         reached, mode="drop")
     return dest_mask, traversed, seen2, fresh
@@ -750,29 +766,44 @@ def shortest_bfs(g: PullGraph, src: int, dst: int, max_hops: int):
     return path[::-1]
 
 
-@partial(jax.jit, static_argnames=("depth", "chunks", "num_nodes",
+@partial(jax.jit, static_argnames=("depth", "chunks", "chunks_d",
                                    "allow_loop"))
-def recurse_fused(in_src_pad, in_iptr_rank, subjects, in_subjects,
-                  seeds_mask, *, depth: int, chunks: int,
-                  num_nodes: int, allow_loop: bool):
+def recurse_fused(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
+                  in_subjects, seeds_mask, *, depth: int, chunks: int,
+                  chunks_d: int, allow_loop: bool):
     """All `depth` levels in ONE dispatch (lax.scan): no host round-trip —
-    and no relay sync — between levels. Returns stacked per-level
-    (dest_words [D,Cn*8,128] BIT-PACKED — the host fetches these every
-    query and the relay moves ~6-8 MB/s, so packed is 8x cheaper;
-    traversed [D]; fresh [D,E_pad] bools that STAY on device until a lazy
-    uidMatrix materialization packs+fetches them). Only for the
+    and no relay sync — between levels. Single-predicate shape, so levels
+    >= 2 stay entirely in DST-RANK space (a recurse frontier is the
+    previous level's fresh destinations): no full-uid scatter, no src-rank
+    remap gather, and the bitmap pack runs over the compressed rank space
+    (the same dual-space trick as the BFS kernel's mask_hop).
+
+    Returns stacked per-level (dest_words [D,Cd*8,128] BIT-PACKED
+    DST-RANK masks — the host fetches these every query and the relay
+    moves ~6-8 MB/s, so packed-and-rank-compressed is the cheapest wire
+    form; traversed [D]; fresh [D,E_pad] bools that STAY on device until
+    a lazy uidMatrix materialization packs+fetches them). Only for the
     single-uid-child no-filter recurse shape (the common + benchmarked
     one); anything needing host logic between levels uses recurse_step."""
+    nd = in_subjects.shape[0]
 
-    def body(carry, _):
-        mask, seen = carry
-        dest, trav, seen2, fresh = _recurse_level(
-            in_src_pad, in_iptr_rank, subjects, in_subjects, mask, seen,
-            chunks=chunks, num_nodes=num_nodes, allow_loop=allow_loop)
-        dest_p = pack_words(dest, pack_chunks(num_nodes))
-        return (dest, seen2), (dest_p, trav, fresh)
+    def body(carry, i):
+        fresh_d, seen = carry
+        # hop 1 reads seed bits in src-rank space; hops >= 2 read the
+        # previous level's fresh dst-rank mask against the dst-rank stream
+        prefix = lax.cond(
+            i == 0,
+            lambda _: _prefix_for(jnp.take(seeds_mask, subjects),
+                                  in_src_pad, chunks),
+            lambda _: _prefix_for(fresh_d, in_src_pad_d, chunks_d),
+            None)
+        reached, traversed, seen2, fresh = _recurse_tail(
+            prefix, in_iptr_rank, seen, allow_loop)
+        dest_p = pack_words(reached, pack_chunks(nd))
+        return (reached, seen2), (dest_p, traversed, fresh)
 
     seen0 = jnp.zeros((in_src_pad.shape[0],), dtype=bool)  # device-side alloc
+    fresh0 = jnp.zeros((nd,), dtype=bool)
     (_m, _s), (masks_p, trav, fresh) = lax.scan(
-        body, (seeds_mask, seen0), None, length=depth)
+        body, (fresh0, seen0), jnp.arange(depth), length=depth)
     return masks_p, trav, fresh

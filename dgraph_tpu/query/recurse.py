@@ -338,13 +338,15 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
     g = pb.pull_graph_for(csr)
     seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
     masks_p, trav, fresh = pb.recurse_fused(
-        g.in_src_pad, g.in_iptr_rank, g.subjects, g.in_subjects,
-        _seeds_mask(seeds, g.num_nodes),
-        depth=depth, chunks=g.chunks, num_nodes=g.num_nodes,
+        g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
+        g.in_subjects, _seeds_mask(seeds, g.num_nodes),
+        depth=depth, chunks=g.chunks, chunks_d=g.chunks_d,
         allow_loop=allow_loop)
-    # ONE relay round-trip for the whole traversal, bit-packed (fresh flags
-    # stay on device until a lazy uidMatrix materialization needs them)
+    # ONE relay round-trip for the whole traversal, bit-packed in DST-RANK
+    # space (fresh flags stay on device until a lazy uidMatrix
+    # materialization needs them); host maps ranks -> uids
     masks_h, trav_h = jax.device_get((masks_p, trav))
+    nd = len(g.host_in_subjects)
     shared_fresh = FreshFlags(fresh)
     frontier = seeds
     attach = sg.children = []
@@ -359,8 +361,8 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
         m = LazyRecurseMatrix(csr, g, frontier, shared_fresh, lvl, allow_loop)
         child.uid_matrix = m
         child.counts = LazyCounts(m)
-        child.dest_uids = np.flatnonzero(pb.unpack_words(
-            masks_h[lvl], g.num_nodes)).astype(np.int64)
+        ranks = np.flatnonzero(pb.unpack_words(masks_h[lvl], nd))
+        child.dest_uids = g.host_in_subjects[ranks].astype(np.int64)
         attach.append(child)
         attach = child.children
         frontier = child.dest_uids
