@@ -43,6 +43,72 @@ def _envelope_ok(data: dict, extensions: dict | None = None) -> bytes:
     return json.dumps(out).encode()
 
 
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>dgraph-tpu console</title>
+<style>
+ body{font:14px/1.4 system-ui,sans-serif;margin:0;display:flex;
+      flex-direction:column;height:100vh;background:#0f1115;color:#d8dee9}
+ header{padding:10px 16px;background:#171a21;display:flex;gap:12px;
+        align-items:center}
+ header b{color:#8fbcbb} header span{color:#616e88;font-size:12px}
+ main{flex:1;display:flex;min-height:0}
+ .col{flex:1;display:flex;flex-direction:column;min-width:0;padding:10px}
+ textarea{flex:1;background:#11141a;color:#d8dee9;border:1px solid #2e3440;
+          border-radius:6px;padding:10px;font:13px/1.45 monospace;
+          resize:none;outline:none}
+ pre{flex:1;overflow:auto;background:#11141a;border:1px solid #2e3440;
+     border-radius:6px;padding:10px;font:12px/1.4 monospace;margin:0}
+ .bar{display:flex;gap:8px;padding:8px 0}
+ button{background:#5e81ac;border:0;color:#fff;border-radius:5px;
+        padding:6px 14px;cursor:pointer}
+ button.alt{background:#3b4252}
+ .lat{color:#616e88;font-size:12px;align-self:center}
+</style></head><body>
+<header><b>dgraph-tpu</b><span>query console — POST /query /mutate /alter;
+GET /state /health /debug/vars</span></header>
+<main>
+ <div class="col">
+  <textarea id="q">{
+  # expand(_all_) shows whatever this server holds
+  q(func: has(name), first: 10) { uid expand(_all_) }
+}</textarea>
+  <div class="bar">
+   <button onclick="run('/query')">Run query</button>
+   <button class="alt" onclick="run('/mutate?commitNow=true')">Mutate</button>
+   <button class="alt" onclick="run('/alter')">Alter</button>
+   <button class="alt" onclick="get('/state')">State</button>
+   <button class="alt" onclick="get('/health')">Health</button>
+   <span class="lat" id="lat"></span>
+  </div>
+ </div>
+ <div class="col"><pre id="out">// results appear here</pre></div>
+</main>
+<script>
+async function show(r, t0){
+  const txt = await r.text();
+  document.getElementById('lat').textContent =
+      (performance.now()-t0).toFixed(0)+' ms';
+  try{document.getElementById('out').textContent =
+      JSON.stringify(JSON.parse(txt),null,2);}
+  catch(e){document.getElementById('out').textContent = txt;}
+}
+async function run(path){
+  const t0 = performance.now();
+  try{
+    const r = await fetch(path,{method:'POST',
+      headers:{'Content-Type':'application/graphql+-'},
+      body:document.getElementById('q').value});
+    await show(r, t0);
+  }catch(e){document.getElementById('out').textContent = 'error: '+e.message;}
+}
+async function get(path){
+  const t0 = performance.now();
+  try{await show(await fetch(path), t0);}
+  catch(e){document.getElementById('out').textContent = 'error: '+e.message;}
+}
+</script></body></html>""".encode("utf-8")
+
+
 def _envelope_err(code: str, message: str) -> bytes:
     return json.dumps(
         {"errors": [{"code": code, "message": message}]}).encode()
@@ -60,9 +126,10 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(n).decode("utf-8") if n else ""
 
-    def _send(self, status: int, body: bytes) -> None:
+    def _send(self, status: int, body: bytes,
+              ctype: str = "application/json") -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -92,6 +159,10 @@ class _Handler(BaseHTTPRequestHandler):
             # recent sampled request traces (net/trace /debug/requests)
             n = int(self._qs().get("n", "32"))
             self._send(200, json.dumps(self.node.traces.recent(n)).encode())
+        elif path in ("", "/ui"):
+            # embedded query console (reference: the static dashboard
+            # served by dgraph/cmd/server/dashboard.go)
+            self._send(200, _DASHBOARD_HTML, ctype="text/html")
         else:
             self._send(404, _envelope_err("ErrorInvalidRequest", "no such path"))
 
