@@ -23,6 +23,7 @@ except ImportError:              # pragma: no cover
     grpc = None
 
 from ..protos import internal_pb2 as ipb
+from ..utils.ballot import tally as _tally
 from .zero import TxnConflict, TxnNotFound, Zero
 
 SERVICE = "dgraph_tpu.internal.Zero"
@@ -213,13 +214,28 @@ class ZeroReplica:
     # -- leader side ---------------------------------------------------------
 
     def start(self) -> None:
+        from ..utils.ballot import BallotLoop
+
         # bootstrap only a FRESH cluster: a restarted idx-0 zero with a
         # persisted term may rejoin a cluster that elected past it — it
         # must campaign like anyone else, not self-promote into a
         # split-brain at a colliding term
         if self._bootstrap and self.term == 0:
             self._become_leader(1)
-        threading.Thread(target=self._loop, daemon=True).start()
+
+        def touch():
+            self._leader_contact = time.monotonic()
+
+        self._ballot = BallotLoop(
+            is_leader=lambda: self.is_leader,
+            send_pings=self._ping_round,
+            campaign=self._campaign,
+            leader_contact=lambda: self._leader_contact,
+            touch_contact=touch,
+            ping_s=self.PING_S,
+            timeout_range=self.ELECTION_TIMEOUT_S,
+            stop_event=self._stop)
+        self._ballot.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -266,6 +282,7 @@ class ZeroReplica:
                                              for g, a in reg.items()}
                 except (ValueError, OSError):
                     pass    # torn legacy file: workers re-register anyway
+            self._ping_fail_rounds = 0   # fresh leadership, fresh tolerance
             self.is_leader = True
 
     def _ship(self, state_json: str) -> None:
@@ -299,49 +316,32 @@ class ZeroReplica:
                 raise RuntimeError(
                     f"zero quorum lost ({acks}/{len(self.members)})")
 
-    def _loop(self) -> None:
-        import random
-
-        timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
-        last_ping = 0.0
-        while not self._stop.wait(0.1):
-            now = time.monotonic()
-            if self.is_leader:
-                if now - last_ping >= self.PING_S:
-                    last_ping = now
-                    acked = 1            # self
-                    for c in self._peer_clients():
-                        try:
-                            r = c.zero_ping(self.term, self.advertise,
-                                            self.members)
-                            if r.term <= self.term:
-                                acked += 1
-                            else:        # deposed: a newer term exists
-                                with self._lock:
-                                    self.term = int(r.term)
-                                    self.is_leader = False
-                                    self._save_meta()
-                                break
-                        except Exception:
-                            pass
-                    if acked < len(self.members) // 2 + 1:
-                        self._ping_fail_rounds += 1
-                        if self._ping_fail_rounds >= 3:
-                            # partitioned from the quorum: stop deciding —
-                            # two live oracles must never coexist (the
-                            # worker path's NoQuorum step-down, for pings)
-                            with self._lock:
-                                self.is_leader = False
-                    else:
-                        self._ping_fail_rounds = 0
-                continue
-            if now - self._leader_contact > timeout:
-                try:
-                    self._campaign()
-                except Exception:
-                    pass     # the loop must survive any campaign failure
-                timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
-                self._leader_contact = time.monotonic()
+    def _ping_round(self) -> None:
+        """One leader ping fan-out with quorum tracking: a partitioned
+        leader must stop deciding — two live oracles must never coexist
+        (the worker path's NoQuorum step-down, applied to pings)."""
+        acked = 1                    # self
+        for c in self._peer_clients():
+            try:
+                r = c.zero_ping(self.term, self.advertise, self.members)
+                if r.term <= self.term:
+                    acked += 1
+                else:                # deposed: a newer term exists
+                    with self._lock:
+                        self.term = int(r.term)
+                        self.is_leader = False
+                        self._save_meta()
+                    self._ping_fail_rounds = 0
+                    return
+            except Exception:
+                pass
+        if not _tally(acked, len(self.members)):
+            self._ping_fail_rounds += 1
+            if self._ping_fail_rounds >= 3:
+                with self._lock:
+                    self.is_leader = False
+        else:
+            self._ping_fail_rounds = 0
 
     def _campaign(self) -> None:
         others = [a for a in self.members if a != self.advertise]
@@ -365,7 +365,7 @@ class ZeroReplica:
                     return
             except Exception:
                 pass
-        if votes >= len(self.members) // 2 + 1:
+        if _tally(votes, len(self.members)):
             with self._lock:
                 if self.term == t:
                     self._become_leader(t)

@@ -25,6 +25,7 @@ except ImportError:              # pragma: no cover
     grpc = None
 
 from ..protos import internal_pb2 as ipb
+from ..utils.ballot import tally as _tally
 from ..query.task import TaskQuery, TaskResult, process_task
 from ..storage.csr_build import STRUCTURAL_RECORDS
 from ..storage.store import decode_record
@@ -212,7 +213,7 @@ class WorkerService:
         self.group_members: list[str] = []
         self._leader_contact = 0.0
         self._election_stop = threading.Event()
-        self._election_thread: threading.Thread | None = None
+        self._election_thread = None   # utils.ballot.BallotLoop | None
 
     def _set_term(self, term: int) -> None:
         self.term = term
@@ -509,39 +510,37 @@ class WorkerService:
             return ipb.HeartbeatResponse(term=self.term, ok=True)
 
     def enable_elections(self) -> None:
-        """Start the failure detector / heartbeat loop (one thread: leaders
-        ping, followers campaign on silence). Requires advertise_addr."""
+        """Start the failure detector / heartbeat loop (the shared
+        BallotLoop driver: leaders ping, followers campaign on silence).
+        Requires advertise_addr."""
+        from ..utils.ballot import BallotLoop
+
         if self._election_thread is not None:
             return
         self._leader_contact = time.monotonic()
-        self._election_thread = threading.Thread(
-            target=self._election_loop, daemon=True)
+
+        def touch():
+            self._leader_contact = time.monotonic()
+
+        self._election_thread = BallotLoop(
+            is_leader=lambda: self.is_leader,
+            send_pings=self._send_heartbeats,
+            campaign=self._maybe_campaign,
+            leader_contact=lambda: self._leader_contact,
+            touch_contact=touch,
+            ping_s=self.HEARTBEAT_S,
+            timeout_range=self.ELECTION_TIMEOUT_S,
+            stop_event=self._election_stop)
         self._election_thread.start()
 
     def stop_elections(self) -> None:
         self._election_stop.set()
 
-    def _election_loop(self) -> None:
-        import random
-
-        timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
-        last_hb = 0.0
-        while not self._election_stop.wait(0.1):
-            now = time.monotonic()
-            if self.is_leader:
-                if now - last_hb >= self.HEARTBEAT_S:
-                    last_hb = now
-                    self._send_heartbeats()
-                continue
-            others = [a for a in self.group_members
-                      if a != self.advertise_addr]
-            if not others:
-                self._leader_contact = now   # no known peers: never campaign
-                continue
-            if now - self._leader_contact > timeout:
-                self._campaign(others)
-                timeout = random.uniform(*self.ELECTION_TIMEOUT_S)
-                self._leader_contact = time.monotonic()
+    def _maybe_campaign(self) -> None:
+        others = [a for a in self.group_members
+                  if a != self.advertise_addr]
+        if others:     # no known peers: never campaign
+            self._campaign(others)
 
     def _send_heartbeats(self) -> None:
         members = sorted(set(self.group_members) | {self.advertise_addr})
@@ -588,7 +587,7 @@ class WorkerService:
             finally:
                 if rw is not None:
                     rw.close()
-        if votes < (len(others) + 1) // 2 + 1:
+        if not _tally(votes, len(others) + 1):
             return
         with self._rlock:
             if self.term != t:
