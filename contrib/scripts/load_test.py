@@ -54,7 +54,15 @@ def main():
             addrs.append(f"127.0.0.1:{wport}")
         groups[g] = addrs
     replicas = [RemoteWorker(a) for a in groups[0]]
-    assert replicas[0].promote(1, groups[0][1:]).ok
+    # promote — unless the wire ballot (always on in CLI workers) already
+    # elected; either way wait until exactly one leader leads
+    t = max(rw.status().term for rw in replicas)
+    if not replicas[0].promote(t + 1, groups[0][1:]).ok:
+        deadline = time.time() + 20
+        while time.time() < deadline and not any(
+                rw.status().leader for rw in replicas):
+            time.sleep(0.2)
+    assert any(rw.status().leader for rw in replicas)
     c = ClusterClient(f"127.0.0.1:{zport}", groups)
 
     t0 = time.time()
@@ -80,15 +88,26 @@ def main():
     battery()
     print("query battery OK")
 
-    leader_proc = workers[0][0]
-    os.kill(leader_proc.pid, signal.SIGKILL)
-    stats = [((r.status().max_commit_ts, r.status().log_len), i)
-             for i, r in enumerate(replicas[1:], 1)]
+    old = next(i for i, r in enumerate(replicas) if r.status().leader)
+    old_term = replicas[old].status().term
+    os.kill(workers[old][0].pid, signal.SIGKILL)
+    live = [i for i in range(3) if i != old]
+    stats = [((replicas[i].status().max_commit_ts,
+               replicas[i].status().log_len), i) for i in live]
     new = max(stats)[1]
-    peers = [a for j, a in enumerate(groups[0]) if j not in (0, new)]
-    assert replicas[new].promote(2, peers).ok
+    peers = [groups[0][j] for j in live if j != new]
+    if not replicas[new].promote(old_term + 1, peers).ok:
+        # the wire ballot won the race: adopt whichever replica leads
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            up = [i for i in live if replicas[i].status().leader]
+            if up:
+                new = up[0]
+                break
+            time.sleep(0.2)
     battery()
-    print(f"failover OK (replica {new} leads at term 2); battery re-passed")
+    print(f"failover OK (replica {new} leads at term "
+          f"{replicas[new].status().term}); battery re-passed")
     c.close()
 
 
