@@ -291,23 +291,24 @@ class Executor:
         """Filter dest uids, then prune + paginate each uidMatrix row
         (reference: filters :1955 then applyPagination :2114 per list)."""
         cgq = child.gq
-        dest = self._apply_filter(cgq.filter, child.dest_uids)
-        kept = set(int(x) for x in dest)
+        dest = np.sort(self._apply_filter(cgq.filter, child.dest_uids))
         first = int(cgq.args.get("first", 0))
         offset = int(cgq.args.get("offset", 0))
         new_matrix = []
         for i, row in enumerate(child.uid_matrix):
-            sel = [j for j, t in enumerate(row) if int(t) in kept]
+            row = np.asarray(row, dtype=np.int64)
+            sel = np.flatnonzero(us.host_rank_of(dest, row, -1) >= 0)
             if offset:
                 sel = sel[offset:]
             if first > 0:
                 sel = sel[:first]
             elif first < 0:
                 sel = sel[first:]
-            new_matrix.append(np.asarray([int(row[j]) for j in sel], dtype=np.int64))
+            new_matrix.append(row[sel])
             if child.facet_matrix and i < len(child.facet_matrix):
-                child.facet_matrix[i] = [child.facet_matrix[i][j] for j in sel
-                                         if j < len(child.facet_matrix[i])]
+                frow = child.facet_matrix[i]
+                child.facet_matrix[i] = [frow[j] for j in sel.tolist()
+                                         if j < len(frow)]
         child.uid_matrix = new_matrix
         child.counts = [len(m) for m in new_matrix]
         child.dest_uids = (np.unique(np.concatenate(new_matrix))
