@@ -23,8 +23,12 @@ vectorized numpy implementation of the same 3-hop expand on the host CPU —
 an optimistic stand-in for the Go worker (numpy's C kernels vs Go's per-uid
 loops; the reference's own inner loops are scalar Go over bp128 blocks).
 
+  * `throughput` — the round-6 serving-layer battery: N worker threads
+    replaying a mixed stream of configs 2-5 against one Node, median QPS
+    with band, cold (caches off) vs warm (plan/task/result caches on).
+
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"band", "query_path", "query_configs"}.
+"band", "query_path", "query_configs", "throughput"}.
 """
 
 import json
@@ -177,6 +181,70 @@ def bench_query_path(subjects, indptr, indices, seeds_np):
             "traversed": trav, **_band(samples)}, None
 
 
+def bench_throughput(n_people=20000, follows=12, workers=4, reps=3,
+                     batches=3):
+    """Round-6 serving-layer throughput: N worker threads replaying a mixed
+    stream of BASELINE configs 2-5 against ONE Node, cold (caches off) vs
+    warm (plan + task + result caches on, pre-warmed). Median QPS with a
+    band; the acceptance gate is warm >= 3x cold with nonzero hit
+    counters. Both passes run after a cache-free warmup replay so jit
+    compiles and snapshot folds are excluded from BOTH numbers."""
+    import threading
+
+    from dgraph_tpu.models.film import film_node
+
+    node = film_node(n_people=n_people, follows=follows)
+    queries = [
+        '{ q(func: eq(age, 30)) { follows @filter(ge(age, 40)) { uid } } }',
+        '{ q(func: uid(0x1)) @recurse(depth: 3) { name follows } }',
+        '{ p as shortest(from: 0x1, to: 0x37) { follows } '
+        '  r(func: uid(p)) { uid } }',
+        '{ q(func: has(age)) @groupby(genre) '
+        '{ count(uid) a : avg(val(ag)) } '
+        '  var(func: has(age)) { ag as age } }',
+    ]
+
+    def replay(r):
+        for _ in range(r):
+            for qt in queries:
+                node.query(qt)
+
+    def measure():
+        samples = []
+        for _batch in range(batches):
+            ts = [threading.Thread(target=replay, args=(reps,))
+                  for _ in range(workers)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            samples.append(workers * reps * len(queries) /
+                           (time.perf_counter() - t0))
+        return _band(samples)
+
+    caches = (node.plan_cache, node.task_cache, node.result_cache)
+    node.plan_cache = node.task_cache = node.result_cache = None
+    replay(1)                      # jit/fold warmup outside both passes
+    cold = measure()
+    node.plan_cache, node.task_cache, _ = caches
+    replay(2)                      # fill + exercise the plan/task tiers
+    node.result_cache = caches[2]
+    replay(1)                      # fill the result tier
+    warm = measure()
+    c = lambda n: node.metrics.counter(n).value
+    out = {"workers": workers, "mixed_stream": len(queries),
+           "cold_qps": cold, "warm_qps": warm,
+           "speedup": round(warm["median"] / max(cold["median"], 1e-9), 2),
+           "plan_cache_hits": c("dgraph_plan_cache_hits_total"),
+           "task_cache_hits": c("dgraph_task_cache_hits_total"),
+           "result_cache_hits": c("dgraph_result_cache_hits_total"),
+           "coalesced_inflight":
+               c("dgraph_task_cache_inflight_waits_total")}
+    node.close()
+    return out
+
+
 def bench_query_configs():
     """BASELINE configs 2-5: DQL text in -> JSON out on the film graph."""
     from dgraph_tpu.models.film import film_node
@@ -273,6 +341,10 @@ def main():
         query_configs = bench_query_configs()
     except Exception as e:  # film-graph battery must not sink the headline
         query_configs = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        throughput = bench_throughput()
+    except Exception as e:  # serving-tier battery must not sink it either
+        throughput = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -283,6 +355,7 @@ def main():
         "band": band,
         "query_path": query_path,
         "query_configs": query_configs,
+        "throughput": throughput,
     }))
 
 

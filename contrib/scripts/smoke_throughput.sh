@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a short CPU-only serving-layer throughput check.
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 replays a small mixed BASELINE stream against one embedded Node,
+# cold (caches off) vs warm (plan/task/result caches on), and asserts
+#   * warm-cache QPS >= cold-cache QPS, and
+#   * the plan/task/result hit counters are nonzero.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+# The acceptance bar is "tier-1 no worse than seed", NOT rc==0: the tree
+# carries known seed failures (see CHANGES.md), so gate on the passed-test
+# count instead of pytest's exit code. SMOKE_MIN_DOTS is the seed floor.
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== throughput smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from bench import bench_throughput
+
+r = bench_throughput(n_people=3000, follows=8, workers=2, reps=2, batches=2)
+print("throughput smoke:", r)
+assert r["warm_qps"]["median"] >= r["cold_qps"]["median"], \
+    f"warm {r['warm_qps']} < cold {r['cold_qps']}"
+assert r["plan_cache_hits"] > 0, "plan cache never hit"
+assert r["task_cache_hits"] > 0, "task cache never hit"
+assert r["result_cache_hits"] > 0, "result cache never hit"
+print(f"OK: warm {r['warm_qps']['median']} qps >= "
+      f"cold {r['cold_qps']['median']} qps ({r['speedup']}x)")
+PY
+echo "== smoke passed =="

@@ -21,7 +21,11 @@ def cmd_serve(args) -> int:
     from dgraph_tpu.api.server import Node
 
     node = Node(dirpath=args.postings, trace_fraction=args.trace,
-                memory_mb=args.memory_mb or None)
+                memory_mb=args.memory_mb or None,
+                plan_cache_size=args.plan_cache,
+                task_cache_mb=args.task_cache_mb,
+                result_cache_mb=args.result_cache_mb,
+                dispatch_width=args.dispatch_width)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
@@ -279,6 +283,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--schema", default=None, help="schema file to apply")
     sp.add_argument("--trace", type=float, default=1.0,
                     help="fraction of requests to trace (/debug/requests)")
+    sp.add_argument("--plan_cache", type=int, default=256,
+                    help="parsed-plan cache entries (0 disables)")
+    sp.add_argument("--task_cache_mb", type=int, default=64,
+                    help="task-result cache budget in MB (0 disables)")
+    sp.add_argument("--result_cache_mb", type=int, default=32,
+                    help="query-result cache budget in MB (0 disables)")
+    sp.add_argument("--dispatch_width", type=int, default=4,
+                    help="max simultaneous device dispatches")
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")

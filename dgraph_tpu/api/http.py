@@ -65,7 +65,7 @@ _DASHBOARD_HTML = """<!doctype html>
  .lat{color:#616e88;font-size:12px;align-self:center}
 </style></head><body>
 <header><b>dgraph-tpu</b><span>query console — POST /query /mutate /alter;
-GET /state /health /debug/vars</span></header>
+GET /state /health /debug/vars /debug/metrics</span></header>
 <main>
  <div class="col">
   <textarea id="q">{
@@ -86,8 +86,13 @@ GET /state /health /debug/vars</span></header>
 <script>
 async function show(r, t0){
   const txt = await r.text();
-  document.getElementById('lat').textContent =
-      (performance.now()-t0).toFixed(0)+' ms';
+  let lat = (performance.now()-t0).toFixed(0)+' ms';
+  try{           // serving-layer readout: QPS + task-cache hit rate
+    const m = await (await fetch('/debug/metrics')).json();
+    lat += ' · ' + m.endpoints.query.qps + ' qps · hit ' +
+        (100*m.caches.task.hit_rate).toFixed(0) + '%';
+  }catch(e){}
+  document.getElementById('lat').textContent = lat;
   try{document.getElementById('out').textContent =
       JSON.stringify(JSON.parse(txt),null,2);}
   catch(e){document.getElementById('out').textContent = txt;}
@@ -112,6 +117,63 @@ async function get(path){
 def _envelope_err(code: str, message: str) -> bytes:
     return json.dumps(
         {"errors": [{"code": code, "message": message}]}).encode()
+
+
+def _hit_rate(hits: int, misses: int) -> float:
+    total = hits + misses
+    return round(hits / total, 4) if total else 0.0
+
+
+def _serving_metrics(node: Node) -> dict:
+    """The /debug/metrics payload: cache tiers, dispatch gate, and
+    per-endpoint QPS + latency (the round-6 serving-layer readout)."""
+    m = node.metrics
+    c = lambda n: m.counter(n).value
+    out = {
+        "caches": {
+            "plan": {
+                "hits": c("dgraph_plan_cache_hits_total"),
+                "misses": c("dgraph_plan_cache_misses_total"),
+                "hit_rate": _hit_rate(c("dgraph_plan_cache_hits_total"),
+                                      c("dgraph_plan_cache_misses_total")),
+                "entries": len(node.plan_cache)
+                if node.plan_cache is not None else 0,
+            },
+            "task": {
+                "hits": c("dgraph_task_cache_hits_total"),
+                "misses": c("dgraph_task_cache_misses_total"),
+                "hit_rate": _hit_rate(c("dgraph_task_cache_hits_total"),
+                                      c("dgraph_task_cache_misses_total")),
+                "evicted": c("dgraph_task_cache_evicted_total"),
+                "inflight_waits":
+                    c("dgraph_task_cache_inflight_waits_total"),
+                "bytes": c("dgraph_task_cache_bytes"),
+            },
+            "result": {
+                "hits": c("dgraph_result_cache_hits_total"),
+                "misses": c("dgraph_result_cache_misses_total"),
+                "hit_rate": _hit_rate(c("dgraph_result_cache_hits_total"),
+                                      c("dgraph_result_cache_misses_total")),
+                "evicted": c("dgraph_result_cache_evicted_total"),
+                "bytes": c("dgraph_result_cache_bytes"),
+            },
+        },
+        "dispatch": {
+            "width": node.dispatch_gate.width,
+            "in_flight": c("dgraph_dispatch_inflight"),
+            "waits": c("dgraph_dispatch_waits_total"),
+        },
+        "endpoints": {
+            ep: {"qps": m.meter(f"http_{ep}").rate(),
+                 "latency": m.histogram(
+                     f"dgraph_http_{ep}_latency_s").snapshot()}
+            for ep in ("query", "mutate", "commit", "abort", "alter")
+        },
+        "node_qps": {"query": m.meter("query").rate(),
+                     "mutate": m.meter("mutate").rate()},
+        "vars": m.to_dict(),
+    }
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -159,6 +221,10 @@ class _Handler(BaseHTTPRequestHandler):
             # recent sampled request traces (net/trace /debug/requests)
             n = int(self._qs().get("n", "32"))
             self._send(200, json.dumps(self.node.traces.recent(n)).encode())
+        elif path == "/debug/metrics":
+            # serving-layer readout: cache hit rates, dispatch gate,
+            # per-endpoint QPS + latency histograms (round-6 tier)
+            self._send(200, json.dumps(_serving_metrics(self.node)).encode())
         elif path in ("", "/ui"):
             # embedded query console (reference: the static dashboard
             # served by dgraph/cmd/server/dashboard.go)
@@ -166,8 +232,14 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, _envelope_err("ErrorInvalidRequest", "no such path"))
 
+    # endpoints that feed the per-endpoint QPS meters + latency histograms
+    _OBSERVED = {"/query": "query", "/mutate": "mutate", "/commit": "commit",
+                 "/abort": "abort", "/alter": "alter"}
+
     def do_POST(self):
         path = urlparse(self.path).path.rstrip("/")
+        ep = self._OBSERVED.get(path)
+        t0 = time.perf_counter()
         try:
             if path == "/query":
                 self._query()
@@ -192,6 +264,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(409, _envelope_err("ErrorAborted", str(e)))
         except Exception as e:  # surface parse/exec errors in the envelope
             self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
+        finally:
+            if ep is not None:
+                m = self.node.metrics
+                m.meter(f"http_{ep}").mark()
+                m.histogram(f"dgraph_http_{ep}_latency_s").observe(
+                    time.perf_counter() - t0)
 
     # -- admin (reference dgraph/cmd/server/admin.go) -------------------------
 
@@ -255,9 +333,11 @@ class _Handler(BaseHTTPRequestHandler):
         qs = self._qs()
         start_ts = qs.get("startTs")
         ro = qs.get("ro", qs.get("readOnly", "")).lower() == "true"
+        edge_limit = qs.get("edgeLimit")   # per-request edge budget override
         t0 = time.perf_counter_ns()
         out, ctx = self.node.query(
-            q, variables, int(start_ts) if start_ts else None, read_only=ro)
+            q, variables, int(start_ts) if start_ts else None, read_only=ro,
+            edge_limit=int(edge_limit) if edge_limit else None)
         self._send(200, _envelope_ok(
             out, {"txn": {"start_ts": ctx.start_ts},
                   "server_latency":

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from dgraph_tpu.coord.zero import TxnConflict, Zero
 from dgraph_tpu.query import dql, rdf
 from dgraph_tpu.query import mutation as mut
+from dgraph_tpu.query import qcache
 from dgraph_tpu.query import upsert as ups
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage import index as idx
@@ -71,7 +72,11 @@ class Node:
 
     def __init__(self, dirpath: str | None = None, n_groups: int = 1,
                  trace_fraction: float = 1.0,
-                 memory_mb: int | None = None) -> None:
+                 memory_mb: int | None = None,
+                 plan_cache_size: int = 256,
+                 task_cache_mb: int = 64,
+                 result_cache_mb: int = 32,
+                 dispatch_width: int = 4) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -80,6 +85,19 @@ class Node:
         self.zero = Zero(n_groups)
         self.metrics = metrics.Registry()
         self.traces = metrics.TraceStore(fraction=trace_fraction)
+        # round-6 serving tier: parsed-plan cache, snapshot-keyed task
+        # result LRU (+ singleflight), bounded device-dispatch gate.
+        # Size 0 disables a tier (bench.py's cold-cache mode).
+        self.plan_cache = (qcache.PlanCache(plan_cache_size, self.metrics)
+                           if plan_cache_size > 0 else None)
+        self.task_cache = (qcache.TaskResultCache(task_cache_mb << 20,
+                                                  self.metrics)
+                           if task_cache_mb > 0 else None)
+        self.result_cache = (qcache.ResultCache(result_cache_mb << 20,
+                                                self.metrics)
+                             if result_cache_mb > 0 else None)
+        self.dispatch_gate = qcache.DispatchGate(dispatch_width,
+                                                 self.metrics)
         self._txns: dict[int, TxnContext] = {}
         self._lock = threading.RLock()       # commit/read linearization
         self._inflight_cv = threading.Condition(self._lock)
@@ -258,6 +276,24 @@ class Node:
     def _invalidate_snapshots(self) -> None:
         with self._lock:
             self._assembler.invalidate()
+        # schema/drop changes don't always mint a new read_ts, but they DO
+        # mint new snapshot objects (fresh cache tokens), so stale task
+        # results can never be served — clearing just releases the bytes
+        if self.task_cache is not None:
+            self.task_cache.clear()
+        if self.result_cache is not None:
+            self.result_cache.clear()
+
+    # -- parsing --------------------------------------------------------------
+
+    def _parse(self, q: str, variables: dict | None = None) -> dql.ParsedRequest:
+        """Parse through the plan cache: hot query shapes skip the lexer +
+        recursive-descent parser entirely. Parsed trees are read-only
+        during execution (engine only builds NEW GraphQuery nodes), so one
+        AST serves every replay."""
+        if self.plan_cache is not None:
+            return self.plan_cache.parse(q, variables)
+        return dql.parse(q, variables)
 
     # -- Query ---------------------------------------------------------------
 
@@ -289,27 +325,37 @@ class Node:
                              for attr in sorted(ctx.preds)}
                     ctx.overlay = (ctx.version, built)
                     snap.preds.update(built)
+                # overlay views are cacheable WITHIN one txn version: the
+                # per-mutate version bump rotates the token, so a buffered
+                # write can never be served from a pre-write cache entry
+                snap.cache_token = ("txn", ctx.start_ts, ctx.version,
+                                    qcache.snapshot_token(base))
             else:
                 snap = self.snapshot(read_ts)
         return read_ts, snap
 
     def query(self, q: str, variables: dict | None = None,
               start_ts: int | None = None,
-              read_only: bool = False) -> tuple[dict, TxnContext]:
+              read_only: bool = False,
+              edge_limit: int | None = None) -> tuple[dict, TxnContext]:
         """Parse + execute a DQL request (edgraph/server.go:373).
 
         read_only treats start_ts purely as a snapshot timestamp: it never
         joins an open txn's uncommitted overlay even if some pending txn
         happens to carry the same start_ts (read ts values come from the same
-        oracle counter, so numeric collision is possible)."""
+        oracle counter, so numeric collision is possible).
+
+        edge_limit overrides the process-default traversed-edge budget for
+        THIS request only (the --query_edge_limit flag, now per-request)."""
         tr = self.traces.start(
             "query", q.strip().splitlines()[0][:120] if q.strip() else "")
         m = self.metrics
         m.counter("dgraph_num_queries_total").inc()
         m.counter("dgraph_pending_queries_total").inc()
+        m.meter("query").mark()
         t0 = time.perf_counter()
         try:
-            req = dql.parse(q, variables)
+            req = self._parse(q, variables)
             tr.printf("parsed: %d query blocks", len(req.queries))
             if req.upsert is not None:
                 # implicit txn commits; an explicit one stays open for the
@@ -326,8 +372,33 @@ class Node:
             else:
                 read_ts, snap = self._read_view(start_ts)
             tr.printf("snapshot at ts %d (%d preds)", read_ts, len(snap.preds))
-            out = Executor(snap, self.store.schema).execute(req)
+            # whole-query result tier: keyed on (plan key, snapshot token,
+            # edge budget); the snapshot token rotates on every commit /
+            # alter / drop / txn-overlay version bump, so a mutation between
+            # repeats always forces re-execution
+            rkey = None
+            if self.result_cache is not None and not req.mutations:
+                pk = qcache.plan_key(q, variables)
+                if pk is not None:
+                    # the EFFECTIVE budget is part of the key: a shrunk
+                    # budget (per-request or via set_query_edge_limit) must
+                    # re-execute, not serve a result computed under a
+                    # larger one (and vice versa)
+                    from dgraph_tpu.query import engine as _eng
+
+                    eff = edge_limit if edge_limit is not None \
+                        else _eng.MAX_QUERY_EDGES
+                    rkey = (pk, qcache.snapshot_token(snap), eff)
+                    cached = self.result_cache.get(rkey)
+                    if cached is not None:
+                        tr.printf("result cache hit")
+                        return cached, TxnContext(start_ts=read_ts)
+            out = Executor(snap, self.store.schema,
+                           cache=self.task_cache, gate=self.dispatch_gate,
+                           edge_limit=edge_limit).execute(req)
             tr.printf("executed")
+            if rkey is not None:
+                self.result_cache.put(rkey, out)
             return out, TxnContext(start_ts=read_ts)
         except Exception as e:
             self.traces.finish(tr, error=str(e))
@@ -361,8 +432,9 @@ class Node:
             vars_map: dict = {}
             if q.strip():
                 _, snap = self._read_view(ctx.start_ts)
-                ex = Executor(snap, self.store.schema)
-                out = ex.execute(dql.parse(q, variables))
+                ex = Executor(snap, self.store.schema,
+                              cache=self.task_cache, gate=self.dispatch_gate)
+                out = ex.execute(self._parse(q, variables))
                 vars_map = ex.vars
             uid_map: dict = {}
             for m in mutations:
@@ -423,6 +495,7 @@ class Node:
         m = self.metrics
         m.counter("dgraph_num_mutations_total").inc()
         m.counter("dgraph_active_mutations_total").inc()
+        m.meter("mutate").mark()
         t0 = time.perf_counter()
         try:
             with self._lock:
@@ -495,7 +568,7 @@ class Node:
                     commit_now: bool = True) -> tuple[dict, MutationResult | None]:
         """One combined DQL request: query blocks and/or mutation blocks
         through the same entry (the `{set {...}}` surface)."""
-        req = dql.parse(q, variables)
+        req = self._parse(q, variables)
         mres = None
         if req.mutations:
             sets, dels = [], []
@@ -549,7 +622,9 @@ class Node:
         1. roll up the layer-heaviest lists below the min-pending watermark
            (folds Python layer dicts into the packed numpy base — the same
            compaction the reference's periodic commit achieves);
-        2. drop cached device snapshots and the predicate build cache
+        2. drop task-result cache entries (pure recompute cost, no
+           correctness state);
+        3. drop cached device snapshots and the predicate build cache
            (rebuilt read-through on the next query).
         Never touches uncommitted layers or layers a live txn could read.
         """
@@ -572,6 +647,20 @@ class Node:
                             self.store.memory_stats()["bytes"] <= budget_bytes:
                         break
                 stats = self.store.memory_stats()
+        cache_evicted = 0
+        cache_bytes = (self.task_cache.bytes if self.task_cache else 0) + \
+            (self.result_cache.bytes if self.result_cache else 0)
+        if cache_bytes and stats["bytes"] + cache_bytes > budget_bytes:
+            over = stats["bytes"] + cache_bytes - budget_bytes
+            if self.result_cache is not None:
+                cache_evicted += self.result_cache.evict_to(
+                    max(0, self.result_cache.bytes - over))
+                over = stats["bytes"] + \
+                    (self.task_cache.bytes if self.task_cache else 0) - \
+                    budget_bytes
+            if self.task_cache is not None and over > 0:
+                cache_evicted += self.task_cache.evict_to(
+                    max(0, self.task_cache.bytes - over))
         dropped_snaps = 0
         if stats["bytes"] > budget_bytes:
             with self._lock:
@@ -579,7 +668,8 @@ class Node:
         self.metrics.counter("dgraph_memory_bytes").set(stats["bytes"])
         return {"bytes": stats["bytes"], "lists": stats["lists"],
                 "layers": stats["layers"], "rolled_up": rolled,
-                "dropped_caches": dropped_snaps}
+                "dropped_caches": dropped_snaps,
+                "task_cache_evicted": cache_evicted}
 
     # -- ops -----------------------------------------------------------------
 

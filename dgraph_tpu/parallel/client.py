@@ -83,6 +83,8 @@ class ClusterClient:
         period, a background echo loop feeds routing (worker/task.go:75,
         conn/pool.go:153)."""
         from .remote import HedgedReplicas
+        from ..query.qcache import DispatchGate, TaskResultCache
+        from ..utils import metrics as metrics_mod
 
         self.zero = _CachedZero(ZeroClient(zero_addr))
         self.replicas = {g: HedgedReplicas(addrs)
@@ -90,12 +92,21 @@ class ClusterClient:
         self.groups = {g: hr.workers for g, hr in self.replicas.items()}
         self._leases = _LeaseAdapter(self.zero)
         self._schema: tuple[float, SchemaState] | None = None
+        # client-side serving tier: replayed task shapes skip the wire,
+        # concurrent identical tasks share one RPC, and the gate bounds
+        # simultaneous fan-out RPCs per client
+        self.metrics = metrics_mod.Registry()
+        self.task_cache = TaskResultCache(32 << 20, self.metrics)
+        self.dispatch_gate = DispatchGate(8, self.metrics)
 
     def _invalidate(self) -> None:
         for hr in self.replicas.values():
             hr.mark_stale()       # force leader re-discovery
         self._schema = None
         self.zero.invalidate()
+        # conservative: read_ts-keyed entries stay valid under MVCC, but a
+        # failover/tablet-move window is exactly when we want no reuse
+        self.task_cache.clear()
 
     # -- leadership ----------------------------------------------------------
 
@@ -227,7 +238,8 @@ class ClusterClient:
             self.zero, local_group=-1,
             local_snap_fn=lambda ts: GraphSnapshot(ts),
             remotes=dict(self.replicas),
-            schema=schema, pred_floors=floors)
+            schema=schema, pred_floors=floors,
+            cache=self.task_cache, gate=self.dispatch_gate)
         snap = GraphSnapshot(read_ts)
         ex = Executor(snap, schema,
                       dispatch=lambda tq: dispatcher.process_task(tq, read_ts))

@@ -181,9 +181,19 @@ class WorkerService:
 
         from ..storage.csr_build import SnapshotAssembler
 
+        from ..query.qcache import TaskResultCache
+        from ..utils import metrics as metrics_mod
+
         self.store = store
         self._assembler = SnapshotAssembler(store)
         self._lock = threading.Lock()
+        # server-side task-result cache: repeated/fanned-out ServeTask
+        # calls for the same (snapshot, task) answer from memory, and
+        # concurrent identical tasks coalesce onto one execution. Keyed on
+        # the snapshot object token — the assembler replaces (never
+        # mutates) snapshots on any visible commit/replay/drop.
+        self.metrics = metrics_mod.Registry()
+        self.task_cache = TaskResultCache(32 << 20, self.metrics)
         # replica-read gate concurrency cap (see serve_task convoy guard)
         self._gate_slots = threading.BoundedSemaphore(2)
         self._move_keys_cache = None
@@ -261,7 +271,12 @@ class WorkerService:
                         time.sleep(0.01)
                 finally:
                     self._gate_slots.release()
-        res = process_task(self._snapshot(read_ts), q, self.store.schema)
+        from ..query.qcache import snapshot_token
+
+        snap = self._snapshot(read_ts)
+        res = self.task_cache.dispatch(
+            snapshot_token(snap), q,
+            lambda tq: process_task(snap, tq, self.store.schema))
         return encode_result(res)
 
     def membership(self, _msg: ipb.MembershipRequest,
@@ -1233,7 +1248,8 @@ class NetworkDispatcher:
 
     def __init__(self, zero, local_group: int, local_snap_fn,
                  remotes: dict[int, RemoteWorker], schema,
-                 pred_floors: dict[str, int] | None = None) -> None:
+                 pred_floors: dict[str, int] | None = None,
+                 cache=None, gate=None) -> None:
         self.zero = zero
         self.local_group = local_group
         self.local_snap_fn = local_snap_fn     # read_ts -> GraphSnapshot
@@ -1242,8 +1258,28 @@ class NetworkDispatcher:
         # per-tablet commit floors (Zero oracle): hedged replica reads wait
         # for (or refuse below) this applied watermark
         self.pred_floors = pred_floors or {}
+        # client-side task cache + dispatch gate over the fan-out: k-hop
+        # queries replaying the same shape skip the wire entirely, and
+        # concurrent identical tasks share one in-flight RPC. Keyed on
+        # read_ts — an MVCC read at a given ts is immutable cluster-wide;
+        # the owning ClusterClient clears the cache on its invalidation
+        # path (leader failover / tablet-map refresh).
+        self.cache = cache
+        self.gate = gate
 
     def process_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
+        if self.cache is not None:
+            return self.cache.dispatch(
+                ("net", read_ts), q,
+                lambda tq: self._process_task_raw(tq, read_ts))
+        return self._process_task_raw(q, read_ts)
+
+    def _process_task_raw(self, q: TaskQuery, read_ts: int) -> TaskResult:
+        if self.gate is not None:
+            return self.gate.run(lambda: self._route_task(q, read_ts))
+        return self._route_task(q, read_ts)
+
+    def _route_task(self, q: TaskQuery, read_ts: int) -> TaskResult:
         attr = q.attr[1:] if q.attr.startswith("~") else q.attr
         # consult (don't claim) the tablet map: a query on a never-seen
         # predicate answers empty locally instead of minting a tablet

@@ -36,10 +36,11 @@ MAX_QUERY_EDGES = 1_000_000  # reference x/init.go:53 QueryEdgeLimit
 
 
 def set_query_edge_limit(n: int) -> None:
-    """Set the per-query traversed-edge budget (the reference's
-    --query_edge_limit server flag, x/config.go:18-24). Single binding:
-    every traversal module reads engine.MAX_QUERY_EDGES through the module
-    attribute."""
+    """Set the process-default per-query traversed-edge budget (the
+    reference's --query_edge_limit server flag, x/config.go:18-24). The
+    module global is only the DEFAULT: an Executor built with edge_limit=N
+    (the per-request override, Node.query(edge_limit=...)) ignores it —
+    traversal modules read the effective budget via ex.edge_budget()."""
     global MAX_QUERY_EDGES
     MAX_QUERY_EDGES = int(n)
 
@@ -86,18 +87,43 @@ class Executor:
     """
 
     def __init__(self, snap: GraphSnapshot, schema: SchemaState,
-                 dispatch=None):
+                 dispatch=None, cache=None, gate=None,
+                 edge_limit: int | None = None):
         self.snap = snap
         self.schema = schema
         self.vars: dict[str, VarValue] = {}
         self.traversed_edges = 0
         self.sort_index_buckets = -1  # sortWithIndex instrumentation
+        # per-request edge budget override; None = module default (read
+        # dynamically so set_query_edge_limit still applies)
+        self.edge_limit = edge_limit
+        self.gate = gate               # DispatchGate | None
         # task dispatch seam (ProcessTaskOverNetwork): the default executes
         # against the local snapshot; a NetworkDispatcher routes each task
         # to its tablet's owning group over the internal wire protocol
         self._remote = dispatch is not None
-        self._dispatch = dispatch or (
+        raw = dispatch or (
             lambda q: process_task(self.snap, q, self.schema))
+        if gate is not None:
+            inner = raw
+            raw = lambda q: gate.run(lambda: inner(q))
+        if cache is not None:
+            from dgraph_tpu.query.qcache import snapshot_token
+
+            token = snapshot_token(snap)
+            self._dispatch = lambda q: cache.dispatch(token, q, raw)
+        else:
+            self._dispatch = raw
+
+    def edge_budget(self) -> int:
+        """Effective traversed-edge budget for this request."""
+        return self.edge_limit if self.edge_limit is not None \
+            else MAX_QUERY_EDGES
+
+    def gated(self, fn):
+        """Run a device-step closure through the dispatch gate when one is
+        installed (recurse/shortest kernel steps that bypass _dispatch)."""
+        return self.gate.run(fn) if self.gate is not None else fn()
 
     # ------------------------------------------------------------------ API
 
@@ -259,7 +285,7 @@ class Executor:
                 tq.facet_keys = tq.facet_keys or ["__all__"]
             res = self._dispatch(tq)
             self.traversed_edges += res.traversed_edges
-            if self.traversed_edges > MAX_QUERY_EDGES:
+            if self.traversed_edges > self.edge_budget():
                 raise QueryError("query exceeded edge budget (ErrTooBig)")
             if cgq.checkpwd:
                 # checkpwd(pwd, "cand"): stored password -> bool per uid

@@ -32,7 +32,6 @@ import jax
 import jax.numpy as jnp
 
 from dgraph_tpu.query import dql
-from dgraph_tpu.query import engine
 from dgraph_tpu.query.engine import QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID
@@ -270,14 +269,17 @@ def recurse(ex, sg: SubGraph) -> None:
                 st = _kstate(cgq.attr, csr)
                 g = st["g"]
                 fmask = _seeds_mask(frontier, g.num_nodes)
-                dest_words, trav, seen2, fresh = pb.recurse_step(
-                    g.in_src_pad, g.in_iptr_rank, g.subjects, g.in_subjects,
-                    fmask, st["seen"], chunks=g.chunks,
-                    num_nodes=g.num_nodes, allow_loop=spec.allow_loop)
+                # the device step runs through the dispatch gate: N
+                # concurrent recurse queries pipeline instead of thrashing
+                dest_words, trav, seen2, fresh = ex.gated(
+                    lambda: pb.recurse_step(
+                        g.in_src_pad, g.in_iptr_rank, g.subjects,
+                        g.in_subjects, fmask, st["seen"], chunks=g.chunks,
+                        num_nodes=g.num_nodes, allow_loop=spec.allow_loop))
                 st["seen"] = seen2
                 dest_words_h, trav_h = jax.device_get((dest_words, trav))
                 edges += int(trav_h)
-                if edges > engine.MAX_QUERY_EDGES:
+                if edges > ex.edge_budget():
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
                 m = LazyRecurseMatrix(csr, g, frontier, FreshFlags(fresh),
@@ -295,7 +297,7 @@ def recurse(ex, sg: SubGraph) -> None:
                     spec.allow_loop) if len(frontier)
                     else ([], 0))
                 edges += total
-                if edges > engine.MAX_QUERY_EDGES:
+                if edges > ex.edge_budget():
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
                 _set_list_result(child, matrix)
@@ -304,7 +306,7 @@ def recurse(ex, sg: SubGraph) -> None:
                 # on (attr, from, to) keys (reference recurse.go:129-141)
                 res = ex._dispatch(TaskQuery(cgq.attr, frontier=frontier))
                 edges += res.traversed_edges
-                if edges > engine.MAX_QUERY_EDGES:
+                if edges > ex.edge_budget():
                     raise QueryError(
                         "recurse exceeded edge budget (ErrTooBig)")
                 matrix = []
@@ -337,11 +339,11 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
 
     g = pb.pull_graph_for(csr)
     seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
-    masks_p, trav, fresh = pb.recurse_fused(
+    masks_p, trav, fresh = ex.gated(lambda: pb.recurse_fused(
         g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
         g.in_subjects, _seeds_mask(seeds, g.num_nodes),
         depth=depth, chunks=g.chunks, chunks_d=g.chunks_d,
-        allow_loop=allow_loop)
+        allow_loop=allow_loop))
     # ONE relay round-trip for the whole traversal, bit-packed in DST-RANK
     # space (fresh flags stay on device until a lazy uidMatrix
     # materialization needs them); host maps ranks -> uids
@@ -355,7 +357,7 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
         if len(frontier) == 0:
             break
         cum += int(trav_h[lvl])
-        if cum > engine.MAX_QUERY_EDGES:
+        if cum > ex.edge_budget():
             raise QueryError("recurse exceeded edge budget (ErrTooBig)")
         child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
         m = LazyRecurseMatrix(csr, g, frontier, shared_fresh, lvl, allow_loop)

@@ -24,7 +24,6 @@ import heapq
 import numpy as np
 
 from dgraph_tpu.query import dql
-from dgraph_tpu.query import engine
 from dgraph_tpu.query.engine import QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery, process_task
 from dgraph_tpu.utils.types import TypeID
@@ -59,7 +58,7 @@ def _build_adjacency(ex, sg: SubGraph, src: int, dst: int):
                            facet_keys=[facet_key] if facet_key else [])
             res = ex._dispatch(tq)
             edges += res.traversed_edges
-            if edges > engine.MAX_QUERY_EDGES:
+            if edges > ex.edge_budget():
                 raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
             dests = res.dest_uids
             if cgq.filter is not None:
@@ -207,7 +206,8 @@ def shortest_path(ex, sg: SubGraph) -> None:
                 p = _dijkstra(adj, src, dst)
                 sg.paths = [p] if p is not None else []
             else:
-                sg.paths = _k_shortest(adj, src, dst, spec.numpaths)
+                sg.paths = _k_shortest(adj, src, dst, spec.numpaths,
+                                        ex.edge_budget())
         sg.paths = [p for p in sg.paths
                     if spec.minweight <= p[0] <= spec.maxweight]
     uids = sorted({u for _c, path, _a in sg.paths for u in path})
@@ -245,7 +245,7 @@ def _dijkstra(adj, src: int, dst: int):
     return (dist[dst], path[::-1], attrs[::-1])
 
 
-def _k_shortest(adj, src: int, dst: int, k: int):
+def _k_shortest(adj, src: int, dst: int, k: int, budget: int):
     """Loopless k-shortest via best-first path enumeration (the reference
     carries whole paths per heap item too, query/shortest.go:274). The pop
     budget is the query edge limit (x/init.go:53 QueryEdgeLimit) — each pop
@@ -253,7 +253,7 @@ def _k_shortest(adj, src: int, dst: int, k: int):
     out = []
     pq = [(0.0, [src], [])]
     pops = 0
-    while pq and len(out) < k and pops < engine.MAX_QUERY_EDGES:
+    while pq and len(out) < k and pops < budget:
         d, path, attrs = heapq.heappop(pq)
         pops += 1
         u = path[-1]

@@ -72,21 +72,61 @@ class Histogram:
                 "max": vals[-1]}
 
 
+class Meter:
+    """Sliding-window event rate (per-endpoint QPS for /debug/metrics).
+    Marks keep a bounded timestamp ring; rate() counts events inside the
+    trailing window. The ring bounds memory, so a sustained burst beyond
+    `cap` events/window under-reports — fine for an ops readout."""
+
+    __slots__ = ("_ring", "_lock", "window")
+
+    def __init__(self, window: float = 10.0, cap: int = 8192) -> None:
+        self.window = window
+        self._ring: deque[float] = deque(maxlen=cap)
+        self._lock = threading.Lock()
+
+    def mark(self) -> None:
+        with self._lock:
+            self._ring.append(time.monotonic())
+
+    def rate(self, window: float | None = None) -> float:
+        w = window or self.window
+        cut = time.monotonic() - w
+        with self._lock:
+            n = sum(1 for t in self._ring if t >= cut)
+        return round(n / w, 3)
+
+
 class Registry:
     """Named metrics with the reference's dgraph_* vocabulary pre-registered
-    (x/metrics.go:27-76)."""
+    (x/metrics.go:27-76), plus the round-6 serving-layer counters (plan /
+    task caches, singleflight, dispatch gate)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: dict[str, Counter] = {}
         self.histograms: dict[str, Histogram] = {}
+        self.meters: dict[str, Meter] = {}
         for name in ("dgraph_num_queries_total", "dgraph_num_mutations_total",
                      "dgraph_num_commits_total", "dgraph_num_aborts_total",
                      "dgraph_posting_reads_total",
                      "dgraph_posting_writes_total",
                      "dgraph_pending_queries_total",
                      "dgraph_active_mutations_total",
-                     "dgraph_num_upserts_total", "dgraph_num_alters_total"):
+                     "dgraph_num_upserts_total", "dgraph_num_alters_total",
+                     "dgraph_plan_cache_hits_total",
+                     "dgraph_plan_cache_misses_total",
+                     "dgraph_task_cache_hits_total",
+                     "dgraph_task_cache_misses_total",
+                     "dgraph_task_cache_evicted_total",
+                     "dgraph_task_cache_inflight_waits_total",
+                     "dgraph_task_cache_bytes",
+                     "dgraph_result_cache_hits_total",
+                     "dgraph_result_cache_misses_total",
+                     "dgraph_result_cache_evicted_total",
+                     "dgraph_result_cache_bytes",
+                     "dgraph_dispatch_inflight",
+                     "dgraph_dispatch_waits_total"):
             self.counters[name] = Counter()
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s"):
@@ -100,10 +140,15 @@ class Registry:
         with self._lock:
             return self.histograms.setdefault(name, Histogram())
 
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            return self.meters.setdefault(name, Meter())
+
     def to_dict(self) -> dict:
         """expvar-style dump for /debug/vars."""
         out: dict = {c: m.value for c, m in sorted(self.counters.items())}
         out.update({h: m.snapshot() for h, m in sorted(self.histograms.items())})
+        out.update({f"{n}_qps": m.rate() for n, m in sorted(self.meters.items())})
         return out
 
 

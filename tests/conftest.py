@@ -36,3 +36,15 @@ assert len(jax.devices()) >= 8, (
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _restore_query_edge_limit():
+    """The edge budget default is a module global (engine.MAX_QUERY_EDGES);
+    tests that shrink it via set_query_edge_limit must not leak the budget
+    into later tests — restore it unconditionally around every test."""
+    from dgraph_tpu.query import engine
+
+    old = engine.MAX_QUERY_EDGES
+    yield
+    engine.MAX_QUERY_EDGES = old

@@ -1,0 +1,360 @@
+"""Round-6 serving layer: plan/task/result caches, singleflight coalescing,
+dispatch gate, per-request edge budgets, and the /debug/metrics surface.
+
+The correctness contract under test: a mutate / alter / drop-attr must NEVER
+let a cached entry be served stale (snapshot-token rotation), and K
+concurrent identical queries must share ONE underlying process_task
+execution per distinct task while every caller gets identical results.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import engine as eng
+from dgraph_tpu.query import qcache
+from dgraph_tpu.query.engine import Executor, QueryError
+from dgraph_tpu.query.task import TaskQuery, TaskResult
+from dgraph_tpu.utils.metrics import Registry
+
+
+def _node():
+    node = Node()
+    node.alter(schema_text="name: string @index(exact) .\n"
+                           "age: int @index(int) .\n"
+                           "friend: [uid] .")
+    node.mutate(set_nquads="\n".join(
+        [f'<0x{i:x}> <name> "p{i}" .' for i in range(1, 9)] +
+        [f'<0x{i:x}> <age> "{20 + i}"^^<xs:int> .' for i in range(1, 9)] +
+        ['<0x1> <friend> <0x2> .', '<0x1> <friend> <0x3> .',
+         '<0x2> <friend> <0x4> .']), commit_now=True)
+    return node
+
+
+Q = '{ q(func: ge(age, 21)) { name friend { name } } }'
+
+
+def _uncached(node, q):
+    caches = (node.plan_cache, node.task_cache, node.result_cache)
+    node.plan_cache = node.task_cache = node.result_cache = None
+    try:
+        out, _ = node.query(q)
+    finally:
+        (node.plan_cache, node.task_cache, node.result_cache) = caches
+    return out
+
+
+# ---------------------------------------------------------------------------
+# invalidation: never serve stale
+# ---------------------------------------------------------------------------
+
+def test_mutate_invalidates_cached_results():
+    node = _node()
+    warm1, _ = node.query(Q)
+    warm2, _ = node.query(Q)          # served from cache
+    assert warm1 == warm2
+    assert node.metrics.counter("dgraph_result_cache_hits_total").value > 0
+    node.mutate(set_nquads='<0x9> <age> "30"^^<xs:int> .\n'
+                           '<0x9> <name> "p9" .', commit_now=True)
+    got, _ = node.query(Q)
+    assert got != warm1               # the new person must appear
+    assert json.dumps(got, sort_keys=True) == \
+        json.dumps(_uncached(node, Q), sort_keys=True)
+    node.close()
+
+
+def test_alter_and_drop_attr_invalidate():
+    node = _node()
+    node.query(Q)
+    node.query(Q)
+    node.alter(drop_attr="friend")
+    got, _ = node.query(Q)
+    assert json.dumps(got, sort_keys=True) == \
+        json.dumps(_uncached(node, Q), sort_keys=True)
+    assert "friend" not in json.dumps(got)
+    node.close()
+
+
+def test_txn_overlay_version_bump_invalidates():
+    """A buffered (uncommitted) write inside a txn must be visible to the
+    txn's next read — the per-mutate version bump rotates the overlay
+    snapshot token."""
+    node = _node()
+    res = node.mutate(set_nquads='<0x1> <name> "renamed" .',
+                      commit_now=False)
+    ts = res.context.start_ts
+    q = '{ q(func: uid(0x1)) { name } }'
+    got1, _ = node.query(q, start_ts=ts)
+    assert got1["q"][0]["name"] == "renamed"
+    node.mutate(set_nquads='<0x1> <name> "again" .', start_ts=ts)
+    got2, _ = node.query(q, start_ts=ts)
+    assert got2["q"][0]["name"] == "again"
+    node.abort(ts)
+    got3, _ = node.query(q)
+    assert got3["q"][0]["name"] == "p1"
+    node.close()
+
+
+def test_cached_vs_uncached_byte_identical():
+    node = _node()
+    for q in (Q, '{ q(func: uid(0x1)) @recurse(depth: 2) { name friend } }',
+              '{ q(func: has(age)) { c : count(uid) } }'):
+        node.query(q)                  # prime
+        cached, _ = node.query(q)
+        assert json.dumps(cached, sort_keys=True) == \
+            json.dumps(_uncached(node, q), sort_keys=True)
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# singleflight coalescing
+# ---------------------------------------------------------------------------
+
+def test_singleflight_one_execution_per_task(monkeypatch):
+    node = _node()
+    node.result_cache = None          # exercise the task tier, not tier 3
+    node.plan_cache = None
+    calls: dict = {}
+    lock = threading.Lock()
+    real = eng.process_task
+
+    def counting(snap, q, schema):
+        key = qcache.task_key(q)
+        with lock:
+            calls[key] = calls.get(key, 0) + 1
+        import time
+        time.sleep(0.01)              # widen the overlap window
+        return real(snap, q, schema)
+
+    monkeypatch.setattr(eng, "process_task", counting)
+    results = [None] * 6
+    errs = []
+
+    def run(i):
+        try:
+            results[i] = node.query(Q)[0]
+        except Exception as e:        # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    # one underlying execution per distinct task, however many callers
+    assert all(n == 1 for n in calls.values()), calls
+    assert len(calls) > 0
+    assert all(json.dumps(r, sort_keys=True) ==
+               json.dumps(results[0], sort_keys=True) for r in results)
+    node.close()
+
+
+def test_singleflight_waiters_share_leader_error():
+    cache = qcache.TaskResultCache(1 << 20, Registry())
+    barrier = threading.Barrier(3)
+    boom = RuntimeError("boom")
+    n_calls = [0]
+
+    def compute(q):
+        barrier.wait(timeout=5)
+        n_calls[0] += 1
+        import time
+        time.sleep(0.02)
+        raise boom
+
+    q = TaskQuery("a", frontier=np.asarray([1, 2], dtype=np.int64))
+    errs = []
+
+    def run(first):
+        try:
+            if not first:
+                barrier.wait(timeout=5)
+            cache.dispatch(1, q, compute)
+        except RuntimeError as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(i == 0,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(errs) == 3 and all(e is boom for e in errs)
+    assert n_calls[0] == 1            # followers joined the failed flight
+
+
+# ---------------------------------------------------------------------------
+# task/result cache mechanics
+# ---------------------------------------------------------------------------
+
+def _mk_result(n) -> TaskResult:
+    return TaskResult(uid_matrix=[np.arange(n, dtype=np.int64)],
+                      counts=[n],
+                      dest_uids=np.arange(n, dtype=np.int64))
+
+
+def test_task_cache_byte_eviction():
+    reg = Registry()
+    one = qcache.result_nbytes(_mk_result(100))
+    cache = qcache.TaskResultCache(int(one * 2.5), reg)
+    for i in range(4):
+        q = TaskQuery(f"p{i}")
+        cache.dispatch(1, q, lambda _q: _mk_result(100))
+    assert len(cache) == 2            # LRU kept the newest two
+    assert reg.counter("dgraph_task_cache_evicted_total").value == 2
+    assert cache.bytes <= int(one * 2.5)
+    # oldest evicted, newest still hits
+    hits0 = reg.counter("dgraph_task_cache_hits_total").value
+    cache.dispatch(1, TaskQuery("p3"), lambda _q: _mk_result(100))
+    assert reg.counter("dgraph_task_cache_hits_total").value == hits0 + 1
+
+
+def test_task_cache_copy_isolation():
+    cache = qcache.TaskResultCache(1 << 20, Registry())
+    q = TaskQuery("p")
+    a = cache.dispatch(1, q, lambda _q: _mk_result(4))
+    a.uid_matrix[0] = np.zeros(0, np.int64)   # caller prunes its copy
+    a.counts[0] = 0
+    b = cache.dispatch(1, q, lambda _q: _mk_result(4))
+    assert len(b.uid_matrix[0]) == 4 and b.counts[0] == 4
+
+
+def test_result_cache_eviction_and_roundtrip():
+    reg = Registry()
+    cache = qcache.ResultCache(600, reg)
+    out = {"q": [{"uid": "0x1", "vals": list(range(20))}]}
+    cache.put(("k1",), out)
+    got = cache.get(("k1",))
+    assert got == out and got is not out
+    got["q"].append("mutated")        # hits hand out independent copies
+    assert cache.get(("k1",)) == out
+    for i in range(8):
+        cache.put((f"k{i}",), out)
+    assert cache.bytes <= 600
+    assert reg.counter("dgraph_result_cache_evicted_total").value > 0
+
+
+def test_enforce_memory_evicts_caches():
+    node = _node()
+    node.query(Q)
+    assert node.result_cache.bytes > 0
+    stats = node.enforce_memory(1)    # 1-byte budget: everything must go
+    assert stats["task_cache_evicted"] > 0
+    assert node.result_cache.bytes == 0 and node.task_cache.bytes == 0
+    got, _ = node.query(Q)            # rebuilt read-through
+    assert got["q"]
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch gate
+# ---------------------------------------------------------------------------
+
+def test_dispatch_gate_bounds_concurrency():
+    reg = Registry()
+    gate = qcache.DispatchGate(2, reg)
+    active, peak = [0], [0]
+    lock = threading.Lock()
+
+    def work():
+        with lock:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        import time
+        time.sleep(0.02)
+        with lock:
+            active[0] -= 1
+
+    ts = [threading.Thread(target=lambda: gate.run(work)) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert peak[0] <= 2
+    assert reg.counter("dgraph_dispatch_waits_total").value > 0
+    assert reg.counter("dgraph_dispatch_inflight").value == 0
+
+
+# ---------------------------------------------------------------------------
+# per-request edge budget
+# ---------------------------------------------------------------------------
+
+def test_per_executor_edge_limit_overrides_global():
+    node = _node()
+    node.task_cache = node.result_cache = None
+    q = '{ q(func: uid(0x1)) { friend { friend { name } } } }'
+    with pytest.raises(QueryError):
+        node.query(q, edge_limit=1)
+    out, _ = node.query(q)            # module default untouched
+    assert out["q"]
+    assert eng.MAX_QUERY_EDGES == 1_000_000
+    node.close()
+
+
+def test_executor_edge_budget_reads_global_dynamically():
+    snap = type("S", (), {"preds": {}, "read_ts": 1,
+                          "pred": lambda self, a: None})()
+    from dgraph_tpu.utils.schema import SchemaState
+
+    ex = Executor.__new__(Executor)
+    ex.edge_limit = None
+    old = eng.MAX_QUERY_EDGES
+    try:
+        eng.set_query_edge_limit(7)
+        assert ex.edge_budget() == 7
+        ex.edge_limit = 3
+        assert ex.edge_budget() == 3
+    finally:
+        eng.set_query_edge_limit(old)
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_variables_signature():
+    reg = Registry()
+    pc = qcache.PlanCache(8, reg)
+    q = 'query q($a: int) { q(func: eq(age, $a)) { name } }'
+    r1 = pc.parse(q, {"$a": 21})
+    r2 = pc.parse(q, {"$a": 21})
+    r3 = pc.parse(q, {"$a": 22})
+    assert r1 is r2 and r1 is not r3    # same text+vars hits, new vars miss
+    assert reg.counter("dgraph_plan_cache_hits_total").value == 1
+    assert reg.counter("dgraph_plan_cache_misses_total").value == 2
+
+
+# ---------------------------------------------------------------------------
+# /debug/metrics HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_debug_metrics_http_surface():
+    from dgraph_tpu.api.http import serve_forever
+
+    node = _node()
+    srv = serve_forever(node, port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        body = Q.encode()
+        for _ in range(3):
+            req = urllib.request.Request(
+                base + "/query", data=body, method="POST",
+                headers={"Content-Type": "application/graphql+-"})
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 200
+        with urllib.request.urlopen(base + "/debug/metrics") as r:
+            m = json.loads(r.read())
+        assert m["caches"]["plan"]["hits"] > 0
+        assert m["caches"]["result"]["hits"] > 0
+        assert m["caches"]["task"]["hit_rate"] >= 0
+        assert m["endpoints"]["query"]["qps"] > 0
+        assert m["endpoints"]["query"]["latency"]["count"] == 3
+        assert m["dispatch"]["width"] >= 1
+        assert "dgraph_task_cache_hits_total" in m["vars"]
+    finally:
+        srv.shutdown()
+        node.close()

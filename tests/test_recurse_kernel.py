@@ -113,6 +113,11 @@ def test_shortest_kernel_bfs_matches_host(rng, monkeypatch):
     from dgraph_tpu.query import shortest as sh
 
     node = _graph_node(rng, n=60)
+    # this test probes WHICH execution path runs (host Dijkstra vs Pallas
+    # kernel) by replaying identical queries after flipping module floors;
+    # the whole-query result cache would legitimately serve the replay
+    # without executing anything, so opt out of that tier here
+    node.result_cache = None
     # pick reachable pairs from the host path first
     monkeypatch.setattr(sh, "DEVICE_SSSP_MIN_EDGES", 1 << 62)  # host Dijkstra
     pairs = []
