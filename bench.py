@@ -26,9 +26,13 @@ loops; the reference's own inner loops are scalar Go over bp128 blocks).
   * `throughput` — the round-6 serving-layer battery: N worker threads
     replaying a mixed stream of configs 2-5 against one Node, median QPS
     with band, cold (caches off) vs warm (plan/task/result caches on).
+  * `freshness` — the round-7 delta-overlay battery: single-quad
+    commit-to-visible latency on the 240k-edge follows tablet and
+    warm-QPS retention of an unrelated-predicate replay under a 10%
+    write mix, overlay on vs off.
 
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"band", "query_path", "query_configs", "throughput"}.
+"band", "query_path", "query_configs", "throughput", "freshness"}.
 """
 
 import json
@@ -245,6 +249,122 @@ def bench_throughput(n_people=20000, follows=12, workers=4, reps=3,
     return out
 
 
+def bench_freshness(n_people=20000, follows=12, workers=4, reps=3,
+                    batches=2, commits=6):
+    """Round-7 delta-overlay battery: mutation-heavy freshness on the film
+    graph (the `follows` tablet is ~n_people*follows edges — 240k at the
+    default scale).
+
+      * commit_visible_ms — single-quad commit on `follows` -> the NEXT
+        query (which must see the new edge, verified) completes; the
+        overlay stamps O(Δ) instead of re-folding the tablet.
+      * pure/mixed QPS — N workers replay value-predicate queries
+        (name/age/genre — none reads `follows`) warm-cached, with and
+        without a 10% single-quad-commit write mix on `follows`;
+        `retention` = mixed/pure. Per-predicate cache tokens keep the
+        unrelated replay's heat across the writes.
+
+    Both measured overlay on vs off (cold = caches off also reported once:
+    the fold cost itself, not cache effects)."""
+    import threading
+
+    from dgraph_tpu.models.film import film_node
+
+    queries = [
+        '{ q(func: eq(age, 30), first: 20) { uid age } }',
+        '{ q(func: eq(name, "p7")) { name } }',
+        '{ q(func: eq(genre, "noir"), first: 5) { name } }',
+        '{ q(func: has(age)) @groupby(genre) '
+        '{ count(uid) a : avg(val(ag)) } '
+        '  var(func: has(age)) { ag as age } }',
+    ]
+    probe = '{ q(func: uid(0x1)) { follows { uid } } }'
+    out = {}
+    fresh_uid = [n_people + 100]
+
+    def one_commit_visible(node):
+        fresh_uid[0] += 1
+        want = f"0x{fresh_uid[0]:x}"
+        t0 = time.perf_counter()
+        node.mutate(set_nquads=f'<0x1> <follows> <{want}> .',
+                    commit_now=True)
+        res, _ = node.query(probe)
+        dt = (time.perf_counter() - t0) * 1e3
+        assert want in {x["uid"] for x in res["q"][0]["follows"]}, \
+            "commit not visible"
+        return dt
+
+    def measure_qps(node, write_every):
+        """Replay `queries` across workers; every write_every-th op is a
+        single-quad commit on follows (0 = pure reads). QPS counts reads
+        over the full elapsed time, so write-induced stalls show up."""
+        op = [0]
+        oplock = threading.Lock()
+
+        def replay(r):
+            for _ in range(r):
+                for qt in queries:
+                    with oplock:
+                        op[0] += 1
+                        turn = op[0]
+                    if write_every and turn % write_every == 0:
+                        with oplock:
+                            fresh_uid[0] += 1
+                            u = fresh_uid[0]
+                        node.mutate(
+                            set_nquads=f'<0x1> <follows> <0x{u:x}> .',
+                            commit_now=True)
+                    node.query(qt)
+
+        samples = []
+        for _batch in range(batches):
+            ts = [threading.Thread(target=replay, args=(reps,))
+                  for _ in range(workers)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            samples.append(workers * reps * len(queries) /
+                           (time.perf_counter() - t0))
+        return _band(samples)
+
+    for overlay in (True, False):
+        node = film_node(n_people=n_people, follows=follows)
+        node._assembler.overlay_enabled = overlay
+        node.query(probe)                      # fold + jit warmup
+        visible = _band([one_commit_visible(node) for _ in range(commits)])
+        # cold pass: caches off — the raw fold-vs-stamp cost
+        caches = (node.plan_cache, node.task_cache, node.result_cache)
+        node.plan_cache = node.task_cache = node.result_cache = None
+        for qt in queries:
+            node.query(qt)
+        cold = {"pure_qps": measure_qps(node, 0),
+                "mixed_qps": measure_qps(node, 10)}
+        cold["retention"] = round(cold["mixed_qps"]["median"] /
+                                  max(cold["pure_qps"]["median"], 1e-9), 3)
+        node.plan_cache, node.task_cache, node.result_cache = caches
+        for _ in range(2):                     # fill every cache tier
+            for qt in queries:
+                node.query(qt)
+        warm = {"pure_qps": measure_qps(node, 0),
+                "mixed_qps": measure_qps(node, 10)}
+        warm["retention"] = round(warm["mixed_qps"]["median"] /
+                                  max(warm["pure_qps"]["median"], 1e-9), 3)
+        c = lambda n: node.metrics.counter(n).value
+        out["overlay_on" if overlay else "overlay_off"] = {
+            "commit_visible_ms": visible, "cold": cold, "warm": warm,
+            "overlay_stamps": c("dgraph_overlay_stamps_total"),
+            "compactions": c("dgraph_compactions_total"),
+            "invalidations_avoided":
+                c("dgraph_cache_invalidations_avoided_total")}
+        node.close()
+    out["commit_visible_speedup"] = round(
+        out["overlay_off"]["commit_visible_ms"]["median"] /
+        max(out["overlay_on"]["commit_visible_ms"]["median"], 1e-9), 1)
+    return out
+
+
 def bench_query_configs():
     """BASELINE configs 2-5: DQL text in -> JSON out on the film graph."""
     from dgraph_tpu.models.film import film_node
@@ -345,6 +465,10 @@ def main():
         throughput = bench_throughput()
     except Exception as e:  # serving-tier battery must not sink it either
         throughput = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        freshness = bench_freshness()
+    except Exception as e:  # overlay battery must not sink it either
+        freshness = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -356,6 +480,7 @@ def main():
         "query_path": query_path,
         "query_configs": query_configs,
         "throughput": throughput,
+        "freshness": freshness,
     }))
 
 

@@ -25,7 +25,12 @@ def cmd_serve(args) -> int:
                 plan_cache_size=args.plan_cache,
                 task_cache_mb=args.task_cache_mb,
                 result_cache_mb=args.result_cache_mb,
-                dispatch_width=args.dispatch_width)
+                dispatch_width=args.dispatch_width,
+                overlay=not args.no_overlay,
+                overlay_max_keys=args.overlay_max_keys,
+                overlay_max_age_s=args.overlay_max_age_s,
+                background_rollup=not args.no_background_rollup,
+                fold_workers=args.fold_workers or None)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
@@ -291,6 +296,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="query-result cache budget in MB (0 disables)")
     sp.add_argument("--dispatch_width", type=int, default=4,
                     help="max simultaneous device dispatches")
+    sp.add_argument("--no_overlay", action="store_true",
+                    help="disable delta-overlay stamping (commits re-fold "
+                         "their whole tablet)")
+    sp.add_argument("--overlay_max_keys", type=int, default=None,
+                    help="overlay depth ceiling before inline compaction "
+                         "(default 512)")
+    sp.add_argument("--overlay_max_age_s", type=float, default=None,
+                    help="overlay age before background rollup (default 30)")
+    sp.add_argument("--no_background_rollup", action="store_true",
+                    help="disable the background overlay compaction loop")
+    sp.add_argument("--fold_workers", type=int, default=0,
+                    help="parallel tablet-fold threads (0 = auto)")
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")

@@ -87,10 +87,14 @@ GET /state /health /debug/vars /debug/metrics</span></header>
 async function show(r, t0){
   const txt = await r.text();
   let lat = (performance.now()-t0).toFixed(0)+' ms';
-  try{           // serving-layer readout: QPS + task-cache hit rate
+  try{           // serving-layer readout: QPS, hit rate, overlay state
     const m = await (await fetch('/debug/metrics')).json();
     lat += ' · ' + m.endpoints.query.qps + ' qps · hit ' +
         (100*m.caches.task.hit_rate).toFixed(0) + '%';
+    const ov = m.overlay || {};
+    const depth = Object.values(ov.depth||{}).reduce((a,b)=>a+b,0);
+    if (ov.stamps) lat += ' · Δ' + depth + ' (' + ov.stamps + ' stamps, ' +
+        (ov.compactions||0) + ' rollups)';
   }catch(e){}
   document.getElementById('lat').textContent = lat;
   try{document.getElementById('out').textContent =
@@ -162,6 +166,22 @@ def _serving_metrics(node: Node) -> dict:
             "width": node.dispatch_gate.width,
             "in_flight": c("dgraph_dispatch_inflight"),
             "waits": c("dgraph_dispatch_waits_total"),
+        },
+        # delta-overlay maintenance tier: O(Δ) commit-to-visible stamping,
+        # background compaction, parallel cold folds, and the task/result
+        # cache invalidations the per-predicate tokens avoided
+        "overlay": {
+            "stamps": c("dgraph_overlay_stamps_total"),
+            "fold_fallbacks": c("dgraph_overlay_fold_fallbacks_total"),
+            "depth": node._assembler.overlay_stats(),
+            "bytes": node._assembler.overlay_bytes(),
+            "journal": node.store.delta_log_stats(),
+            "compactions": c("dgraph_compactions_total"),
+            "compaction_s": m.histogram("dgraph_compaction_s").snapshot(),
+            "invalidations_avoided":
+                c("dgraph_cache_invalidations_avoided_total"),
+            "parallel_folds": c("dgraph_parallel_folds_total"),
+            "fold_pool_width": c("dgraph_fold_pool_width"),
         },
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),

@@ -76,7 +76,12 @@ class Node:
                  plan_cache_size: int = 256,
                  task_cache_mb: int = 64,
                  result_cache_mb: int = 32,
-                 dispatch_width: int = 4) -> None:
+                 dispatch_width: int = 4,
+                 overlay: bool = True,
+                 overlay_max_keys: int | None = None,
+                 overlay_max_age_s: float | None = None,
+                 background_rollup: bool = True,
+                 fold_workers: int | None = None) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -104,13 +109,25 @@ class Node:
         self._sched = Scheduler()            # conflict-keyed mutation apply
         # incremental per-predicate snapshot reuse (shared with the worker
         # wire service and follower readers): a commit touching one
-        # predicate re-folds one predicate
+        # predicate STAMPS a delta overlay on one predicate (storage/
+        # delta.py) — or re-folds it when the journal can't prove the delta
         self._assembler = SnapshotAssembler(
             self.store,
             on_pred_build=lambda attr: self.metrics.counter(
                 "dgraph_posting_reads_total").inc(
                     len(self.store.by_pred.get(
-                        (int(K.KeyKind.DATA), attr), ()))))
+                        (int(K.KeyKind.DATA), attr), ()))),
+            metrics=self.metrics,
+            overlay_enabled=overlay,
+            overlay_max_keys=overlay_max_keys,
+            overlay_max_age_s=overlay_max_age_s,
+            fold_workers=fold_workers)
+        # background rollup: overlays past the size/age threshold fold back
+        # into fresh bases OFF the query path (posting-list rollups one
+        # level up); started lazily on the first stamped overlay
+        self.background_rollup = background_rollup
+        self._rollup_stop = threading.Event()
+        self._rollup_started = False
         if self.store.max_seen_commit_ts:
             # recover the ts sequence past everything the WAL replayed
             self.zero.oracle.timestamps(self.store.max_seen_commit_ts)
@@ -271,7 +288,28 @@ class Node:
         with self._lock:
             if read_ts is None:
                 read_ts = self.zero.oracle.read_ts()
-            return self._assembler.snapshot(read_ts)
+            snap = self._assembler.snapshot(read_ts)
+            if self.background_rollup and not self._rollup_started and \
+                    self._assembler._overlays:
+                self._start_rollup_loop()
+            return snap
+
+    # overlays older than this many seconds (or deeper than the stamp
+    # ceiling) compact on the next tick
+    ROLLUP_TICK_S = 1.0
+
+    def _start_rollup_loop(self) -> None:
+        self._rollup_started = True
+
+        def loop():
+            while not self._rollup_stop.wait(self.ROLLUP_TICK_S):
+                try:
+                    if self._assembler.compact_candidates():
+                        self._assembler.compact(self._lock)
+                except Exception:
+                    pass     # next tick retries; queries are unaffected
+        threading.Thread(target=loop, daemon=True,
+                         name="dgt-rollup").start()
 
     def _invalidate_snapshots(self) -> None:
         with self._lock:
@@ -372,10 +410,13 @@ class Node:
             else:
                 read_ts, snap = self._read_view(start_ts)
             tr.printf("snapshot at ts %d (%d preds)", read_ts, len(snap.preds))
-            # whole-query result tier: keyed on (plan key, snapshot token,
-            # edge budget); the snapshot token rotates on every commit /
-            # alter / drop / txn-overlay version bump, so a mutation between
-            # repeats always forces re-execution
+            # whole-query result tier: keyed on (plan key, per-predicate
+            # token tuple of the plan's read set, edge budget). A commit to
+            # predicate P rotates only P's PredData token, so replays that
+            # never read P keep their cache heat; plans whose read set
+            # isn't statically derivable (explicit uids, expand, shortest)
+            # key on the snapshot object and rotate on every commit /
+            # alter / drop / txn-overlay version bump as before
             rkey = None
             if self.result_cache is not None and not req.mutations:
                 pk = qcache.plan_key(q, variables)
@@ -388,7 +429,7 @@ class Node:
 
                     eff = edge_limit if edge_limit is not None \
                         else _eng.MAX_QUERY_EDGES
-                    rkey = (pk, qcache.snapshot_token(snap), eff)
+                    rkey = (pk, qcache.result_token(req, snap), eff)
                     cached = self.result_cache.get(rkey)
                     if cached is not None:
                         tr.printf("result cache hit")
@@ -661,6 +702,13 @@ class Node:
             if self.task_cache is not None and over > 0:
                 cache_evicted += self.task_cache.evict_to(
                     max(0, self.task_cache.bytes - over))
+        # overlay rows are pure acceleration state: force-compact them back
+        # into folded bases before the invalidate hammer (keeps cache heat)
+        compacted = 0
+        overlay_bytes = self._assembler.overlay_bytes()
+        if overlay_bytes and stats["bytes"] + overlay_bytes > budget_bytes:
+            compacted = self._assembler.compact(self._lock, force=True)
+            overlay_bytes = self._assembler.overlay_bytes()
         dropped_snaps = 0
         if stats["bytes"] > budget_bytes:
             with self._lock:
@@ -669,7 +717,9 @@ class Node:
         return {"bytes": stats["bytes"], "lists": stats["lists"],
                 "layers": stats["layers"], "rolled_up": rolled,
                 "dropped_caches": dropped_snaps,
-                "task_cache_evicted": cache_evicted}
+                "task_cache_evicted": cache_evicted,
+                "overlay_bytes": overlay_bytes,
+                "overlays_compacted": compacted}
 
     # -- ops -----------------------------------------------------------------
 
@@ -681,4 +731,5 @@ class Node:
         return self.zero.state()
 
     def close(self) -> None:
+        self._rollup_stop.set()
         self.store.close()

@@ -95,6 +95,21 @@ def expand(indptr: jax.Array, indices: jax.Array, rows: jax.Array, out_cap: int)
     return ExpandResult(out, seg_out, counts, total)
 
 
+def expand_masked(
+    indptr: jax.Array, indices: jax.Array, rows: jax.Array,
+    patched: jax.Array, out_cap: int
+) -> ExpandResult:
+    """Base-side half of a delta-overlay merge-on-read (storage/delta.py
+    OverlayCSR): expand the frontier over the UNCHANGED base arrays with the
+    overlay-patched slots masked to sentinel — their rows come from the
+    overlay's host-resident replacement rows, which the caller splices into
+    the uidMatrix. The base device arrays are never rebuilt or re-uploaded;
+    an overlay commit costs the delta, not the tablet."""
+    snt = sentinel(rows.dtype)
+    rows = jnp.where(jnp.asarray(patched), snt, rows)
+    return expand(indptr, indices, rows, out_cap)
+
+
 def expand_dest(
     indptr: jax.Array, indices: jax.Array, rows: jax.Array, out_cap: int
 ) -> tuple[jax.Array, jax.Array]:

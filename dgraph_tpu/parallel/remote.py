@@ -185,14 +185,14 @@ class WorkerService:
         from ..utils import metrics as metrics_mod
 
         self.store = store
-        self._assembler = SnapshotAssembler(store)
+        self.metrics = metrics_mod.Registry()
+        self._assembler = SnapshotAssembler(store, metrics=self.metrics)
         self._lock = threading.Lock()
         # server-side task-result cache: repeated/fanned-out ServeTask
         # calls for the same (snapshot, task) answer from memory, and
-        # concurrent identical tasks coalesce onto one execution. Keyed on
-        # the snapshot object token — the assembler replaces (never
-        # mutates) snapshots on any visible commit/replay/drop.
-        self.metrics = metrics_mod.Registry()
+        # concurrent identical tasks coalesce onto one execution. Keyed
+        # per predicate — the assembler replaces (never mutates) a
+        # PredData on any visible commit/overlay-stamp/replay/drop.
         self.task_cache = TaskResultCache(32 << 20, self.metrics)
         # replica-read gate concurrency cap (see serve_task convoy guard)
         self._gate_slots = threading.BoundedSemaphore(2)
@@ -271,11 +271,11 @@ class WorkerService:
                         time.sleep(0.01)
                 finally:
                     self._gate_slots.release()
-        from ..query.qcache import snapshot_token
+        from ..query.qcache import task_token
 
         snap = self._snapshot(read_ts)
         res = self.task_cache.dispatch(
-            snapshot_token(snap), q,
+            task_token(snap, q), q,
             lambda tq: process_task(snap, tq, self.store.schema))
         return encode_result(res)
 
@@ -712,7 +712,8 @@ class WorkerService:
 
                     _sh.rmtree(d, ignore_errors=True)
                 with self._lock:
-                    self._assembler = SnapshotAssembler(self.store)
+                    self._assembler = SnapshotAssembler(
+                        self.store, metrics=self.metrics)
                 self._last_seq = int(resp.session_seq)
         except Exception:
             pass                       # next gap retries the sync

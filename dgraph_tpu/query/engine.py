@@ -108,10 +108,13 @@ class Executor:
             inner = raw
             raw = lambda q: gate.run(lambda: inner(q))
         if cache is not None:
-            from dgraph_tpu.query.qcache import snapshot_token
+            from dgraph_tpu.query.qcache import task_token
 
-            token = snapshot_token(snap)
-            self._dispatch = lambda q: cache.dispatch(token, q, raw)
+            # per-PREDICATE tokens (not per-snapshot): a commit to P rotates
+            # only P's task keys, so unrelated predicates keep their cache
+            # heat across writes (the delta-overlay tier's cache contract)
+            self._dispatch = lambda q: cache.dispatch(
+                task_token(snap, q), q, raw)
         else:
             self._dispatch = raw
 
@@ -707,7 +710,13 @@ def _known_uids(snap: GraphSnapshot) -> np.ndarray:
     for pd in snap.preds.values():
         parts.append(pd.has_subjects().astype(np.int64))
         if pd.csr is not None:
-            parts.append(np.asarray(pd.csr.indices).astype(np.int64))
+            if hasattr(pd.csr, "host_arrays"):
+                # cached host mirror (PredCSR) / host-side merge (overlay)
+                # — never a device upload + download just to enumerate uids
+                parts.append(np.asarray(
+                    pd.csr.host_arrays()[2]).astype(np.int64))
+            else:    # mesh-sharded tablet: device fetch
+                parts.append(np.asarray(pd.csr.indices).astype(np.int64))
     out = np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
     snap._known_uids_cache = out
     return out

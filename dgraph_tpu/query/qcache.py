@@ -67,6 +67,87 @@ def snapshot_token(snap):
     return tok
 
 
+def task_token(snap, q) -> object:
+    """PER-PREDICATE cache version for one task: the token of the PredData
+    OBJECT serving q.attr. The assembler reuses PredData identity for clean
+    predicates and replaces it on any visible change (fold, delta-overlay
+    stamp, txn overlay), so a commit to predicate P rotates ONLY P's task
+    keys — every other predicate's cache heat survives the write. A task
+    reads exactly its own predicate's PredData (process_task), which makes
+    this sound."""
+    attr = q.attr[1:] if q.attr.startswith("~") else q.attr
+    pd = snap.preds.get(attr)
+    if pd is None:
+        # absent predicate: fall back to the snapshot object (predicate
+        # creation replaces the snapshot, so stale "empty" results die)
+        return ("miss", snapshot_token(snap), attr)
+    return snapshot_token(pd)     # same counter machinery, per-object
+
+
+def plan_attrs(req) -> list[str] | None:
+    """Predicates a parsed request can read, statically derived from the
+    plan; None = not derivable (explicit uids validate against the known-uid
+    set of EVERY predicate; expand()/shortest read dynamically), in which
+    case the caller must key on the whole snapshot."""
+    out: set[str] = set()
+
+    def add_attr(attr: str) -> None:
+        if attr:
+            out.add(attr[1:] if attr.startswith("~") else attr)
+
+    def walk_filter(ft) -> bool:
+        if ft is None:
+            return True
+        if ft.func is not None:
+            add_attr(ft.func.attr)
+            return True
+        return all(walk_filter(c) for c in ft.children)
+
+    def walk(gq) -> bool:
+        if gq.uids or gq.shortest is not None or gq.expand:
+            return False
+        if gq.func is not None:
+            add_attr(gq.func.attr)
+        if not walk_filter(gq.filter):
+            return False
+        for o in gq.order:
+            if not o.is_val:
+                add_attr(o.attr)
+        if gq.groupby is not None:
+            for _alias, attr, _lang in gq.groupby.attrs:
+                add_attr(attr)
+        for c in gq.children:
+            if c.is_uid_node or c.attr in ("val", "math") or \
+                    c.attr.startswith("__agg_"):
+                if not walk_filter(c.filter):
+                    return False
+                continue
+            add_attr(c.attr)
+            if not walk(c):
+                return False
+        return True
+
+    for gq in req.queries:
+        if not walk(gq):
+            return None
+    return sorted(out)
+
+
+def result_token(req, snap) -> object:
+    """Whole-query cache version: the per-predicate token tuple of the
+    plan's read set when statically known, else the snapshot object token.
+    A commit to predicate P then rotates only the keys of plans that read P
+    — unrelated replays keep their result-cache heat across writes."""
+    attrs = plan_attrs(req)
+    if attrs is None:
+        return ("snap", snapshot_token(snap))
+    toks = []
+    for attr in attrs:
+        pd = snap.preds.get(attr)
+        toks.append(("miss", attr) if pd is None else snapshot_token(pd))
+    return tuple(toks)
+
+
 # ---------------------------------------------------------------------------
 # canonical task keys
 # ---------------------------------------------------------------------------

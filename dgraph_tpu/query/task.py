@@ -84,6 +84,50 @@ def rows_for_uids(csr: PredCSR, uids: np.ndarray) -> np.ndarray:
     return us.host_rank_of(subjects, uids, us.SENTINEL32).astype(np.int32)
 
 
+def _gather_rows_host(indptr_h: np.ndarray, indices_h: np.ndarray,
+                      rows: np.ndarray, deg: np.ndarray,
+                      offs: np.ndarray) -> np.ndarray:
+    """Flat host gather of per-row spans: rows (SENTINEL32 = skip) with
+    per-slot degree `deg` and output offsets `offs` (cumsum of deg) —
+    the shared inner step of the host expand paths."""
+    total = int(offs[-1])
+    ok = rows != us.SENTINEL32
+    rc = np.clip(rows, 0, max(len(indptr_h) - 2, 0))
+    starts = np.where(ok, indptr_h[rc], 0).astype(np.int64)
+    pos = np.repeat(starts - offs[:-1], deg) + np.arange(total)
+    return indices_h[pos].astype(np.int64)
+
+
+def _expand_overlay(ov, uids: np.ndarray) -> tuple[list[np.ndarray], int]:
+    """Merge-on-read expand over an OverlayCSR (storage/delta.py): gather
+    untouched rows from the UNCHANGED base (host mirror below the dispatch
+    cutover, ops/csr.expand_masked above it) and splice the overlay's
+    replacement rows per frontier slot — O(frontier + Δ), never a merge of
+    the tablet. The base device arrays keep identity: a commit costs its
+    delta, not a re-fold or re-upload."""
+    rb, ro, deg_b, deg_o = ov.frontier_plan(uids)
+    need_base = int(deg_b.sum())
+    total = need_base + int(deg_o.sum())
+    offs = np.zeros(len(uids) + 1, dtype=np.int64)
+    np.cumsum(deg_b, out=offs[1:])
+    base = ov.base
+    if base is None or need_base == 0:
+        base_targets = np.zeros(0, np.int64)
+    elif need_base <= HOST_EXPAND_MAX:
+        _, indptr_h, indices_h = base.host_arrays()
+        base_targets = _gather_rows_host(indptr_h, indices_h, rb, deg_b,
+                                         offs)
+    else:
+        cap = 1 << max(int(np.ceil(np.log2(need_base + 1))), 4)
+        res = csrops.expand_masked(base.indptr, base.indices,
+                                   jnp.asarray(rb), ro >= 0, out_cap=cap)
+        base_targets = np.asarray(res.targets)[:need_base].astype(np.int64)
+    matrix = [base_targets[offs[i]: offs[i + 1]] for i in range(len(uids))]
+    for i in np.flatnonzero(ro >= 0).tolist():
+        matrix[i] = ov.delta.rows[ro[i]]
+    return matrix, total
+
+
 def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np.ndarray], int]:
     """uidMatrix for a frontier over one adjacency; device gather + host split.
 
@@ -92,12 +136,16 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
     rounded to a pow2 capacity class to bound jit recompiles — NOT the
     predicate's total edge count. A 1-uid frontier on a 16M-edge predicate
     allocates its own degree, not the whole edge array."""
+    from dgraph_tpu.storage.delta import OverlayCSR
+
     if len(uids) == 0 or csr is None:
         return [np.zeros(0, np.int64) for _ in range(len(uids))], 0
     if getattr(csr, "is_dist", False):
         # mesh-sharded tablet: SPMD expand over the owning group's submesh
         # (ProcessTaskOverNetwork remapped to ICI, parallel/dist.DistPredCSR)
         matrix, total = csr.expand_matrix(uids)
+    elif isinstance(csr, OverlayCSR):
+        matrix, total = _expand_overlay(csr, uids)
     else:
         rows = rows_for_uids(csr, uids)
         indptr_h = csr.host_arrays()[1]
@@ -111,12 +159,10 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
             # a small gather is microseconds on the cached host mirror but
             # pays fixed per-dispatch + sync latency on device — the device
             # path wins only once the edge volume amortizes it
-            indices_h = csr.host_arrays()[2]
-            starts = np.where(ok, indptr_h[rc], 0).astype(np.int64)
             offs = np.zeros(len(uids) + 1, dtype=np.int64)
             np.cumsum(deg, out=offs[1:])
-            pos = np.repeat(starts - offs[:-1], deg) + np.arange(need)
-            targets = indices_h[pos].astype(np.int64)
+            targets = _gather_rows_host(indptr_h, csr.host_arrays()[2],
+                                        rows, deg, offs)
             matrix = [targets[offs[i]: offs[i + 1]]
                       for i in range(len(uids))]
             total = need
@@ -169,13 +215,15 @@ def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
 
 
 def _index_uids_intersect_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
-    """Intersection of uid lists of the chosen token rows (allofterms)."""
+    """Intersection of uid lists of the chosen token rows (allofterms) —
+    on the cached host mirrors (overlay-merged indexes never pay a device
+    round-trip here)."""
     if not rows:
         return np.zeros(0, np.int64)
-    indptr = np.asarray(ti.indptr)
+    indptr, uids_h = ti.host_arrays()
     out = None
     for r in rows:
-        u = np.asarray(ti.uids)[indptr[r] : indptr[r + 1]].astype(np.int64)
+        u = uids_h[indptr[r]: indptr[r + 1]]
         out = u if out is None else us.intersect_host(out, u)
         if len(out) == 0:
             break
@@ -454,7 +502,9 @@ def _root_func(snap: GraphSnapshot, pd: PredData, schema, fname: str | None,
             # has(~pred): nodes with at least one INCOMING edge
             if pd.rev_csr is None:
                 return np.zeros(0, np.int64)
-            return np.asarray(pd.rev_csr.subjects).astype(np.int64)
+            from dgraph_tpu.storage.delta import csr_subjects_host
+
+            return csr_subjects_host(pd.rev_csr)
         return pd.has_subjects().astype(np.int64)
 
     if fname in ("le", "lt", "ge", "gt", "eq"):
@@ -509,9 +559,9 @@ def _count_func(pd: PredData, op: str, n: int,
     csr = pd.rev_csr if reverse else pd.csr
     if csr is None:
         return np.zeros(0, np.int64)
-    indptr = np.asarray(csr.indptr)
-    subjects = np.asarray(csr.subjects).astype(np.int64)
-    deg = indptr[1:] - indptr[:-1]
+    from dgraph_tpu.storage.delta import csr_subjects_degrees
+
+    subjects, deg = csr_subjects_degrees(csr)
     mask = {"eq": deg == n, "le": deg <= n, "lt": deg < n,
             "ge": deg >= n, "gt": deg > n}[op]
     return subjects[mask]
@@ -614,6 +664,16 @@ def _case_variants(tri: str) -> list[str]:
 _MAX_PLAN_ALTS = 16     # alternation product cap (planner bail-out)
 
 
+def _sre_parser():
+    """The stdlib regex parser module: re._parser on 3.11+, sre_parse
+    before (same API — the 3.11 rename left the parse() surface intact)."""
+    try:
+        import re._parser as sre
+    except ImportError:
+        import sre_parse as sre
+    return sre
+
+
 def _lit_alternatives(seq) -> list[list[str]] | None:
     """Required-literal analysis of a parsed regex sequence (simplified
     codesearch index/regexp, the planner behind worker/trigram.go:36).
@@ -624,8 +684,6 @@ def _lit_alternatives(seq) -> list[list[str]] | None:
     backrefs, min==0 repeats) contribute nothing and break the current run;
     group/repeat boundaries also break runs (never concatenate across them,
     "ab+c" must not claim "abc"). None = give up (caller scans)."""
-    import re._parser as sre
-
     alts: list[list[str]] = [[""]]      # per alternative: runs; last is open
 
     def brk(a):
@@ -690,9 +748,7 @@ def _trigram_plan(pattern: str) -> list[list[str]] | None:
     trigram's uid list). None = no branch has a literal >= 3 chars, or the
     pattern is beyond the planner — caller falls back to the full scan."""
     try:
-        import re._parser as sre
-
-        parsed = list(sre.parse(pattern))
+        parsed = list(_sre_parser().parse(pattern))
     except Exception:
         return None
     alts = _lit_alternatives(parsed)

@@ -1,0 +1,548 @@
+"""Incremental delta-overlay posting maintenance: O(Δ) commit-to-visible.
+
+Reference semantics: the reference never rebuilds the world on a write — a
+posting list is an immutable packed base plus a mutable delta layer merged at
+read time (posting/lists.go:243 read-through, posting/mvcc.go), compacted by
+background rollups. Our snapshot builder violated that: any commit moving a
+predicate's watermark re-folded the WHOLE tablet (build_pred) and re-uploaded
+the CSR, so a single-edge commit on a 16M-edge predicate paid O(tablet).
+
+This module restores the delta-main split at snapshot granularity:
+
+  * A commit's touched keys land in the store's per-predicate delta journal
+    (storage/store.py `delta_since`). The SnapshotAssembler STAMPS a cached
+    PredData with the journal delta instead of re-folding: replacement rows
+    for exactly the touched subjects, computed from each key's own layer
+    stack at read_ts — cost O(Δ), not O(tablet).
+  * `OverlayCSR` = unchanged base `PredCSR` (device arrays keep identity —
+    no re-fold, no re-upload) + sorted replacement rows for the touched
+    subjects. The hot expand path patches per-frontier-slot
+    (query/task._expand_csr merge-on-read via uidset.host_rank_of; device
+    path via ops/csr.expand_masked); cold consumers (kernels, sorts) see
+    lazily merged mirrors.
+  * Token indexes and value tables patch the same way: touched terms /
+    subjects are re-derived, everything else is shared BY REFERENCE with the
+    base PredData, so unrelated device arrays also keep identity.
+  * A size/age threshold triggers background compaction (csr_build.
+    SnapshotAssembler.compact): the overlay folds into a fresh base off the
+    query path — the rollup of posting/list.go, one level up.
+
+Byte-identity contract: a stamped PredData must be indistinguishable from a
+from-scratch `build_pred` at the same read_ts (contrib/scripts/
+smoke_ingest.sh asserts it). Replacement rows use the exact same per-key
+fold (`PostingList.uids` / csr_build's shared `_fold_value_subject`), so the
+contract holds by construction.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+from dgraph_tpu.ops import uidset as us
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.utils.types import TypeID
+
+_EMPTY64 = np.zeros(0, np.int64)
+
+
+class OverlayRows:
+    """Replacement rows for the touched subjects of one (kind, attr) CSR:
+    subject -> its COMPLETE sorted uid row at read_ts (empty = all edges
+    gone). Replacement (not add/del sets) keeps DEL_ALL, re-adds, and
+    mixed-op layers correct with one code path — the row is re-derived from
+    the key's own layer stack, which is O(that key), not O(tablet)."""
+
+    __slots__ = ("subs", "rows", "lens")
+
+    def __init__(self, subs: np.ndarray, rows: list[np.ndarray]) -> None:
+        self.subs = np.asarray(subs, dtype=np.int64)      # sorted, unique
+        self.rows = rows
+        self.lens = np.fromiter((len(r) for r in rows), np.int64,
+                                count=len(rows))
+
+    @property
+    def depth(self) -> int:
+        return len(self.rows)
+
+    def nbytes(self) -> int:
+        return int(self.subs.nbytes + self.lens.nbytes +
+                   sum(r.nbytes for r in self.rows))
+
+
+def overlay_rows(store, kbs: list[bytes], read_ts: int) -> OverlayRows:
+    """Build replacement rows for a delta's DATA/REVERSE keys at read_ts."""
+    from dgraph_tpu.storage.csr_build import MAX_DEVICE_UID
+
+    pairs = sorted((K.uid_of(kb), kb) for kb in kbs)
+    subs = np.asarray([s for s, _ in pairs], dtype=np.int64)
+    rows = []
+    for subj, kb in pairs:
+        pl = store.lists.get(kb)
+        u = pl.uids(read_ts) if pl is not None else _EMPTY64
+        if len(u) and int(u[-1]) > MAX_DEVICE_UID:
+            raise ValueError("object uid exceeds device uid space")
+        rows.append(u)
+    if len(subs) and int(subs[-1]) > MAX_DEVICE_UID:
+        raise ValueError(f"uid {subs[-1]} exceeds device uid space")
+    return OverlayRows(subs, rows)
+
+
+class OverlayCSR:
+    """PredCSR view = immutable base + replacement rows for touched
+    subjects. Duck-types PredCSR:
+
+      * `.base` keeps the original device arrays untouched (identity across
+        overlay-only commits — the no-re-upload contract).
+      * `subjects_host()` / `subjects_degrees_host()` merge subjects and
+        degrees only — O(N) vectorized, no edge copy (has(), count()).
+      * `host_arrays()` lazily materializes fully merged host mirrors
+        (recurse seed mapping, sorts — rare on overlaid predicates).
+      * `.subjects/.indptr/.indices` lazily upload merged device arrays for
+        kernel consumers; compaction soon replaces the overlay, so this is
+        a transient cost, never the steady state.
+      * the hot expand path never touches the merged mirrors:
+        `frontier_plan` hands task._expand_csr a per-slot patch plan.
+    """
+
+    is_dist = False
+
+    def __init__(self, base, delta: OverlayRows) -> None:
+        # stacking overlays would hide the true base: the assembler always
+        # re-stamps from the folded PredData, so `base` is plain (or None)
+        assert not isinstance(base, OverlayCSR)
+        self.base = base
+        self.delta = delta
+        self._subs_deg = None          # merged (subjects, degrees)
+        self._merged_host = None       # merged (subjects, indptr, indices)
+        self._merged_dev = None        # merged device PredCSR
+
+    # -- base mirrors --------------------------------------------------------
+
+    def _base_host(self):
+        if self.base is None:
+            return (_EMPTY64, np.zeros(1, np.int64), _EMPTY64)
+        return self.base.host_arrays()
+
+    # -- merged subject/degree view (O(N), no edge copy) ---------------------
+
+    def subjects_degrees_host(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._subs_deg is None:
+            bs, bip, _ = self._base_host()
+            bs = np.asarray(bs, dtype=np.int64)
+            deg_b = (np.asarray(bip[1:], np.int64)
+                     - np.asarray(bip[:-1], np.int64))
+            rb = us.host_rank_of(bs, self.delta.subs, -1)
+            keep = np.ones(len(bs), dtype=bool)
+            keep[rb[rb >= 0]] = False
+            add = self.delta.lens > 0          # empty rows fall out of the CSR
+            subs = np.concatenate([bs[keep], self.delta.subs[add]])
+            degs = np.concatenate([deg_b[keep], self.delta.lens[add]])
+            order = np.argsort(subs, kind="stable")
+            self._subs_deg = (subs[order], degs[order])
+        return self._subs_deg
+
+    def subjects_host(self) -> np.ndarray:
+        return self.subjects_degrees_host()[0]
+
+    @property
+    def num_subjects(self) -> int:
+        return len(self.subjects_host())
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.subjects_degrees_host()[1].sum())
+
+    def approx_nbytes(self) -> int:
+        base = 0
+        if self.base is not None:
+            base = int(self.base.subjects.nbytes + self.base.indptr.nbytes
+                       + self.base.indices.nbytes)
+        return base + self.delta.nbytes()
+
+    # -- hot-path merge plan (task._expand_csr) ------------------------------
+
+    def frontier_plan(self, uids: np.ndarray):
+        """Per-frontier-slot merge plan: (base rows with touched slots
+        masked to SENTINEL32, overlay row index or -1, base degree, overlay
+        degree). O(|frontier| log N + Δ) — never materializes the merge."""
+        bs, bip, _ = self._base_host()
+        ro = us.host_rank_of(self.delta.subs, uids, -1)
+        touched = ro >= 0
+        if len(bs) == 0:        # base-less overlay (tablet born from deltas)
+            rb = np.full(len(uids), us.SENTINEL32, np.int32)
+            deg_b = np.zeros(len(uids), np.int64)
+        else:
+            rb = us.host_rank_of(bs, uids, us.SENTINEL32).astype(np.int32)
+            rb = np.where(touched, us.SENTINEL32, rb).astype(np.int32)
+            rc = np.clip(rb, 0, len(bip) - 2)
+            bip = np.asarray(bip, dtype=np.int64)
+            deg_b = np.where(rb != us.SENTINEL32, bip[rc + 1] - bip[rc], 0)
+        lens = self.delta.lens
+        lc = np.clip(ro, 0, max(len(lens) - 1, 0))
+        deg_o = np.where(touched, lens[lc] if len(lens) else 0, 0)
+        return rb, ro, deg_b.astype(np.int64), deg_o.astype(np.int64)
+
+    # -- fully merged mirrors (cold consumers) -------------------------------
+
+    def host_arrays(self):
+        if self._merged_host is None:
+            bs, bip, bix = self._base_host()
+            bs = np.asarray(bs, dtype=np.int64)
+            bip = np.asarray(bip, dtype=np.int64)
+            bix = np.asarray(bix, dtype=np.int64)
+            rb = us.host_rank_of(bs, self.delta.subs, -1)
+            keep = np.ones(len(bs), dtype=bool)
+            keep[rb[rb >= 0]] = False
+            add = self.delta.lens > 0
+            ov_rows = [r for r, a in zip(self.delta.rows, add) if a]
+            ov_flat = (np.concatenate(ov_rows).astype(np.int64)
+                       if ov_rows else _EMPTY64)
+            ov_starts = np.zeros(len(ov_rows), np.int64)
+            if ov_rows:
+                np.cumsum(self.delta.lens[add][:-1], out=ov_starts[1:])
+            src = np.concatenate([bix, ov_flat])
+            subs = np.concatenate([bs[keep], self.delta.subs[add]])
+            counts = np.concatenate(
+                [bip[1:][keep] - bip[:-1][keep], self.delta.lens[add]])
+            starts = np.concatenate([bip[:-1][keep], len(bix) + ov_starts])
+            order = np.argsort(subs, kind="stable")
+            subs, counts, starts = subs[order], counts[order], starts[order]
+            indptr = np.zeros(len(subs) + 1, np.int64)
+            np.cumsum(counts, out=indptr[1:])
+            total = int(indptr[-1])
+            idx = (np.repeat(starts - indptr[:-1], counts)
+                   + np.arange(total, dtype=np.int64))
+            self._merged_host = (subs, indptr, src[idx])
+        return self._merged_host
+
+    def _merged_device(self):
+        if self._merged_dev is None:
+            import jax.numpy as jnp
+
+            from dgraph_tpu.storage.csr_build import PredCSR
+
+            subs, indptr, indices = self.host_arrays()
+            self._merged_dev = PredCSR(
+                jnp.asarray(subs.astype(np.int32)),
+                jnp.asarray(indptr.astype(np.int32)),
+                jnp.asarray(indices.astype(np.int32)))
+        return self._merged_dev
+
+    @property
+    def subjects(self):
+        return self._merged_device().subjects
+
+    @property
+    def indptr(self):
+        return self._merged_device().indptr
+
+    @property
+    def indices(self):
+        return self._merged_device().indices
+
+
+def csr_subjects_host(csr) -> np.ndarray:
+    """Host-side subject uids of a PredCSR-like, without forcing an overlay
+    edge merge (int64)."""
+    f = getattr(csr, "subjects_host", None)
+    if f is not None:
+        return f()
+    if hasattr(csr, "host_arrays"):
+        return np.asarray(csr.host_arrays()[0], dtype=np.int64)
+    return np.asarray(csr.subjects).astype(np.int64)   # mesh-sharded tablet
+
+
+def csr_subjects_degrees(csr) -> tuple[np.ndarray, np.ndarray]:
+    """(subjects, out-degrees) of a PredCSR-like — the count-index base
+    quantity — without forcing an overlay edge merge."""
+    f = getattr(csr, "subjects_degrees_host", None)
+    if f is not None:
+        return f()
+    if hasattr(csr, "host_arrays"):
+        s, ip, _ = csr.host_arrays()
+        ip = np.asarray(ip, dtype=np.int64)
+        return np.asarray(s, dtype=np.int64), ip[1:] - ip[:-1]
+    s = np.asarray(csr.subjects).astype(np.int64)
+    ip = np.asarray(csr.indptr).astype(np.int64)
+    return s, ip[1:] - ip[:-1]
+
+
+class LazyTokenIndex:
+    """TokenIndex duck-type over merged HOST columns: the terms list and
+    host mirrors are exact at stamp time (inequality walks, sorts, and the
+    sub-64k union path never touch the device); the device columns upload
+    lazily on the first large union."""
+
+    def __init__(self, terms: list[bytes], indptr: np.ndarray,
+                 uids: np.ndarray) -> None:
+        self.terms = terms
+        self._indptr_h = indptr.astype(np.int64)
+        self._uids_h = uids.astype(np.int64)
+        self._dev = None
+
+    def term_row(self, term: bytes) -> int:
+        i = bisect.bisect_left(self.terms, term)
+        return i if i < len(self.terms) and self.terms[i] == term else -1
+
+    def host_arrays(self):
+        return self._indptr_h, self._uids_h
+
+    def _device(self):
+        if self._dev is None:
+            import jax.numpy as jnp
+
+            self._dev = (jnp.asarray(self._indptr_h.astype(np.int32)),
+                         jnp.asarray(self._uids_h.astype(np.int32)))
+        return self._dev
+
+    @property
+    def indptr(self):
+        return self._device()[0]
+
+    @property
+    def uids(self):
+        return self._device()[1]
+
+
+def merge_token_index(base, patches: dict[bytes, np.ndarray]):
+    """base TokenIndex + {term: replacement uid row} -> merged index.
+    Empty replacement rows delete the term (build_pred never emits empty
+    index rows); unknown terms insert. O(T + rows) vectorized."""
+    if base is not None:
+        b_terms = list(base.terms)
+        b_indptr, b_uids = base.host_arrays()
+        b_indptr = np.asarray(b_indptr, dtype=np.int64)
+        b_uids = np.asarray(b_uids, dtype=np.int64)
+    else:
+        b_terms, b_indptr, b_uids = [], np.zeros(1, np.int64), _EMPTY64
+    keep = np.ones(len(b_terms), dtype=bool)
+    inserts: list[tuple[bytes, np.ndarray]] = []
+    for term in patches:
+        i = bisect.bisect_left(b_terms, term)
+        if i < len(b_terms) and b_terms[i] == term:
+            keep[i] = False
+        row = patches[term]
+        if len(row):
+            inserts.append((term, np.asarray(row, dtype=np.int64)))
+    inserts.sort(key=lambda t: t[0])
+    kept_idx = np.flatnonzero(keep)
+    terms = [b_terms[i] for i in kept_idx] + [t for t, _ in inserts]
+    counts = np.concatenate(
+        [b_indptr[kept_idx + 1] - b_indptr[kept_idx],
+         np.asarray([len(r) for _, r in inserts], dtype=np.int64)])
+    ins_flat = (np.concatenate([r for _, r in inserts])
+                if inserts else _EMPTY64)
+    ins_starts = np.zeros(len(inserts), np.int64)
+    if inserts:
+        np.cumsum(counts[len(kept_idx):][:-1], out=ins_starts[1:])
+    starts = np.concatenate([b_indptr[kept_idx], len(b_uids) + ins_starts])
+    order = np.argsort(np.array(terms, dtype=object), kind="stable") \
+        if terms else np.zeros(0, np.int64)
+    terms = [terms[i] for i in order]
+    counts, starts = counts[order], starts[order]
+    indptr = np.zeros(len(terms) + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    total = int(indptr[-1])
+    idx = (np.repeat(starts - indptr[:-1], counts)
+           + np.arange(total, dtype=np.int64))
+    src = np.concatenate([b_uids, ins_flat])
+    return LazyTokenIndex(terms, indptr, src[idx] if total else _EMPTY64)
+
+
+# ---------------------------------------------------------------------------
+# the stamp: cached PredData + journal delta -> patched PredData
+# ---------------------------------------------------------------------------
+
+def stamp_pred(store, attr: str, base_pd, read_ts: int,
+               dkeys: list[bytes]):
+    """Patch a folded PredData with a commit delta at read_ts — O(Δ).
+
+    base_pd MUST be a plain fold (never itself stamped — the assembler
+    re-stamps from the true base so overlays never stack). Untouched state
+    is shared BY REFERENCE with base_pd; every touched subject/term is
+    re-derived with the exact logic build_pred uses, so the result is
+    byte-identical to a from-scratch fold at read_ts. Raises on shapes the
+    stamp can't express (caller falls back to the full fold)."""
+    from dgraph_tpu.storage import csr_build as cb
+
+    entry = store.schema.get(attr)
+    tid = entry.type_id if entry else TypeID.DEFAULT
+    if tid != base_pd.type_id:
+        raise ValueError("schema type changed under the overlay")
+
+    data_k: list[bytes] = []
+    rev_k: list[bytes] = []
+    idx_k: list[bytes] = []
+    for kb in dkeys:
+        kind = kb[0]
+        if kind == int(K.KeyKind.DATA):
+            data_k.append(kb)
+        elif kind == int(K.KeyKind.REVERSE):
+            rev_k.append(kb)
+        elif kind == int(K.KeyKind.INDEX):
+            idx_k.append(kb)
+        # COUNT buckets are implicit in the CSR (degree) — nothing to patch
+
+    pd = cb.PredData(attr, tid)
+    # share everything by reference; touched pieces are replaced below
+    pd.csr = base_pd.csr
+    pd.rev_csr = base_pd.rev_csr
+    pd.value_subjects = base_pd.value_subjects
+    pd.value_subjects_host = base_pd.value_subjects_host
+    pd.num_values = base_pd.num_values
+    pd.num_values_host = base_pd.num_values_host
+    pd.host_values = base_pd.host_values
+    pd.list_values = base_pd.list_values
+    pd.lang_values = base_pd.lang_values
+    pd.facets = base_pd.facets
+    pd.indexes = base_pd.indexes
+
+    if data_k:
+        _stamp_data(store, pd, base_pd, entry, tid, data_k, read_ts)
+    if rev_k:
+        if entry is not None and entry.reverse:
+            base = base_pd.rev_csr
+            if isinstance(base, OverlayCSR):
+                raise ValueError("stacked overlay")
+            pd.rev_csr = OverlayCSR(base, overlay_rows(store, rev_k, read_ts))
+    if idx_k:
+        _stamp_indexes(store, pd, base_pd, entry, idx_k, read_ts)
+    return pd
+
+
+def _stamp_data(store, pd, base_pd, entry, tid, data_k, read_ts) -> None:
+    """Patch the forward CSR + value tables for the delta's DATA keys."""
+    from dgraph_tpu.storage import csr_build as cb
+
+    if isinstance(base_pd.csr, OverlayCSR):
+        raise ValueError("stacked overlay")
+    pairs = sorted((K.uid_of(kb), kb) for kb in data_k)
+    touched = np.asarray([s for s, _ in pairs], dtype=np.int64)
+    touched_set = set(touched.tolist())
+
+    uid_typed = tid == TypeID.UID
+    value_side = not uid_typed     # DEFAULT predicates may carry either
+    if value_side:
+        pd.host_values = {u: v for u, v in base_pd.host_values.items()
+                          if u not in touched_set}
+        pd.list_values = {u: v for u, v in base_pd.list_values.items()
+                          if u not in touched_set}
+        pd.lang_values = {u: v for u, v in base_pd.lang_values.items()
+                          if u not in touched_set}
+    if base_pd.facets:
+        pd.facets = {k: v for k, v in base_pd.facets.items()
+                     if k[0] not in touched_set}
+    else:
+        pd.facets = {}
+
+    edge_rows: list[np.ndarray] = []
+    val_entries: dict[int, float] = {}      # subj -> num mirror value
+    for subj, kb in pairs:
+        pl = store.lists.get(kb)
+        if pl is None:
+            edge_rows.append(_EMPTY64)
+            continue
+        u = pl.uids(read_ts)
+        if uid_typed:
+            # the flat fold's facet capture: only lists carrying postings
+            if pl.base_postings or pl.layers or pl.uncommitted:
+                for p in pl.live_map(read_ts).values():
+                    if p.facets:
+                        pd.facets[(int(subj), p.uid)] = p.facets
+            edge_rows.append(u)
+            continue
+        is_edge, num = cb._fold_value_subject(
+            pd, entry, tid, int(subj), pl, read_ts, None)
+        if is_edge:
+            edge_rows.append(u)
+        else:
+            edge_rows.append(_EMPTY64)     # value subject: no CSR row
+            if num is not None:
+                val_entries[int(subj)] = num
+
+    if len(touched) and int(touched[-1]) > cb.MAX_DEVICE_UID:
+        raise ValueError(f"uid {touched[-1]} exceeds device uid space")
+    for r in edge_rows:
+        if len(r) and int(r[-1]) > cb.MAX_DEVICE_UID:
+            raise ValueError("object uid exceeds device uid space")
+
+    rows = OverlayRows(touched, edge_rows)
+    if base_pd.csr is not None or rows.lens.any():
+        pd.csr = OverlayCSR(base_pd.csr, rows)
+
+    if value_side:
+        _patch_value_arrays(pd, base_pd, touched, val_entries)
+
+
+def _patch_value_arrays(pd, base_pd, touched: np.ndarray,
+                        val_entries: dict[int, float]) -> None:
+    """Splice the touched subjects into the sorted value tables (and their
+    device mirrors — they changed, so fresh uploads are correct here; the
+    uid-edge CSR is the identity-preserving one)."""
+    import jax.numpy as jnp
+
+    from dgraph_tpu.storage.csr_build import MAX_DEVICE_UID
+
+    vs = base_pd.value_subjects_host
+    nv = base_pd.num_values_host
+    if vs is None:
+        vs, nv = _EMPTY64, np.zeros(0, np.float64)
+    rb = us.host_rank_of(vs, touched, -1)
+    keep = np.ones(len(vs), dtype=bool)
+    keep[rb[rb >= 0]] = False
+    add_subs = np.asarray(sorted(val_entries), dtype=np.int64)
+    add_nums = np.asarray([val_entries[int(s)] for s in add_subs],
+                          dtype=np.float64)
+    new_vs = np.concatenate([vs[keep], add_subs])
+    new_nv = np.concatenate([nv[keep], add_nums])
+    order = np.argsort(new_vs, kind="stable")
+    new_vs, new_nv = new_vs[order], new_nv[order]
+    if len(new_vs) == 0:
+        pd.value_subjects = pd.value_subjects_host = None
+        pd.num_values = pd.num_values_host = None
+        return
+    if int(new_vs[-1]) > MAX_DEVICE_UID:
+        raise ValueError("value subject uid exceeds device uid space")
+    pd.value_subjects_host = new_vs
+    pd.value_subjects = jnp.asarray(new_vs.astype(np.int32))
+    pd.num_values_host = new_nv
+    pd.num_values = jnp.asarray(new_nv.astype(np.float32))
+
+
+def _stamp_indexes(store, pd, base_pd, entry, idx_k, read_ts) -> None:
+    """Patch touched token rows of each tokenizer's index."""
+    from dgraph_tpu.utils import tok as tokmod
+
+    if entry is None or not entry.indexed:
+        return          # index keys without schema index: nothing visible
+    ident_to_name = {tokmod.get(n).ident: n for n in entry.tokenizers}
+    per_tok: dict[str, dict[bytes, np.ndarray]] = {}
+    for kb in idx_k:
+        key = K.parse_key(kb)
+        if not key.term:
+            continue
+        name = ident_to_name.get(key.term[0])
+        if name is None:
+            continue     # stale tokenizer ident (schema changed: the
+            # structural invalidation path rebuilds from scratch anyway)
+        pl = store.lists.get(kb)
+        u = pl.uids(read_ts) if pl is not None else _EMPTY64
+        per_tok.setdefault(name, {})[key.term[1:]] = u
+    if not per_tok:
+        return
+    pd.indexes = dict(base_pd.indexes)
+    for name, patches in per_tok.items():
+        pd.indexes[name] = merge_token_index(
+            base_pd.indexes.get(name), patches)
+
+
+def overlay_nbytes(pd) -> int:
+    """Host bytes attributable to a stamped PredData's overlay state
+    (enforce_memory accounting)."""
+    n = 0
+    for csr in (pd.csr, pd.rev_csr):
+        if isinstance(csr, OverlayCSR):
+            n += csr.delta.nbytes()
+    return n

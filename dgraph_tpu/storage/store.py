@@ -371,6 +371,15 @@ class Store:
         # read-through; here clean predicates reuse device arrays)
         self.pred_commit_ts: dict[str, int] = {}
         self.pred_replay_seq: dict[str, int] = {}   # below-watermark commits
+        # per-predicate delta journal: attr -> {key bytes: last commit_ts}
+        # for every key committed since _delta_floor_for(attr). This is what
+        # makes commit-to-visible O(Δ): the snapshot assembler stamps cached
+        # device views with exactly these keys (storage/delta.py) instead of
+        # re-folding the tablet. Bounded per attr; overflow resets the
+        # completeness floor and the next full fold re-bases stamping.
+        self._delta_log: dict[str, dict[bytes, int]] = {}
+        self._delta_floor: dict[str, int] = {}
+        self._delta_base_floor = 0   # commits at/below this live in bases
         # cold-open fold accelerator: per-(kind, attr) CONTIGUOUS packed
         # columns captured at snapshot load (the DGTS2 layout is already
         # tablet-ordered). While an entry survives — dropped on the first
@@ -622,6 +631,9 @@ class Store:
                 self._bump_pred_ts(kb, commit_ts)
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, commit_ts)
 
+    MAX_DELTA_KEYS = 8192     # per-attr journal bound (bulk loads overflow
+    # it on purpose: their next fold re-bases incremental stamping)
+
     def _bump_pred_ts(self, kb: bytes, commit_ts: int) -> None:
         self._lock.assert_held()   # caller owns the commit critical section
         attr = K.kind_attr_of(kb)[1]
@@ -633,6 +645,50 @@ class Store:
             # out-of-order apply): max-only watermarks can't see it, so
             # cached snapshots key staleness on this counter too
             self.pred_replay_seq[attr] = self.pred_replay_seq.get(attr, 0) + 1
+        log = self._delta_log.get(attr)
+        if log is None:
+            log = self._delta_log[attr] = {}
+        if commit_ts > log.get(kb, 0):
+            log[kb] = commit_ts
+        if len(log) > self.MAX_DELTA_KEYS:
+            log.clear()
+            self._delta_floor[attr] = max(
+                self.pred_commit_ts.get(attr, 0),
+                self._delta_floor.get(attr, 0))
+
+    # -- delta journal (overlay stamping feed, storage/delta.py) ------------
+
+    def _delta_floor_for(self, attr: str) -> int:
+        return max(self._delta_base_floor, self._delta_floor.get(attr, 0))
+
+    def delta_since(self, attr: str, base_ts: int) -> dict[bytes, int] | None:
+        """Keys of attr committed after base_ts ({kb: commit_ts}), or None
+        when the journal can't prove completeness above base_ts (overflow,
+        bulk install, pre-journal snapshot) — the caller must full-fold."""
+        with self._lock:
+            if self._delta_floor_for(attr) > base_ts:
+                return None
+            log = self._delta_log.get(attr)
+            if not log:
+                return {}
+            return {kb: ts for kb, ts in log.items() if ts > base_ts}
+
+    def prune_delta(self, attr: str, upto_ts: int) -> None:
+        """A full fold at upto_ts subsumes journal entries at/below it."""
+        with self._lock:
+            if upto_ts < self._delta_floor_for(attr):
+                return
+            log = self._delta_log.get(attr)
+            if log:
+                for kb in [kb for kb, ts in log.items() if ts <= upto_ts]:
+                    del log[kb]
+            self._delta_floor[attr] = max(
+                self._delta_floor.get(attr, 0), upto_ts)
+
+    def delta_log_stats(self) -> dict:
+        with self._lock:
+            keys = sum(len(v) for v in self._delta_log.values())
+            return {"attrs": len(self._delta_log), "keys": keys}
 
     def abort(self, start_ts: int, key_bytes: list[bytes]) -> None:
         self._wal_write({"t": "a", "s": start_ts, "k": list(key_bytes)})
@@ -658,9 +714,17 @@ class Store:
         self._wal_write({"t": "dk", "attr": attr, "kind": int(kind)}, sync=True)
         self._drop_kind_mem(attr, kind)
 
+    def _reset_delta(self, attr: str) -> None:
+        """Drops are structural: the journal can't express them — reset
+        completeness so stamping waits for the next full fold."""
+        self._delta_log.pop(attr, None)
+        self._delta_floor[attr] = max(self._delta_floor.get(attr, 0),
+                                      self.max_seen_commit_ts)
+
     def _drop_kind_mem(self, attr: str, kind: K.KeyKind) -> None:
         with self._lock:
             self._drop_packed(int(kind), attr)
+            self._reset_delta(attr)
             self._segments.pop((int(kind), attr), None)
             for kb in self.by_pred.pop((int(kind), attr), set()):
                 self.lists.pop(kb, None)
@@ -669,6 +733,7 @@ class Store:
 
     def _delete_predicate_mem(self, attr: str) -> None:
         with self._lock:
+            self._reset_delta(attr)
             for kind in list(K.KeyKind):
                 self._drop_packed(int(kind), attr)
                 self._segments.pop((int(kind), attr), None)
@@ -735,6 +800,10 @@ class Store:
                 self.by_pred.setdefault((int(key.kind), key.attr), set()).add(kb)
                 if commit_ts > self.pred_commit_ts.get(key.attr, 0):
                     self.pred_commit_ts[key.attr] = commit_ts
+                # installs bypass the delta journal: stamping resumes after
+                # the next full fold re-bases these tablets
+                self._delta_floor[key.attr] = max(
+                    self._delta_floor.get(key.attr, 0), commit_ts)
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, commit_ts)
 
     # -- WAL ----------------------------------------------------------------
@@ -986,6 +1055,9 @@ class Store:
                     self._load_v2(raw)
                 else:
                     self._load_v1(raw)
+        # commits at/below the snapshot ts live in the loaded bases, not the
+        # journal; the WAL tail replay below records everything above it
+        self._delta_base_floor = self.snapshot_ts
         self._replay_wal(os.path.join(self.dir, "wal.log"))
 
     def _load_v2(self, raw: bytes) -> None:

@@ -97,6 +97,40 @@ class Meter:
         return round(n / w, 3)
 
 
+class KeyedGauge:
+    """Per-key integer gauges under one metric name (Prometheus labeled
+    gauge shape) — per-predicate overlay depth, per-tablet sizes. Zero
+    values drop their key so an idle predicate doesn't grow the map."""
+
+    __slots__ = ("_vals", "_lock")
+
+    def __init__(self) -> None:
+        self._vals: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, v: int) -> None:
+        with self._lock:
+            if v:
+                self._vals[key] = v
+            else:
+                self._vals.pop(key, None)
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            v = self._vals.get(key, 0) + n
+            if v:
+                self._vals[key] = v
+            else:
+                self._vals.pop(key, None)
+
+    def get(self, key: str) -> int:
+        return self._vals.get(key, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._vals)
+
+
 class Registry:
     """Named metrics with the reference's dgraph_* vocabulary pre-registered
     (x/metrics.go:27-76), plus the round-6 serving-layer counters (plan /
@@ -107,6 +141,7 @@ class Registry:
         self.counters: dict[str, Counter] = {}
         self.histograms: dict[str, Histogram] = {}
         self.meters: dict[str, Meter] = {}
+        self.keyed_gauges: dict[str, KeyedGauge] = {}
         for name in ("dgraph_num_queries_total", "dgraph_num_mutations_total",
                      "dgraph_num_commits_total", "dgraph_num_aborts_total",
                      "dgraph_posting_reads_total",
@@ -126,10 +161,17 @@ class Registry:
                      "dgraph_result_cache_evicted_total",
                      "dgraph_result_cache_bytes",
                      "dgraph_dispatch_inflight",
-                     "dgraph_dispatch_waits_total"):
+                     "dgraph_dispatch_waits_total",
+                     # delta-overlay maintenance tier (storage/delta.py)
+                     "dgraph_overlay_stamps_total",
+                     "dgraph_overlay_fold_fallbacks_total",
+                     "dgraph_compactions_total",
+                     "dgraph_cache_invalidations_avoided_total",
+                     "dgraph_parallel_folds_total",
+                     "dgraph_fold_pool_width"):
             self.counters[name] = Counter()
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
-                     "dgraph_commit_latency_s"):
+                     "dgraph_commit_latency_s", "dgraph_compaction_s"):
             self.histograms[name] = Histogram()
 
     def counter(self, name: str) -> Counter:
@@ -144,11 +186,17 @@ class Registry:
         with self._lock:
             return self.meters.setdefault(name, Meter())
 
+    def keyed(self, name: str) -> KeyedGauge:
+        with self._lock:
+            return self.keyed_gauges.setdefault(name, KeyedGauge())
+
     def to_dict(self) -> dict:
         """expvar-style dump for /debug/vars."""
         out: dict = {c: m.value for c, m in sorted(self.counters.items())}
         out.update({h: m.snapshot() for h, m in sorted(self.histograms.items())})
         out.update({f"{n}_qps": m.rate() for n, m in sorted(self.meters.items())})
+        out.update({n: g.snapshot()
+                    for n, g in sorted(self.keyed_gauges.items())})
         return out
 
 

@@ -1,0 +1,347 @@
+"""Delta-overlay posting maintenance: O(Δ) commit-to-visible.
+
+Covers the storage/delta.py overlay tier end to end: stamping visibility,
+byte-identity against from-scratch folds, device base-array identity,
+background compaction, per-predicate cache invalidation, the journal
+fallbacks, and the SnapshotAssembler replay-race staleness branch
+(pred_replay_seq) that previously had no direct test.
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.storage.csr_build import SnapshotAssembler, build_pred
+from dgraph_tpu.storage.delta import OverlayCSR
+
+
+SCHEMA = ("name: string @index(exact, term) .\n"
+          "age: int @index(int) .\n"
+          "follows: [uid] @reverse .\n")
+
+
+def small_node(n=200, follows=3) -> Node:
+    node = Node()
+    node.alter(schema_text=SCHEMA)
+    quads = []
+    for i in range(1, n + 1):
+        quads.append(f'<0x{i:x}> <name> "p{i}" .')
+        quads.append(f'<0x{i:x}> <age> "{18 + i % 40}"^^<xs:int> .')
+        for j in range(follows):
+            quads.append(f'<0x{i:x}> <follows> <0x{(i + j) % n + 1:x}> .')
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+    return node
+
+
+def assert_pred_equal(a, b):
+    """Byte-identity between two PredData views of the same data."""
+    def csr_arrays(csr):
+        if csr is None:
+            return (np.zeros(0, np.int64),) * 3
+        s, ip, ix = csr.host_arrays()
+        return (np.asarray(s, np.int64), np.asarray(ip, np.int64),
+                np.asarray(ix, np.int64))
+
+    for ca, cb in ((a.csr, b.csr), (a.rev_csr, b.rev_csr)):
+        for x, y in zip(csr_arrays(ca), csr_arrays(cb)):
+            assert np.array_equal(x, y), (x, y)
+    for fa, fb in ((a.value_subjects_host, b.value_subjects_host),
+                   (a.num_values_host, b.num_values_host)):
+        if fa is None or fb is None:
+            assert (fa is None or not len(fa)) and (fb is None or not len(fb))
+        else:
+            assert np.array_equal(fa, fb, equal_nan=True)
+    assert a.host_values == b.host_values
+    assert a.list_values == b.list_values
+    assert a.lang_values == b.lang_values
+    assert a.facets == b.facets
+    assert sorted(a.indexes) == sorted(b.indexes)
+    for name in a.indexes:
+        ta, tb = a.indexes[name], b.indexes[name]
+        assert ta.terms == tb.terms, name
+        ia, ua = ta.host_arrays()
+        ib, ub = tb.host_arrays()
+        assert np.array_equal(np.asarray(ia), np.asarray(ib)), name
+        assert np.array_equal(np.asarray(ua), np.asarray(ub)), name
+
+
+def test_single_quad_commit_stamps_overlay_and_keeps_base_identity():
+    node = small_node()
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    base_csr = node.snapshot().preds["follows"].csr
+    base_subjects = base_csr.subjects
+
+    node.mutate(set_nquads='<0x1> <follows> <0x64> .', commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    got = {x["uid"] for x in out["q"][0]["follows"]}
+    assert "0x64" in got                      # commit is visible
+
+    csr = node.snapshot().preds["follows"].csr
+    assert isinstance(csr, OverlayCSR)        # stamped, not re-folded
+    assert csr.base.subjects is base_subjects  # device identity preserved
+    assert csr.base.indices is base_csr.indices
+    assert node.metrics.counter("dgraph_overlay_stamps_total").value >= 1
+    node.close()
+
+
+def test_overlay_reads_byte_identical_to_full_fold():
+    node = small_node()
+    node.query('{ q(func: has(name)) { name } }')   # prime the pred cache
+    node.mutate(set_nquads='\n'.join([
+        '<0x1> <follows> <0x80> .',
+        '<0x2> <name> "renamed" .',
+        '<0x3> <age> "99"^^<xs:int> .',
+    ]), commit_now=True)
+    node.mutate(del_nquads='<0x4> <follows> * .', commit_now=True)
+    node.mutate(del_nquads='<0x5> <name> * .', commit_now=True)
+    ts = node.store.max_seen_commit_ts
+    snap = node.snapshot(ts)
+    assert isinstance(snap.preds["follows"].csr, OverlayCSR)
+    for attr in ("name", "age", "follows"):
+        assert_pred_equal(snap.preds[attr], build_pred(node.store, attr, ts))
+    node.close()
+
+
+def test_value_overlay_serves_eq_has_sort_and_index():
+    node = small_node()
+    node.query('{ q(func: has(age)) { age } }')
+    node.mutate(set_nquads='<0x1> <age> "99"^^<xs:int> .\n'
+                           '<0x2> <name> "zzz" .', commit_now=True)
+    out, _ = node.query('{ q(func: eq(age, 99)) { uid age } }')
+    assert out["q"] == [{"uid": "0x1", "age": 99}]
+    out, _ = node.query('{ q(func: eq(name, "zzz")) { uid } }')
+    assert out["q"] == [{"uid": "0x2"}]
+    out, _ = node.query('{ q(func: ge(age, 99)) { uid } }')
+    assert out["q"] == [{"uid": "0x1"}]
+    out, _ = node.query(
+        '{ q(func: has(age), orderdesc: age, first: 1) { uid age } }')
+    assert out["q"] == [{"uid": "0x1", "age": 99}]
+    node.close()
+
+
+def test_reverse_count_and_has_on_overlaid_predicate():
+    node = small_node()
+    node.query('{ q(func: has(follows)) { uid } }')
+    node.mutate(set_nquads='<0x1> <follows> <0x64> .', commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x64)) { ~follows { uid } } }')
+    assert "0x1" in {x["uid"] for x in out["q"][0]["~follows"]}
+    out, _ = node.query('{ q(func: eq(count(follows), 4)) { uid } }')
+    assert [x["uid"] for x in out["q"]] == ["0x1"]
+    out, _ = node.query('{ q(func: has(follows)) { uid } }')
+    assert "0x1" in {x["uid"] for x in out["q"]}
+    node.close()
+
+
+def test_compaction_empties_overlay_and_results_unchanged():
+    node = small_node()
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    node.mutate(set_nquads='<0x1> <follows> <0x64> .', commit_now=True)
+    before, _ = node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    assert node._assembler.overlay_stats()      # an overlay is live
+
+    done = node._assembler.compact(node._lock, force=True)
+    assert done >= 1
+    assert node._assembler.overlay_stats() == {}    # overlay is empty
+    assert node.store.delta_since(
+        "follows", node.store.pred_commit_ts["follows"]) == {}
+    after, _ = node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    assert after == before                      # results unchanged
+    csr = node.snapshot().preds["follows"].csr
+    assert not isinstance(csr, OverlayCSR)      # folded base again
+    assert node.metrics.counter("dgraph_compactions_total").value >= 1
+    node.close()
+
+
+def test_deep_overlay_compacts_inline_via_fold():
+    node = small_node()
+    node._assembler.OVERLAY_MAX_KEYS = 2
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    quads = "\n".join(f'<0x{i:x}> <follows> <0x90> .' for i in range(1, 9))
+    node.mutate(set_nquads=quads, commit_now=True)   # 8 keys > ceiling
+    out, _ = node.query('{ q(func: uid(0x3)) { follows { uid } } }')
+    assert "0x90" in {x["uid"] for x in out["q"][0]["follows"]}
+    csr = node.snapshot().preds["follows"].csr
+    assert not isinstance(csr, OverlayCSR)      # folded, not stamped
+    node.close()
+
+
+def test_overlay_disabled_still_correct():
+    node = small_node()
+    node._assembler.overlay_enabled = False
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    node.mutate(set_nquads='<0x1> <follows> <0x64> .', commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    assert "0x64" in {x["uid"] for x in out["q"][0]["follows"]}
+    assert not isinstance(node.snapshot().preds["follows"].csr, OverlayCSR)
+    node.close()
+
+
+def test_journal_overflow_falls_back_to_fold():
+    node = small_node()
+    node.store.MAX_DELTA_KEYS = 4
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    quads = "\n".join(f'<0x{i:x}> <follows> <0x90> .' for i in range(1, 9))
+    node.mutate(set_nquads=quads, commit_now=True)   # overflows the journal
+    assert node.store.delta_since(
+        "follows", node.store.pred_commit_ts["follows"] - 1) is None
+    out, _ = node.query('{ q(func: uid(0x5)) { follows { uid } } }')
+    assert "0x90" in {x["uid"] for x in out["q"][0]["follows"]}
+    # the fold re-based stamping: the NEXT small commit overlays again
+    node.mutate(set_nquads='<0x1> <follows> <0x91> .', commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    assert "0x91" in {x["uid"] for x in out["q"][0]["follows"]}
+    assert isinstance(node.snapshot().preds["follows"].csr, OverlayCSR)
+    node.close()
+
+
+def test_uid_only_commit_keeps_value_table_identity():
+    node = small_node()
+    node.query('{ q(func: has(age)) { age } }')
+    pd1 = node.snapshot().preds["age"]
+    node.mutate(set_nquads='<0x1> <follows> <0x64> .', commit_now=True)
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    pd2 = node.snapshot().preds["age"]
+    assert pd2 is pd1     # untouched predicate: same object, same arrays
+    node.close()
+
+
+def test_per_predicate_invalidation_preserves_cache_heat():
+    """A commit to predicate A must not evict task/result cache entries of
+    queries that only read predicate B (the overlay tier's cache contract:
+    per-PredData tokens instead of one global snapshot token)."""
+    node = small_node()
+    qb = '{ q(func: eq(name, "p7")) { name } }'
+    node.query(qb)
+    out1, _ = node.query(qb)                 # fills + hits result cache
+    hits0 = node.metrics.counter("dgraph_result_cache_hits_total").value
+    task_hits0 = node.metrics.counter("dgraph_task_cache_hits_total").value
+    assert hits0 >= 1
+
+    node.mutate(set_nquads='<0x1> <age> "77"^^<xs:int> .', commit_now=True)
+    out2, _ = node.query(qb)                 # age commit: name heat survives
+    assert out2 == out1
+    assert node.metrics.counter(
+        "dgraph_result_cache_hits_total").value > hits0
+    assert node.metrics.counter(
+        "dgraph_cache_invalidations_avoided_total").value > 0
+
+    # and the changed predicate itself must NOT be served stale
+    out, _ = node.query('{ q(func: eq(age, 77)) { uid } }')
+    assert out["q"] == [{"uid": "0x1"}]
+    node.close()
+
+
+def test_replay_race_rebuilds_cached_view():
+    """The pred_replay_seq branch of SnapshotAssembler._stale: a commit
+    REPLAYED below the predicate's watermark after assembly (out-of-order
+    WAL/replication apply) must rebuild the cached view — the max-only
+    watermark alone cannot see it."""
+    from dgraph_tpu.query import mutation as mut
+    from dgraph_tpu.storage.postings import DirectedEdge
+    from dgraph_tpu.storage.store import Store, encode_record, decode_record
+    from dgraph_tpu.utils.schema import parse_schema
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    s = Store()
+    for e in parse_schema("a: int ."):
+        s.set_schema(e)
+    touched, _, _ = mut.apply_mutations(
+        s, [DirectedEdge(1, "a", value=Val(TypeID.INT, 1))], 1)
+    s.commit(1, 2, touched)
+    touched, _, _ = mut.apply_mutations(
+        s, [DirectedEdge(2, "a", value=Val(TypeID.INT, 2))], 9)
+    s.commit(9, 10, touched)
+
+    asm = SnapshotAssembler(s)
+    snap1 = asm.snapshot(10)
+    assert snap1.preds["a"].host_values == {1: Val(TypeID.INT, 1),
+                                           2: Val(TypeID.INT, 2)}
+
+    # an out-of-order WAL record pair lands BELOW the watermark (ts 4 < 10)
+    # through the replication/replay apply path — exactly what a follower
+    # sees when a lagging leader re-ships history
+    from dgraph_tpu.storage import keys as K
+    from dgraph_tpu.storage.postings import Op, Posting
+    kb3 = K.data_key("a", 3).encode()
+    for rec in ({"t": "m", "s": 3, "k": kb3,
+                 "p": Posting(0, Op.SET, Val(TypeID.INT, 33))},
+                {"t": "c", "s": 3, "ts": 4, "k": [kb3]}):
+        s.apply_record(decode_record(encode_record(rec)))
+    assert s.pred_replay_seq.get("a", 0) == 1
+    assert s.pred_commit_ts["a"] == 10          # watermark did NOT move
+
+    snap2 = asm.snapshot(10)
+    assert snap2 is not snap1                   # cached view was rebuilt
+    assert snap2.preds["a"].host_values[3] == Val(TypeID.INT, 33)
+
+
+def test_parallel_fold_matches_serial():
+    node = small_node(n=50)
+    ts = node.store.max_seen_commit_ts
+    from dgraph_tpu.storage.csr_build import build_snapshot
+
+    ser = build_snapshot(node.store, ts, fold_workers=1)
+    par = build_snapshot(node.store, ts, fold_workers=4)
+    assert sorted(ser.preds) == sorted(par.preds)
+    for attr in ser.preds:
+        assert_pred_equal(ser.preds[attr], par.preds[attr])
+    node.close()
+
+
+def test_background_rollup_loop_compacts_aged_overlay():
+    node = small_node(n=50)
+    node._assembler.OVERLAY_MAX_AGE_S = 0.05
+    node.ROLLUP_TICK_S = 0.05
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    node.mutate(set_nquads='<0x1> <follows> <0x20> .', commit_now=True)
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    assert node._assembler.overlay_stats()
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline and node._assembler.overlay_stats():
+        time.sleep(0.05)
+    assert node._assembler.overlay_stats() == {}
+    out, _ = node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    assert "0x20" in {x["uid"] for x in out["q"][0]["follows"]}
+    node.close()
+
+
+def test_overlay_on_edgeless_base_tablet():
+    """An overlay stamped onto a predicate whose folded base has NO edges
+    (all deleted, then compacted) has base csr None — the merge-on-read
+    plan must serve the delta-born rows instead of indexing an empty
+    indptr (regression: IndexError in OverlayCSR.frontier_plan)."""
+    node = Node()
+    node.alter(schema_text="friend: [uid] .")
+    node.mutate(set_nquads='<0x1> <friend> <0x2> .', commit_now=True)
+    node.query('{ q(func: uid(0x1)) { friend { uid } } }')
+    node.mutate(del_nquads='<0x1> <friend> <0x2> .', commit_now=True)
+    node.query('{ q(func: uid(0x1)) { friend { uid } } }')
+    node._assembler.compact(node._lock, force=True)   # base: csr=None
+    node.mutate(set_nquads='<0x1> <friend> <0x3> .', commit_now=True)
+    out, _ = node.query('{ q(func: uid(0x1)) { friend { uid } } }')
+    assert [x["uid"] for x in out["q"][0]["friend"]] == ["0x3"]
+    ts = node.store.max_seen_commit_ts
+    assert_pred_equal(node.snapshot(ts).preds["friend"],
+                      build_pred(node.store, "friend", ts))
+    node.close()
+
+
+def test_expand_masked_matches_expand_with_patch():
+    """ops/csr.expand_masked: the base half of the overlay merge leaves
+    patched slots empty for the host splice."""
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops import csr as csrops
+    from dgraph_tpu.ops.uidset import SENTINEL32
+
+    indptr = jnp.asarray(np.asarray([0, 2, 5, 6], np.int32))
+    indices = jnp.asarray(np.asarray([1, 2, 3, 4, 5, 9], np.int32))
+    rows = jnp.asarray(np.asarray([0, 1, 2], np.int32))
+    patched = np.asarray([False, True, False])
+    res = csrops.expand_masked(indptr, indices, rows, patched, out_cap=8)
+    counts = np.asarray(res.counts)
+    assert counts.tolist() == [2, 0, 1]
+    targets = np.asarray(res.targets)[: int(res.total)]
+    assert targets.tolist() == [1, 2, 9]
