@@ -30,9 +30,13 @@ loops; the reference's own inner loops are scalar Go over bp128 blocks).
     commit-to-visible latency on the 240k-edge follows tablet and
     warm-QPS retention of an unrelated-predicate replay under a 10%
     write mix, overlay on vs off.
+  * `planner` — the cost-based-planner adversarial battery (worst-order
+    filter chains, scan-vs-probe roots) planned vs parse-order, caches
+    off, outputs asserted byte-identical.
 
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"band", "query_path", "query_configs", "throughput", "freshness"}.
+"band", "query_path", "query_configs", "throughput", "freshness",
+"planner"}.
 """
 
 import json
@@ -365,6 +369,80 @@ def bench_freshness(n_people=20000, follows=12, workers=4, reps=3,
     return out
 
 
+def bench_planner(n_people=20000, follows=12, iters=5):
+    """Cost-based-planner adversarial battery (the new_subsystem round):
+    queries written in the WORST execution order, run planned vs
+    parse-order (planner off) on the same Node with every cache tier
+    disabled (the planner's win must not hide behind cache heat).
+
+      * worst_chain — an AND filter chain whose parse order runs two
+        count-index probes and two O(frontier) string compares over the
+        full has() root before the 1-row eq; the plan runs the eq first
+        and short-circuits the rest over a 1-uid frontier.
+      * scan_vs_probe — a has() tablet-scan root with a 1-row eq filter;
+        the plan swaps the probe into the root position.
+      * sibling_order / reverse_or — declaration-order traps for the
+        sibling and OR paths (plans must at minimum not regress them).
+
+    Outputs are asserted byte-identical planned vs parse-order; the
+    acceptance gate is >=5x on worst_chain and strictly-better wall time
+    on scan_vs_probe."""
+    from dgraph_tpu.models.film import film_node
+
+    node = film_node(n_people=n_people, follows=follows)
+    # p6 is a "noir" person (i % 4 == 2); the chain front-loads the
+    # expensive frontier-cost leaves exactly backwards
+    battery = [
+        ("worst_chain",
+         '{ q(func: has(age)) @filter(ge(count(follows), 1) AND '
+         'le(count(follows), 50) AND eq(genre, "noir") AND '
+         'le(name, "zzzz") AND eq(name, "p6")) { uid name age } }'),
+        ("scan_vs_probe",
+         '{ q(func: has(name)) @filter(eq(name, "p123")) '
+         '{ uid name age follows { uid } } }'),
+        ("sibling_order",
+         '{ q(func: eq(age, 30), first: 50) { follows { uid } name } }'),
+        ("reverse_or",
+         '{ q(func: has(age)) @filter((eq(genre, "noir") OR '
+         'eq(genre, "drama")) AND eq(name, "p6")) { uid name } }'),
+    ]
+    # caches off: measure execution order, not cache heat
+    node.plan_cache = node.task_cache = node.result_cache = None
+    out = {"battery": []}
+    identical = True
+    for name, qt in battery:
+        runs = {}
+        for planned in (False, True):
+            node.planner_enabled = planned
+            res, _ = node.query(qt)        # warmup (jit/fold)
+            samples = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                res, _ = node.query(qt)
+                samples.append((time.perf_counter() - t0) * 1e3)
+            runs[planned] = (_band(samples), json.dumps(res))
+        same = runs[False][1] == runs[True][1]
+        identical &= same
+        speed = round(runs[False][0]["median"] /
+                      max(runs[True][0]["median"], 1e-9), 2)
+        out["battery"].append({
+            "name": name, "parse_order_ms": runs[False][0],
+            "planned_ms": runs[True][0], "speedup": speed,
+            "identical": same})
+    node.planner_enabled = True
+    c = lambda n: node.metrics.counter(n).value
+    by = {b["name"]: b for b in out["battery"]}
+    out["identical"] = identical
+    out["worst_chain_speedup"] = by["worst_chain"]["speedup"]
+    out["scan_vs_probe_speedup"] = by["scan_vs_probe"]["speedup"]
+    out["root_swaps"] = c("dgraph_planner_root_swaps_total")
+    out["filter_reorders"] = c("dgraph_planner_filter_reorders_total")
+    out["est_error_log2"] = node.metrics.histogram(
+        "dgraph_planner_est_error_log2").snapshot()
+    node.close()
+    return out
+
+
 def bench_query_configs():
     """BASELINE configs 2-5: DQL text in -> JSON out on the film graph."""
     from dgraph_tpu.models.film import film_node
@@ -469,6 +547,10 @@ def main():
         freshness = bench_freshness()
     except Exception as e:  # overlay battery must not sink it either
         freshness = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        planner = bench_planner()
+    except Exception as e:  # planner battery must not sink it either
+        planner = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -481,6 +563,7 @@ def main():
         "query_configs": query_configs,
         "throughput": throughput,
         "freshness": freshness,
+        "planner": planner,
     }))
 
 

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a short CPU-only cost-based-planner check.
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 runs the adversarial planner battery (bench.py bench_planner) at a
+# reduced scale and asserts
+#   * planned outputs byte-identical to parse-order on every battery case,
+#   * planned wall-time strictly better on the scan-vs-probe case, and
+#   * the worst-order filter chain speeds up by a healthy margin.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== planner smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from bench import bench_planner
+
+r = bench_planner(n_people=8000, follows=8, iters=3)
+by = {b["name"]: b for b in r["battery"]}
+for b in r["battery"]:
+    print(f"  {b['name']}: parse {b['parse_order_ms']['median']}ms "
+          f"planned {b['planned_ms']['median']}ms "
+          f"({b['speedup']}x, identical={b['identical']})")
+assert r["identical"], "planned output diverged from parse-order"
+svp = by["scan_vs_probe"]
+assert svp["planned_ms"]["median"] < svp["parse_order_ms"]["median"], \
+    f"scan-vs-probe not strictly better: {svp}"
+assert r["worst_chain_speedup"] >= 3.0, \
+    f"worst-chain speedup {r['worst_chain_speedup']} below smoke floor"
+assert r["root_swaps"] > 0 and r["filter_reorders"] > 0
+print(f"OK: worst_chain {r['worst_chain_speedup']}x, "
+      f"scan_vs_probe {r['scan_vs_probe_speedup']}x, outputs identical")
+PY
+echo "== smoke passed =="

@@ -30,7 +30,9 @@ def cmd_serve(args) -> int:
                 overlay_max_keys=args.overlay_max_keys,
                 overlay_max_age_s=args.overlay_max_age_s,
                 background_rollup=not args.no_background_rollup,
-                fold_workers=args.fold_workers or None)
+                fold_workers=args.fold_workers or None,
+                planner=not args.no_planner,
+                stats_top_k=args.stats_top_k)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
@@ -308,6 +310,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="disable the background overlay compaction loop")
     sp.add_argument("--fold_workers", type=int, default=0,
                     help="parallel tablet-fold threads (0 = auto)")
+    sp.add_argument("--no_planner", action="store_true",
+                    help="disable the cost-based query planner "
+                         "(restores parse-order execution)")
+    sp.add_argument("--stats_top_k", type=int, default=8,
+                    help="top-K term-frequency sketch size per index "
+                         "tokenizer (EXPLAIN / stats readout)")
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")

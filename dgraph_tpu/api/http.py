@@ -183,6 +183,32 @@ def _serving_metrics(node: Node) -> dict:
             "parallel_folds": c("dgraph_parallel_folds_total"),
             "fold_pool_width": c("dgraph_fold_pool_width"),
         },
+        # cost-based planner tier: decision counters, plan-cache hit
+        # rates, and the estimation-error histogram (|log2(actual/est)|
+        # per executed planned step — 0 is a perfect estimate)
+        "planner": {
+            "enabled": node.planner_enabled,
+            "plans_built": c("dgraph_planner_plans_total"),
+            "root_swaps": c("dgraph_planner_root_swaps_total"),
+            "filter_reorders": c("dgraph_planner_filter_reorders_total"),
+            "sibling_reorders": c("dgraph_planner_child_reorders_total"),
+            "host_expands": c("dgraph_planner_host_expands_total"),
+            "device_expands": c("dgraph_planner_device_expands_total"),
+            "fallbacks": c("dgraph_planner_fallbacks_total"),
+            "plan_cache": {
+                "hits": c("dgraph_planner_cache_hits_total"),
+                "misses": c("dgraph_planner_cache_misses_total"),
+                "hit_rate": _hit_rate(
+                    c("dgraph_planner_cache_hits_total"),
+                    c("dgraph_planner_cache_misses_total")),
+            },
+            "est_error_log2": m.histogram(
+                "dgraph_planner_est_error_log2").snapshot(),
+            "stats": {
+                "builds": c("dgraph_stats_builds_total"),
+                "delta_updates": c("dgraph_stats_delta_updates_total"),
+            },
+        },
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),
                  "latency": m.histogram(
@@ -354,14 +380,19 @@ class _Handler(BaseHTTPRequestHandler):
         start_ts = qs.get("startTs")
         ro = qs.get("ro", qs.get("readOnly", "")).lower() == "true"
         edge_limit = qs.get("edgeLimit")   # per-request edge budget override
+        explain = qs.get("explain", "").lower() == "true"
         t0 = time.perf_counter_ns()
         out, ctx = self.node.query(
             q, variables, int(start_ts) if start_ts else None, read_only=ro,
-            edge_limit=int(edge_limit) if edge_limit else None)
-        self._send(200, _envelope_ok(
-            out, {"txn": {"start_ts": ctx.start_ts},
-                  "server_latency":
-                      {"total_ns": time.perf_counter_ns() - t0}}))
+            edge_limit=int(edge_limit) if edge_limit else None,
+            explain=explain)
+        ext = {"txn": {"start_ts": ctx.start_ts},
+               "server_latency": {"total_ns": time.perf_counter_ns() - t0}}
+        if explain:
+            # the plan tree (est vs actual per step) rides the envelope's
+            # extensions, keeping "data" byte-identical to a plain query
+            ext["explain"] = out.pop("explain", None)
+        self._send(200, _envelope_ok(out, ext))
 
     def _mutate(self):
         body = self._read_body()

@@ -81,7 +81,9 @@ class Node:
                  overlay_max_keys: int | None = None,
                  overlay_max_age_s: float | None = None,
                  background_rollup: bool = True,
-                 fold_workers: int | None = None) -> None:
+                 fold_workers: int | None = None,
+                 planner: bool = True,
+                 stats_top_k: int = 8) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -103,6 +105,11 @@ class Node:
                              if result_cache_mb > 0 else None)
         self.dispatch_gate = qcache.DispatchGate(dispatch_width,
                                                  self.metrics)
+        # cost-based planner (query/planner.py) over the live cardinality
+        # stats (storage/stats.py). Order decisions only — disabling it
+        # (--no_planner) restores exact parse-order execution.
+        self.planner_enabled = planner
+        self.stats_top_k = int(stats_top_k)
         self._txns: dict[int, TxnContext] = {}
         self._lock = threading.RLock()       # commit/read linearization
         self._inflight_cv = threading.Condition(self._lock)
@@ -375,7 +382,8 @@ class Node:
     def query(self, q: str, variables: dict | None = None,
               start_ts: int | None = None,
               read_only: bool = False,
-              edge_limit: int | None = None) -> tuple[dict, TxnContext]:
+              edge_limit: int | None = None,
+              explain: bool = False) -> tuple[dict, TxnContext]:
         """Parse + execute a DQL request (edgraph/server.go:373).
 
         read_only treats start_ts purely as a snapshot timestamp: it never
@@ -384,7 +392,12 @@ class Node:
         oracle counter, so numeric collision is possible).
 
         edge_limit overrides the process-default traversed-edge budget for
-        THIS request only (the --query_edge_limit flag, now per-request)."""
+        THIS request only (the --query_edge_limit flag, now per-request).
+
+        explain=True adds an "explain" key to the returned dict: the
+        physical plan tree with estimated vs actual cardinality per step
+        (the ?explain=true HTTP surface). Explain requests bypass the
+        whole-query result cache so the actuals are real."""
         tr = self.traces.start(
             "query", q.strip().splitlines()[0][:120] if q.strip() else "")
         m = self.metrics
@@ -418,7 +431,8 @@ class Node:
             # key on the snapshot object and rotate on every commit /
             # alter / drop / txn-overlay version bump as before
             rkey = None
-            if self.result_cache is not None and not req.mutations:
+            if self.result_cache is not None and not req.mutations \
+                    and not explain:
                 pk = qcache.plan_key(q, variables)
                 if pk is not None:
                     # the EFFECTIVE budget is part of the key: a shrunk
@@ -434,12 +448,43 @@ class Node:
                     if cached is not None:
                         tr.printf("result cache hit")
                         return cached, TxnContext(start_ts=read_ts)
+            # cost-based plan (order decisions only): cached alongside the
+            # AST, keyed on the per-predicate stats tokens of the plan's
+            # read set — a commit to P rebuilds only plans that read P
+            plan = None
+            recorder = {} if explain else None
+            if self.planner_enabled and not req.mutations:
+                from dgraph_tpu.query import planner as plmod
+
+                def build():
+                    return plmod.build_plan(req, snap, self.store.schema,
+                                            metrics=self.metrics,
+                                            top_k=self.stats_top_k,
+                                            trace=tr)
+                try:
+                    plan = (self.plan_cache.plan(q, variables, req, snap,
+                                                 build)
+                            if self.plan_cache is not None else build())
+                except Exception:
+                    # stats/planner trouble must never fail a query —
+                    # parse-order execution is always available
+                    self.metrics.counter(
+                        "dgraph_planner_fallbacks_total").inc()
+                    plan = None
             out = Executor(snap, self.store.schema,
                            cache=self.task_cache, gate=self.dispatch_gate,
-                           edge_limit=edge_limit).execute(req)
+                           edge_limit=edge_limit, plan=plan,
+                           explain=recorder).execute(req)
             tr.printf("executed")
             if rkey is not None:
                 self.result_cache.put(rkey, out)
+            if explain:
+                from dgraph_tpu.query import planner as plmod
+
+                out = dict(out)
+                out["explain"] = (plmod.render_explain(plan, recorder)
+                                  if plan is not None
+                                  else {"planner": "off"})
             return out, TxnContext(start_ts=read_ts)
         except Exception as e:
             self.traces.finish(tr, error=str(e))

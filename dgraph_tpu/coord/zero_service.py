@@ -197,6 +197,7 @@ class ZeroReplica:
         self._bootstrap = bootstrap_leader
         self._peer_cache: dict[str, ZeroClient] = {}
         self._ping_fail_rounds = 0
+        self._ship_pool = None       # parallel ship fan-out executor
         svc.replica = self
 
     # -- durable meta --------------------------------------------------------
@@ -239,6 +240,8 @@ class ZeroReplica:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._ship_pool is not None:
+            self._ship_pool.shutdown(wait=False)
         for c in self._peer_cache.values():
             try:
                 c.close()
@@ -288,33 +291,57 @@ class ZeroReplica:
     def _ship(self, state_json: str) -> None:
         """Called from Zero._persist (under its _plock): replicate to a
         quorum of zeros. Quorum counts self; on failure step down — a
-        minority leader must not keep minting leases."""
+        minority leader must not keep minting leases.
+
+        The RPC fan-out runs in PARALLEL with the replica lock released:
+        ships are full-state idempotent replaces ordered by seq (standbys
+        reject anything below their seq), so ordering needs no lock — and
+        one partitioned standby must cost one RPC timeout, not stall
+        every lease persist behind a sequential walk while holding the
+        lock the ping/vote handlers need."""
         with self._lock:
             if not self.is_leader:
                 return
             self.seq += 1
             seq = self.seq
+            term = self.term
             self._save_meta()
             with self.svc._lock:
                 members_json = json.dumps(
                     {str(g): a for g, a in self.svc._members.items()})
-            acks = 1
-            for c in self._peer_clients():
-                try:
-                    r = c.zero_ship(self.term, seq, state_json,
-                                    members_json)
-                    if r.ok:
-                        acks += 1
-                    elif r.term > self.term:
-                        self.is_leader = False
-                        break
-                except Exception:
-                    pass
-            quorum = len(self.members) // 2 + 1
-            if acks < quorum:
+            peers = self._peer_clients()
+            members_n = len(self.members)
+            if self._ship_pool is None and peers:
+                self._ship_pool = futures.ThreadPoolExecutor(
+                    max_workers=max(len(self.members), 2),
+                    thread_name_prefix="dgt-zship")
+            pool = self._ship_pool
+
+        def one(c) -> int:
+            try:
+                r = c.zero_ship(term, seq, state_json, members_json)
+                if r.ok:
+                    return 1
+                return -1 if r.term > term else 0
+            except Exception:
+                return 0
+
+        try:
+            results = list(pool.map(one, peers)) if peers else []
+        except RuntimeError:
+            # stop() shut the pool down mid-persist: count every peer as
+            # un-acked — the quorum check below raises the same clean
+            # quorum-lost error the sequential path produced
+            results = [0] * len(peers)
+        acks = 1 + sum(1 for r in results if r == 1)
+        deposed = any(r == -1 for r in results)
+        quorum = members_n // 2 + 1
+        if deposed or acks < quorum:
+            with self._lock:
                 self.is_leader = False
+            if acks < quorum:
                 raise RuntimeError(
-                    f"zero quorum lost ({acks}/{len(self.members)})")
+                    f"zero quorum lost ({acks}/{members_n})")
 
     def _ping_round(self) -> None:
         """One leader ping fan-out with quorum tracking: a partitioned
@@ -379,11 +406,18 @@ class ZeroReplica:
             if msg.term < self.term:
                 return ipb.ZeroShipResponse(ok=False, term=self.term,
                                             seq=self.seq)
-            if msg.term > self.term or self.is_leader:
+            newer_term = msg.term > self.term
+            if newer_term or self.is_leader:
                 self.term = int(msg.term)
                 self.is_leader = False
-            if int(msg.seq) < self.seq:
+            if not newer_term and int(msg.seq) < self.seq:
                 # stale re-ship (e.g. a deposed leader's in-flight persist)
+                # — but ONLY within the same term. A strictly newer term's
+                # ship is a full-state replace and its seq is adopted: a
+                # standby that alone received a quorum-failed ship would
+                # otherwise reject every subsequent ship via this check
+                # and later resurrect the unacked state by winning an
+                # election on its inflated seq.
                 return ipb.ZeroShipResponse(ok=False, term=self.term,
                                             seq=self.seq)
             self._leader_contact = time.monotonic()

@@ -1179,8 +1179,18 @@ class HedgedReplicas:
                      min_applied: int = 0) -> TaskResult:
         order = self._order()
         if len(order) == 1:
-            return self.workers[order[0]].process_task(q, read_ts,
-                                                       min_applied)
+            rw = self.workers[order[0]]
+            try:
+                return rw.process_task(q, read_ts, min_applied)
+            except Exception as e:
+                if min_applied > 0 and self._is_behind(e):
+                    # the sole replica is behind the commit floor after
+                    # its applied-wait: with nobody else to serve the
+                    # tablet, this is the lost-Decide shape the
+                    # multi-replica path already falls back on — retry
+                    # once without the floor and serve its best state
+                    return rw.process_task(q, read_ts, 0)
+                raise
         if min_applied <= 0:
             # no commit floor known for this tablet (cold cluster / Zero
             # restart): only the leader is guaranteed current, so don't

@@ -88,12 +88,20 @@ class Executor:
 
     def __init__(self, snap: GraphSnapshot, schema: SchemaState,
                  dispatch=None, cache=None, gate=None,
-                 edge_limit: int | None = None):
+                 edge_limit: int | None = None,
+                 plan=None, explain: dict | None = None):
         self.snap = snap
         self.schema = schema
         self.vars: dict[str, VarValue] = {}
         self.traversed_edges = 0
         self.sort_index_buckets = -1  # sortWithIndex instrumentation
+        # physical plan (query/planner.py): order decisions only — root
+        # source selection, AND-filter order, sibling order, dispatch
+        # cutover. None = parse-order execution (--no_planner / direct
+        # Executor users). `explain` is a per-query {step id: actual
+        # cardinality} recorder feeding the EXPLAIN surface.
+        self.plan = plan
+        self.explain = explain
         # per-request edge budget override; None = module default (read
         # dynamically so set_query_edge_limit still applies)
         self.edge_limit = edge_limit
@@ -197,12 +205,24 @@ class Executor:
             elif vv is not None and not vv.is_uid:
                 uids.append(np.asarray(sorted(vv.vals.keys()), dtype=np.int64))
         if gq.func is not None:
-            uids.append(self._run_root_func(gq.func))
+            fn = gq.func
+            if self.plan is not None:
+                sw = self.plan.root_swap.get(id(gq))
+                if sw is not None:
+                    # planner root-source swap: the selective index probe
+                    # runs as the root; the demoted root function re-enters
+                    # at the probe's old filter position (_eval_filter)
+                    fn = sw.new_func
+            uids.append(self._run_root_func(fn))
         if not uids:
+            if self.plan is not None:
+                self.plan.record(gq, 0, self.explain)
             return np.zeros(0, np.int64)
         out = uids[0]
         for u in uids[1:]:
             out = us.union_host(out, u)
+        if self.plan is not None:
+            self.plan.record(gq, len(out), self.explain)
         return out
 
     def _run_root_func(self, fn: dql.Function) -> np.ndarray:
@@ -236,7 +256,10 @@ class Executor:
         (the reference's applyPagination also works per matrix row)."""
         gq = sg.gq
         if is_root:
-            sg.dest_uids = self._apply_filter(gq.filter, sg.dest_uids)
+            swap = self.plan.root_swap.get(id(gq)) \
+                if self.plan is not None else None
+            sg.dest_uids = self._apply_filter(gq.filter, sg.dest_uids,
+                                              swap=swap)
         if gq.groupby is not None:
             from dgraph_tpu.query.groupby import process_groupby
 
@@ -271,12 +294,26 @@ class Executor:
 
     def _process_children(self, sg: SubGraph) -> None:
         """Expand each child over this level's DestUIDs — one device step per
-        child (reference :2081 launches goroutines; here children batch)."""
+        child (reference :2081 launches goroutines; here children batch).
+
+        With a plan, independent siblings expand cheapest-estimate-first
+        (the planner guarantees no sibling defines or reads a var); result
+        slots are restored to declaration order so output encoding — which
+        walks sg.children — is byte-identical either way."""
         gq = sg.gq
         frontier = np.sort(sg.dest_uids)
-        for cgq in self._effective_children(gq, frontier):
+        eff = self._effective_children(gq, frontier)
+        order = None
+        if self.plan is not None:
+            order = self.plan.child_order.get(id(gq))
+            if order is not None and len(order) != len(eff):
+                order = None    # expand() reshaped the list: declaration order
+        slots: list[SubGraph | None] = [None] * len(eff)
+        seq = [(i, eff[i]) for i in order] if order is not None \
+            else list(enumerate(eff))
+        for slot, cgq in seq:
             child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-            sg.children.append(child)
+            slots[slot] = child
             if cgq.is_uid_node or cgq.attr in ("val", "math") or \
                cgq.attr.startswith("__agg_"):
                 self._compute_virtual_child(sg, child, frontier)
@@ -286,7 +323,13 @@ class Executor:
                            if cgq.facets is not None else [])
             if cgq.facets is not None:
                 tq.facet_keys = tq.facet_keys or ["__all__"]
+            if self.plan is not None:
+                # estimated-frontier-size-driven host/device dispatch
+                # cutover (0 = the static task.HOST_EXPAND_MAX default)
+                tq.cutover = self.plan.cutover.get(id(cgq), 0)
             res = self._dispatch(tq)
+            if self.plan is not None:
+                self.plan.record(cgq, res.traversed_edges, self.explain)
             self.traversed_edges += res.traversed_edges
             if self.traversed_edges > self.edge_budget():
                 raise QueryError("query exceeded edge budget (ErrTooBig)")
@@ -315,6 +358,7 @@ class Executor:
             self._record_child_vars(cgq, child, frontier)
             if cgq.children or cgq.cascade:
                 self._finish_level(child, is_root=False)
+        sg.children.extend(c for c in slots if c is not None)
 
     def _apply_child_row_mods(self, child: SubGraph) -> None:
         """Filter dest uids, then prune + paginate each uidMatrix row
@@ -405,15 +449,42 @@ class Executor:
     # ---------------------------------------------------------------- filters
 
     def _apply_filter(self, ft: dql.FilterTree | None,
-                      frontier: np.ndarray) -> np.ndarray:
+                      frontier: np.ndarray, swap=None) -> np.ndarray:
         if ft is None or len(frontier) == 0:
             return frontier
-        return self._eval_filter(ft, frontier)
+        return self._eval_filter(ft, frontier, swap)
 
-    def _eval_filter(self, ft: dql.FilterTree, frontier: np.ndarray) -> np.ndarray:
+    def _eval_filter(self, ft: dql.FilterTree, frontier: np.ndarray,
+                     swap=None) -> np.ndarray:
         if ft.func is not None:
-            return self._eval_filter_func(ft.func, frontier)
-        parts = [self._eval_filter(c, frontier) for c in ft.children]
+            fn = ft.func
+            if swap is not None and id(ft) == swap.leaf_id:
+                # this leaf's probe was promoted to the root; the demoted
+                # root function evaluates here instead (root ∩ filters is
+                # symmetric — every filter function is pointwise)
+                fn = swap.orig_func
+            out = self._eval_filter_func(fn, frontier)
+            if self.plan is not None:
+                self.plan.record(ft, len(out), self.explain,
+                                 bound=len(frontier))
+            return out
+        if ft.op == "and":
+            order = self.plan.and_order.get(id(ft)) \
+                if self.plan is not None else None
+            if order is not None:
+                # planned: most-selective-first with short-circuit
+                # frontier intersection. Every filter function evaluates
+                # pointwise (result ⊆ frontier, membership of u depends
+                # only on u), so evaluating child k over the frontier
+                # already narrowed by children 0..k-1 yields exactly the
+                # parse-order intersection — at a fraction of the work.
+                out = frontier
+                for i in order:
+                    if len(out) == 0:
+                        break
+                    out = self._eval_filter(ft.children[i], out, swap)
+                return out
+        parts = [self._eval_filter(c, frontier, swap) for c in ft.children]
         if ft.op == "and":
             out = parts[0]
             for p in parts[1:]:

@@ -420,7 +420,13 @@ class ResultCache(_ByteLRU):
 
 class PlanCache:
     """Entry-count LRU over parsed DQL requests. Parsed trees are
-    read-only during execution, so one AST serves every replay."""
+    read-only during execution, so one AST serves every replay.
+
+    A second tier caches the OPTIMIZED physical plan alongside the AST
+    (query/planner.py): keyed on (plan key, the per-predicate token tuple
+    of the request's read set), so a commit to predicate P — which may
+    change P's cardinality stats — invalidates only plans that read P,
+    exactly the task/result-tier invalidation rule."""
 
     def __init__(self, size: int = 256, metrics=None) -> None:
         from dgraph_tpu.utils.metrics import Registry
@@ -431,6 +437,11 @@ class PlanCache:
         self._entries: OrderedDict[tuple, object] = OrderedDict()
         self._hits = self.metrics.counter("dgraph_plan_cache_hits_total")
         self._misses = self.metrics.counter("dgraph_plan_cache_misses_total")
+        self._plans: OrderedDict[tuple, object] = OrderedDict()
+        self._plan_hits = self.metrics.counter(
+            "dgraph_planner_cache_hits_total")
+        self._plan_misses = self.metrics.counter(
+            "dgraph_planner_cache_misses_total")
 
     def parse(self, q: str, variables: dict | None = None):
         from dgraph_tpu.query import dql
@@ -452,9 +463,34 @@ class PlanCache:
                 self._entries.popitem(last=False)
         return req
 
+    def plan(self, q: str, variables: dict | None, req, snap, build):
+        """Optimized-plan tier: serve the cached physical plan for this
+        (query shape, stats version), else build one. Plans key on AST
+        node object ids, so a hit must also match the cached AST object
+        (`plan.req is req`) — an AST-tier eviction re-parse mints new
+        node ids and the stale plan is rebuilt."""
+        key = plan_key(q, variables)
+        if key is None or self.size <= 0:
+            return build()
+        pk = (key, result_token(req, snap))
+        with self._lock:
+            p = self._plans.get(pk)
+            if p is not None and p.req is req:
+                self._plans.move_to_end(pk)
+                self._plan_hits.inc()
+                return p
+        p = build()
+        with self._lock:
+            self._plan_misses.inc()
+            self._plans[pk] = p
+            while len(self._plans) > self.size:
+                self._plans.popitem(last=False)
+        return p
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._plans.clear()
 
     def __len__(self) -> int:
         return len(self._entries)

@@ -60,6 +60,12 @@ class TaskQuery:
     lang: str = ""
     facet_keys: list[str] = field(default_factory=list)
     first: int = 0                          # per-uid result truncation
+    # planner override of the host/device expand cutover (query/planner.py
+    # estimated-frontier-size decision); 0 = the static HOST_EXPAND_MAX.
+    # Purely an execution-strategy knob — results are identical either
+    # way, so qcache.task_key deliberately excludes it (cache heat is
+    # shared across planner on/off).
+    cutover: int = 0
 
 
 @dataclass
@@ -98,7 +104,8 @@ def _gather_rows_host(indptr_h: np.ndarray, indices_h: np.ndarray,
     return indices_h[pos].astype(np.int64)
 
 
-def _expand_overlay(ov, uids: np.ndarray) -> tuple[list[np.ndarray], int]:
+def _expand_overlay(ov, uids: np.ndarray,
+                    cutover: int = 0) -> tuple[list[np.ndarray], int]:
     """Merge-on-read expand over an OverlayCSR (storage/delta.py): gather
     untouched rows from the UNCHANGED base (host mirror below the dispatch
     cutover, ops/csr.expand_masked above it) and splice the overlay's
@@ -113,7 +120,7 @@ def _expand_overlay(ov, uids: np.ndarray) -> tuple[list[np.ndarray], int]:
     base = ov.base
     if base is None or need_base == 0:
         base_targets = np.zeros(0, np.int64)
-    elif need_base <= HOST_EXPAND_MAX:
+    elif need_base <= (cutover or HOST_EXPAND_MAX):
         _, indptr_h, indices_h = base.host_arrays()
         base_targets = _gather_rows_host(indptr_h, indices_h, rb, deg_b,
                                          offs)
@@ -128,14 +135,18 @@ def _expand_overlay(ov, uids: np.ndarray) -> tuple[list[np.ndarray], int]:
     return matrix, total
 
 
-def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np.ndarray], int]:
+def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
+                cutover: int = 0) -> tuple[list[np.ndarray], int]:
     """uidMatrix for a frontier over one adjacency; device gather + host split.
 
     Two-pass count-then-gather (SURVEY §7): the output capacity is the
     frontier's exact degree sum (counted on the cached host indptr mirror),
     rounded to a pow2 capacity class to bound jit recompiles — NOT the
     predicate's total edge count. A 1-uid frontier on a 16M-edge predicate
-    allocates its own degree, not the whole edge array."""
+    allocates its own degree, not the whole edge array.
+
+    cutover: planner override of the host/device switch point (0 = the
+    static HOST_EXPAND_MAX); the two paths produce identical matrices."""
     from dgraph_tpu.storage.delta import OverlayCSR
 
     if len(uids) == 0 or csr is None:
@@ -145,7 +156,7 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
         # (ProcessTaskOverNetwork remapped to ICI, parallel/dist.DistPredCSR)
         matrix, total = csr.expand_matrix(uids)
     elif isinstance(csr, OverlayCSR):
-        matrix, total = _expand_overlay(csr, uids)
+        matrix, total = _expand_overlay(csr, uids, cutover)
     else:
         rows = rows_for_uids(csr, uids)
         indptr_h = csr.host_arrays()[1]
@@ -153,7 +164,7 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0) -> tuple[list[np
         ok = rows != us.SENTINEL32
         deg = np.where(ok, indptr_h[rc + 1] - indptr_h[rc], 0)
         need = int(deg.sum())
-        if need <= HOST_EXPAND_MAX:
+        if need <= (cutover or HOST_EXPAND_MAX):
             # size-adaptive strategy (the TPU-era analog of the reference's
             # linear/gallop/binary ratio switch, algo/uidlist.go:147-155):
             # a small gather is microseconds on the cached host mirror but
@@ -314,7 +325,8 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
     attr = q.attr
     if attr.startswith("~"):
         attr = attr[1:]
-        q = TaskQuery(attr, q.frontier, q.func, True, q.lang, q.facet_keys, q.first)
+        q = TaskQuery(attr, q.frontier, q.func, True, q.lang, q.facet_keys,
+                      q.first, q.cutover)
     pd = snap.pred(attr) or PredData(attr, schema.type_of(attr))
     res = TaskResult()
 
@@ -332,7 +344,8 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
     entry_tid = pd.type_id
     if entry_tid == TypeID.UID or pd.csr is not None or q.reverse:
         csr = pd.rev_csr if q.reverse else pd.csr
-        matrix, traversed = _expand_csr(csr, frontier, q.first) if csr is not None else (
+        matrix, traversed = _expand_csr(csr, frontier, q.first, q.cutover) \
+            if csr is not None else (
             [np.zeros(0, np.int64) for _ in frontier], 0)
         res.uid_matrix = matrix
         res.counts = [len(m) for m in matrix]

@@ -454,13 +454,26 @@ class Store:
             return None
         seg, i = hit
         pl = seg.build_list(i)
+        tick = False
         if cache:
-            dict.__setitem__(self.lists, kb, pl)
-            self._lazy_bytes += pl.approx_bytes()
-            self._evict_tick += 1
-            if self._evict_tick >= 512:
-                self._evict_tick = 0
-                self._evict_clean()
+            with self._lock:
+                # re-check under the lock immediately before inserting: a
+                # writer (Store.get + add_mutation) may have installed —
+                # and dirtied — a list for this key while we built our
+                # pristine copy from the segment. Clobbering theirs would
+                # make a committed write invisible until WAL replay;
+                # return the existing object instead.
+                existing = dict.get(self.lists, kb)
+                if existing is not None:
+                    return existing
+                dict.__setitem__(self.lists, kb, pl)
+                self._lazy_bytes += pl.approx_bytes()
+                self._evict_tick += 1
+                if self._evict_tick >= 512:
+                    self._evict_tick = 0
+                    tick = True
+        if tick:
+            self._evict_clean()
         return pl
 
     def _evict_clean(self) -> None:
@@ -885,8 +898,14 @@ class Store:
         t = rec["t"]
         if t == "m":
             kb = _key_bytes(rec["k"])
-            if self._packed_tablets:
-                self._drop_packed(*K.kind_attr_of(kb))
+            # unconditional: _drop_packed also records the tablet in
+            # _touched (paged mode). Gating it on a non-empty
+            # _packed_tablets skipped that side effect after checkpoint()
+            # cleared the packed cache, so tablet_lists() kept serving
+            # pristine segment rows that omit this applied mutation
+            # (stale reads on WAL replay / follower ship-apply /
+            # predicate-move ingest).
+            self._drop_packed(*K.kind_attr_of(kb))
             pl = self.lists.get(kb)
             if pl is None:      # full parse only on first sight of the key
                 key = K.parse_key(kb)

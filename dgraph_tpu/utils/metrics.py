@@ -168,10 +168,24 @@ class Registry:
                      "dgraph_compactions_total",
                      "dgraph_cache_invalidations_avoided_total",
                      "dgraph_parallel_folds_total",
-                     "dgraph_fold_pool_width"):
+                     "dgraph_fold_pool_width",
+                     # cost-based planner (query/planner.py) + live
+                     # cardinality stats (storage/stats.py)
+                     "dgraph_planner_plans_total",
+                     "dgraph_planner_root_swaps_total",
+                     "dgraph_planner_filter_reorders_total",
+                     "dgraph_planner_child_reorders_total",
+                     "dgraph_planner_host_expands_total",
+                     "dgraph_planner_device_expands_total",
+                     "dgraph_planner_cache_hits_total",
+                     "dgraph_planner_cache_misses_total",
+                     "dgraph_planner_fallbacks_total",
+                     "dgraph_stats_builds_total",
+                     "dgraph_stats_delta_updates_total"):
             self.counters[name] = Counter()
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
-                     "dgraph_commit_latency_s", "dgraph_compaction_s"):
+                     "dgraph_commit_latency_s", "dgraph_compaction_s",
+                     "dgraph_planner_est_error_log2"):
             self.histograms[name] = Histogram()
 
     def counter(self, name: str) -> Counter:

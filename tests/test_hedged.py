@@ -209,3 +209,22 @@ def test_wedged_floor_falls_back_to_leader():
         hr.close()
         for s in servers:
             s.stop(0)
+
+
+def test_single_replica_behind_floor_retries_floorless():
+    """Satellite regression (PR 3): a SINGLE-replica group whose sole
+    replica reports FAILED_PRECONDITION after the applied-wait must get
+    the same lost-Decide fallback the multi-replica path has — one retry
+    with min_applied=0 — instead of surfacing the error to the client."""
+    svcs, servers, addrs = _mk_pair(NQ)
+    # sole replica: a one-address group
+    svcs[0].APPLIED_WAIT = 0.1
+    hr = HedgedReplicas(addrs[:1])
+    try:
+        res = hr.process_task(TaskQuery("name", func=("eq", ["p3"])), 5,
+                              min_applied=999)   # floor nobody applied
+        assert list(res.dest_uids) == [3]
+    finally:
+        hr.close()
+        for s in servers:
+            s.stop(0)

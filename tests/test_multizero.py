@@ -14,6 +14,7 @@ import pytest
 grpc = pytest.importorskip("grpc")
 
 from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.protos import internal_pb2 as ipb
 from dgraph_tpu.coord.zero_service import (ZeroClient, ZeroReplica,
                                            ZeroService, serve_zero)
 
@@ -124,3 +125,37 @@ def test_single_zero_mode_unaffected(tmp_path):
         c.close()
     finally:
         server.stop(0)
+
+
+def test_standby_adopts_newer_term_ship_with_lower_seq(tmp_path):
+    """Satellite regression (PR 3): a standby that alone received a
+    quorum-failed ship (inflated seq) must accept a strictly-newer term's
+    full-state replace and ADOPT the leader's lower seq — the old
+    `msg.seq < self.seq` check rejected every subsequent ship and let the
+    standby later resurrect the unacked state by winning an election."""
+    import os
+
+    d = str(tmp_path / "zs")
+    os.makedirs(d, exist_ok=True)
+    svc = ZeroService(Zero(n_groups=1))
+    rep = ZeroReplica(svc, d, "127.0.0.1:1", ["127.0.0.1:1"],
+                      bootstrap_leader=False)
+    # term-1 leader ships seq 5 — then dies before quorum-acking it
+    r = rep.zero_ship(ipb.ZeroShipRequest(term=1, seq=5,
+                                          state_json="{\"a\":1}"), None)
+    assert r.ok and rep.seq == 5
+    # same-term stale re-ship still rejected
+    r = rep.zero_ship(ipb.ZeroShipRequest(term=1, seq=3,
+                                          state_json="{}"), None)
+    assert not r.ok
+    # the NEW term-2 leader (elected without the unacked seq-5 state)
+    # ships its full state at seq 1: must be accepted, seq adopted
+    r = rep.zero_ship(ipb.ZeroShipRequest(term=2, seq=1,
+                                          state_json="{\"b\":2}"), None)
+    assert r.ok and rep.term == 2 and rep.seq == 1
+    with open(os.path.join(d, "zero_state.json")) as f:
+        assert f.read() == "{\"b\":2}"
+    # a vote request keyed on the adopted seq no longer out-ranks peers
+    v = rep.zero_vote(ipb.ZeroVoteRequest(term=3, seq=1,
+                                          candidate="x"), None)
+    assert v.granted

@@ -151,3 +151,51 @@ def test_paged_write_to_existing_value_key_visible(tmp_path):
     out, _ = node.query('{ q(func: eq(age, 99)) { uid } }')
     assert {x["uid"] for x in out["q"]} == {"0x5"}
     node.close()
+
+
+def test_paged_replay_after_checkpoint_not_stale(tmp_path):
+    """Satellite regression (PR 3): _apply_record_locked's 'm' branch must
+    call _drop_packed UNCONDITIONALLY. The old `if self._packed_tablets:`
+    fast path skipped the _touched side effect once checkpoint() cleared
+    the packed cache, so tablet_lists() kept serving pristine segment rows
+    that omit the applied mutation (stale reads on WAL replay / follower
+    ship-apply / predicate-move ingest)."""
+    from dgraph_tpu.storage.postings import Op, Posting
+
+    d = _build_dataset(tmp_path)
+    store = Store(d, memory_budget=64 << 20)
+    assert store.paged and store._segments
+    store.checkpoint(store.max_seen_commit_ts)   # clears _packed_tablets
+    assert not store._packed_tablets
+    ts = store.max_seen_commit_ts
+    kb = K.data_key("friend", 1).encode()
+    # follower ship-apply path: records land via apply_record
+    store.apply_record({"t": "m", "s": ts + 1, "k": kb,
+                        "p": Posting(uid=399, op=Op.SET)})
+    store.apply_record({"t": "c", "s": ts + 1, "ts": ts + 2, "k": [kb]})
+    kbs = store.keys_of(K.KeyKind.DATA, "friend")
+    pls = store.tablet_lists(int(K.KeyKind.DATA), "friend", kbs)
+    got = pls[kbs.index(kb)].uids(ts + 2)
+    assert 399 in got.tolist(), "tablet scan served a pristine segment row"
+    store.close()
+
+
+def test_materialize_returns_resident_list(tmp_path):
+    """Satellite regression (PR 3): _materialize must re-check the map
+    under the lock immediately before inserting — a racing reader's
+    pristine copy must never replace a writer's dirty list (which would
+    make a committed write invisible until WAL replay)."""
+    from dgraph_tpu.storage.postings import Op, Posting
+
+    d = _build_dataset(tmp_path)
+    store = Store(d, memory_budget=64 << 20)
+    key = K.data_key("friend", 2)
+    kb = key.encode()
+    pl = store.get(key)                  # writer materializes + holds it
+    pl.add_mutation(999, Posting(uid=777, op=Op.SET))
+    # racing reader re-materializes the same key from the segment: it
+    # must return the resident (dirty) object, not clobber it
+    got = store._materialize(kb)
+    assert got is pl
+    assert dict.get(store.lists, kb) is pl
+    store.close()

@@ -1,0 +1,312 @@
+"""Cost-based query planner (query/planner.py): estimation math, ordering
+decisions, and — the load-bearing contract — plan ≡ parse-order result
+equivalence on the golden corpus and fuzz seeds. Plans only ever change
+ORDER; any output difference planner-on vs planner-off is a bug."""
+
+import json
+import random
+
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import dql, planner
+from dgraph_tpu.storage import stats as stmod
+
+N_PEOPLE = 2000
+FOLLOWS = 6
+
+
+@pytest.fixture(scope="module")
+def node():
+    from dgraph_tpu.models.film import film_node
+
+    n = film_node(n_people=N_PEOPLE, follows=FOLLOWS)
+    yield n
+    n.close()
+
+
+def _est(node, snap, fname, attr, *args, **kw):
+    fn = dql.Function(name=fname, attr=attr, args=list(args), **kw)
+    return planner._est_func(fn, snap, node.store.schema, None, 10**9)
+
+
+# ---------------------------------------------------------------------------
+# estimation math
+# ---------------------------------------------------------------------------
+
+def test_eq_estimate_is_exact_term_frequency(node):
+    snap = node.snapshot()
+    est, src, dep = _est(node, snap, "eq", "name", "p7")
+    assert (est, src, dep) == (1, "index probe", False)
+    est, src, _ = _est(node, snap, "eq", "genre", "noir")
+    assert src == "index probe"
+    assert est == N_PEOPLE // 4          # i % 4 == 2 -> "noir"
+    # multi-value eq sums the term frequencies
+    est2, _, _ = _est(node, snap, "eq", "genre", "noir", "drama")
+    assert est2 == 2 * (N_PEOPLE // 4)
+
+
+def test_inequality_estimate_counts_index_range(node):
+    snap = node.snapshot()
+    est, src, dep = _est(node, snap, "ge", "age", 50)
+    assert src == "index probe" and not dep
+    # exact: ages are 18 + i % 60 -> [50, 77] hits 28 of every 60
+    actual, _ = node.query('{ q(func: ge(age, 50)) { count(uid) } }')
+    assert est == actual["q"][0]["count"]
+
+
+def test_has_estimate_and_frontier_dependence(node):
+    snap = node.snapshot()
+    est, src, dep = _est(node, snap, "has", "age")
+    assert est == N_PEOPLE and src == "tablet scan"
+    assert dep           # value predicate: evaluated over the frontier
+    est, src, dep = _est(node, snap, "has", "follows")
+    assert src == "tablet scan" and not dep   # uid predicate
+    assert est > 0
+
+
+def test_absent_predicate_estimates_zero(node):
+    snap = node.snapshot()
+    assert _est(node, snap, "eq", "nosuchpred", "x")[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# ordering decisions
+# ---------------------------------------------------------------------------
+
+CHAIN = ('{ q(func: has(age)) @filter(ge(count(follows), 1) AND '
+         'eq(genre, "noir") AND eq(name, "p6")) { uid name } }')
+
+
+def test_and_order_most_selective_first(node):
+    req = dql.parse(CHAIN)
+    snap = node.snapshot()
+    plan = planner.build_plan(req, snap, node.store.schema)
+    gq = req.queries[0]
+    # root swap: eq(name, "p6") (est 1) beats the has(age) tablet scan
+    sw = plan.root_swap.get(id(gq))
+    assert sw is not None and sw.new_func.attr == "name"
+    ft = gq.filter
+    order = plan.and_order[id(ft)]
+    ordered_attrs = []
+    for i in order:
+        leaf = ft.children[i]
+        fn = sw.orig_func if id(leaf) == sw.leaf_id else leaf.func
+        ordered_attrs.append((fn.attr, fn.is_count))
+    # the absolute eq(genre) index probe first; the frontier-scaled
+    # leaves after, ascending by estimate — the count probe (est
+    # has/8) before the demoted has(age) full scan. Ordering must key
+    # on what the leaf EXECUTES (the demoted root), not the promoted
+    # probe that used to sit there.
+    assert ordered_attrs == [("genre", False), ("follows", True),
+                             ("age", False)]
+
+
+def test_no_swap_when_uids_join_the_root(node):
+    # explicit uids union with the root function: swapping would change
+    # the result set, so the planner must not touch it
+    q = '{ q(func: has(age)) @filter(eq(name, "p6")) { uid } }'
+    req = dql.parse(q)
+    req.queries[0].uids = [1, 2]
+    plan = planner.build_plan(req, node.snapshot(), node.store.schema)
+    assert id(req.queries[0]) not in plan.root_swap
+
+
+def test_sibling_order_skipped_when_vars_bind(node):
+    q = ('{ q(func: eq(age, 30)) { x as age follows { uid } } }')
+    req = dql.parse(q)
+    plan = planner.build_plan(req, node.snapshot(), node.store.schema)
+    assert id(req.queries[0]) not in plan.child_order
+    assert not planner._orderable_children(req.queries[0])
+
+
+def test_cutover_override_for_moderate_expansions(node):
+    # fake stats: a predicate whose estimated expansion lands between the
+    # static 64k threshold and the device minimum gets a host-preferring
+    # cutover override
+    snap = node.snapshot()
+    pd = snap.pred("follows")
+    real = stmod.pred_stats(pd)
+    fake = stmod.PredStats(
+        attr="follows", type_name="UID",
+        fwd=stmod.CSRStats(n_subjects=real.fwd.n_subjects,
+                           n_edges=200_000),
+        rev=stmod.CSRStats())
+    pd.__dict__[stmod._STATS_ATTR] = fake
+    try:
+        req = dql.parse('{ q(func: has(age)) { follows { uid } } }')
+        plan = planner.build_plan(req, snap, node.store.schema)
+        cgq = req.queries[0].children[0]
+        cut = plan.cutover.get(id(cgq))
+        assert cut is not None and cut > (1 << 16)
+        assert cut <= planner.DEVICE_MIN_EDGES
+    finally:
+        pd.__dict__[stmod._STATS_ATTR] = real
+
+
+# ---------------------------------------------------------------------------
+# plan ≡ parse-order equivalence
+# ---------------------------------------------------------------------------
+
+def _on_off(node, q):
+    """Run q planner-off then planner-on with the task/result caches
+    disabled — a cache hit would serve the first run's output and make
+    the comparison vacuous."""
+    stash = (node.task_cache, node.result_cache)
+    node.task_cache = node.result_cache = None
+    try:
+        node.planner_enabled = False
+        off, _ = node.query(q)
+        node.planner_enabled = True
+        on, _ = node.query(q)
+    finally:
+        node.task_cache, node.result_cache = stash
+    return json.dumps(off, sort_keys=True, default=str), \
+        json.dumps(on, sort_keys=True, default=str)
+
+
+def test_golden_corpus_equivalence():
+    """Every golden-battery query yields byte-identical JSON planner-on
+    vs planner-off (the golden dataset spans every directive/function
+    family, so this is the broadest semantics gate)."""
+    from tests.test_golden import QUERIES, SCHEMA, _dataset
+
+    n = Node()
+    n.alter(schema_text=SCHEMA)
+    n.mutate(set_nquads=_dataset(), commit_now=True)
+    try:
+        for qname, q in QUERIES:
+            off, on = _on_off(n, q)
+            assert off == on, f"planner changed output of {qname!r}"
+        assert n.metrics.counter("dgraph_planner_plans_total").value > 0
+    finally:
+        n.close()
+
+
+def test_fuzz_seed_equivalence(node):
+    """Seeded random filter chains over the film graph: planned output ==
+    parse-order output for every seed."""
+    rng = random.Random(20260803)
+    leaves = ['eq(genre, "noir")', 'eq(genre, "drama")', 'eq(name, "p6")',
+              'ge(age, 40)', 'le(age, 30)', 'has(genre)', 'has(follows)',
+              'ge(count(follows), 1)', 'eq(count(follows), 2)',
+              'eq(name, "p100")', 'lt(age, 77)']
+    roots = ['has(age)', 'has(name)', 'eq(genre, "scifi")', 'ge(age, 70)',
+             'has(follows)']
+
+    def tree(depth):
+        if depth == 0 or rng.random() < 0.5:
+            return rng.choice(leaves)
+        op = rng.choice([" AND ", " OR "])
+        parts = [tree(depth - 1) for _ in range(rng.randint(2, 3))]
+        t = "(" + op.join(parts) + ")"
+        if rng.random() < 0.2:
+            t = f"(NOT {t})"
+        return t
+
+    for _ in range(40):
+        body = rng.choice(["uid", "uid name", "uid follows { uid }",
+                           "name count(follows)"])
+        q = (f'{{ q(func: {rng.choice(roots)}) @filter({tree(2)}) '
+             f'{{ {body} }} }}')
+        off, on = _on_off(node, q)
+        assert off == on, q
+
+
+def test_child_filter_reorder_equivalence(node):
+    q = ('{ q(func: eq(age, 30), first: 10) { name follows '
+         '@filter(ge(count(follows), 1) AND eq(genre, "noir") AND '
+         'eq(name, "p6")) { uid } } }')
+    off, on = _on_off(node, q)
+    assert off == on
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN surface + plan cache + flags
+# ---------------------------------------------------------------------------
+
+def test_explain_returns_est_vs_actual(node):
+    node.planner_enabled = True
+    out, _ = node.query(CHAIN, explain=True)
+    ex = out["explain"]
+    assert ex["planner"] == "on"
+    assert ex["decisions"]["root_swaps"] >= 1
+    # stats header: the read set's live stats with the top-K sketch
+    assert ex["stats"]["name"]["subjects"] == 0      # value predicate
+    assert ex["stats"]["name"]["values"] == N_PEOPLE
+    assert len(ex["stats"]["genre"]["top_terms"]["exact"]) == 4
+    blk = ex["blocks"][0]
+    assert blk["root"]["swapped"] is True
+    assert blk["root"]["est"] >= 0 and blk["root"]["actual"] is not None
+    # the promoted probe ran as root; its actual equals the root's
+    assert any(f["actual"] is not None for f in blk["filters"])
+    # plain queries must NOT carry the explain key
+    out2, _ = node.query(CHAIN)
+    assert "explain" not in out2
+
+
+def test_explain_planner_off():
+    n = Node(planner=False)
+    n.alter(schema_text="name: string @index(exact) .")
+    n.mutate(set_nquads='<0x1> <name> "a" .', commit_now=True)
+    try:
+        out, _ = n.query('{ q(func: has(name)) { uid } }', explain=True)
+        assert out["explain"] == {"planner": "off"}
+        assert n.metrics.counter("dgraph_planner_plans_total").value == 0
+    finally:
+        n.close()
+
+
+def test_plan_cache_hits_and_invalidates(node):
+    node.planner_enabled = True
+    # result cache off: a whole-query hit would return before planning
+    stash, node.result_cache = node.result_cache, None
+    q = '{ q(func: has(age)) @filter(eq(name, "p9")) { uid } }'
+    c = lambda name: node.metrics.counter(name).value
+    node.query(q)
+    h0 = c("dgraph_planner_cache_hits_total")
+    node.query(q)
+    node.result_cache = stash
+    assert c("dgraph_planner_cache_hits_total") == h0 + 1
+    # a commit to a predicate the plan reads rotates its stats token:
+    # the cached plan must be rebuilt against fresh stats
+    m0 = c("dgraph_planner_cache_misses_total")
+    node.mutate(set_nquads=f'<0x{N_PEOPLE + 50:x}> <name> "fresh" .',
+                commit_now=True)
+    node.query(q)
+    assert c("dgraph_planner_cache_misses_total") == m0 + 1
+
+
+def test_estimation_error_histogram_feeds(node):
+    node.planner_enabled = True
+    node.query(CHAIN)
+    snap = node.metrics.histogram(
+        "dgraph_planner_est_error_log2").snapshot()
+    assert snap["count"] > 0
+
+
+def test_http_explain_surface(node):
+    import urllib.request
+
+    from dgraph_tpu.api.http import serve_forever
+
+    node.planner_enabled = True
+    srv = serve_forever(node, port=0)
+    try:
+        port = srv.server_address[1]
+        body = CHAIN.encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/query?explain=true", data=body)
+        with urllib.request.urlopen(req) as r:
+            env = json.loads(r.read())
+        assert "explain" in env["extensions"]
+        assert env["extensions"]["explain"]["planner"] == "on"
+        assert "explain" not in env["data"]
+        # /debug/metrics exposes the planner section
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/metrics") as r:
+            m = json.loads(r.read())
+        assert m["planner"]["plans_built"] > 0
+        assert "est_error_log2" in m["planner"]
+    finally:
+        srv.shutdown()

@@ -1,0 +1,184 @@
+"""Cardinality-statistics subsystem (storage/stats.py): exactness at fold
+time, O(Δ) maintenance through the delta-overlay stamp, and exact
+reconciliation after compaction."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.storage import stats as stmod
+from dgraph_tpu.storage.csr_build import build_pred
+from dgraph_tpu.storage.delta import OverlayCSR
+
+N_PEOPLE = 800
+FOLLOWS = 4
+
+
+@pytest.fixture()
+def node():
+    from dgraph_tpu.models.film import film_node
+
+    n = film_node(n_people=N_PEOPLE, follows=FOLLOWS)
+    yield n
+    n.close()
+
+
+def _fresh_stats(node, attr):
+    """Stats of a from-scratch fold at the current watermark — the
+    reconciliation oracle."""
+    pd = build_pred(node.store, attr, node.store.max_seen_commit_ts)
+    return stmod.pred_stats(pd)
+
+
+def _same(a: stmod.PredStats, b: stmod.PredStats) -> None:
+    assert a.fwd.n_subjects == b.fwd.n_subjects
+    assert a.fwd.n_edges == b.fwd.n_edges
+    assert np.array_equal(a.fwd.hist, b.fwd.hist)
+    assert a.value_count == b.value_count
+    assert a.numeric_values == b.numeric_values
+    assert a.index_terms == b.index_terms
+    assert a.index_postings == b.index_postings
+
+
+def test_fold_time_stats_exact(node):
+    snap = node.snapshot()
+    st = stmod.pred_stats(snap.pred("follows"))
+    sub, ip, _ = snap.pred("follows").csr.host_arrays()
+    deg = np.asarray(ip)[1:] - np.asarray(ip)[:-1]
+    assert st.fwd.n_subjects == len(sub)
+    assert st.fwd.n_edges == int(deg.sum())
+    assert int(st.fwd.hist.sum()) == len(sub)
+    assert not st.fwd.via_delta
+    ages = stmod.pred_stats(snap.pred("age"))
+    assert ages.value_count == N_PEOPLE
+    assert ages.numeric_values == N_PEOPLE     # int values: all numeric
+    names = stmod.pred_stats(snap.pred("name"))
+    assert names.value_count == N_PEOPLE
+    assert names.index_terms["exact"] == N_PEOPLE
+    assert names.index_postings["exact"] == N_PEOPLE
+
+
+def test_overlay_commit_updates_stats_o_delta(node):
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')  # warm fold
+    snap0 = node.snapshot()
+    stmod.pred_stats(snap0.pred("follows"), node.metrics)    # cache base
+    builds0 = node.metrics.counter("dgraph_stats_builds_total").value
+    # single-quad commit -> overlay stamp, NOT a re-fold
+    node.mutate(set_nquads=f'<0x1> <follows> <0x{N_PEOPLE + 7:x}> .',
+                commit_now=True)
+    snap1 = node.snapshot()
+    pd = snap1.pred("follows")
+    assert isinstance(pd.csr, OverlayCSR)      # the stamp actually ran
+    d0 = node.metrics.counter("dgraph_stats_delta_updates_total").value
+    st = stmod.pred_stats(pd, node.metrics)
+    assert st.fwd.via_delta                    # adjusted, not recounted
+    assert node.metrics.counter(
+        "dgraph_stats_delta_updates_total").value == d0 + 1
+    # the delta path must not have re-counted any tablet
+    assert node.metrics.counter(
+        "dgraph_stats_builds_total").value == builds0
+    _same(st, _fresh_stats(node, "follows"))   # ...and must be EXACT
+
+
+def test_overlay_delete_and_readd_stats_exact(node):
+    node.query('{ q(func: uid(0x2)) { follows { uid } } }')
+    snap0 = node.snapshot()
+    stmod.pred_stats(snap0.pred("follows"))
+    # delete every follows edge of 0x2 (row leaves the CSR), touch another
+    node.mutate(del_nquads='<0x2> <follows> * .', commit_now=True)
+    node.mutate(set_nquads=f'<0x3> <follows> <0x{N_PEOPLE + 9:x}> .',
+                commit_now=True)
+    snap1 = node.snapshot()
+    pd = snap1.pred("follows")
+    assert isinstance(pd.csr, OverlayCSR)
+    _same(stmod.pred_stats(pd), _fresh_stats(node, "follows"))
+
+
+def test_compaction_reconciles_exactly(node):
+    node.query('{ q(func: uid(0x1)) { follows { uid } } }')
+    stmod.pred_stats(node.snapshot().pred("follows"))
+    for i in range(5):
+        node.mutate(
+            set_nquads=f'<0x{i + 1:x}> <follows> <0x{N_PEOPLE + 20 + i:x}> .',
+            commit_now=True)
+    overlaid = stmod.pred_stats(node.snapshot().pred("follows"))
+    assert overlaid.fwd.via_delta
+    assert node._assembler.compact(node._lock, force=True) >= 1
+    pd = node.snapshot().pred("follows")
+    assert not isinstance(pd.csr, OverlayCSR)  # folded base again
+    st = stmod.pred_stats(pd)
+    assert not st.fwd.via_delta
+    _same(st, overlaid)                        # delta math was exact
+    _same(st, _fresh_stats(node, "follows"))
+
+
+def test_index_patch_keeps_term_probes_exact(node):
+    node.query('{ q(func: eq(name, "p1")) { uid } }')
+    stmod.pred_stats(node.snapshot().pred("name"))
+    node.mutate(set_nquads=f'<0x{N_PEOPLE + 40:x}> <name> "p1" .',
+                commit_now=True)
+    pd = node.snapshot().pred("name")
+    ti = pd.indexes["exact"]
+    # planner point probe: exact row length after the index patch
+    from dgraph_tpu.utils import tok as tokmod
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    t = tokmod.get("exact").tokens(Val(TypeID.STRING, "p1"))[0][1:]
+    assert stmod.term_freq(ti, t) == 2
+    st = stmod.pred_stats(pd)
+    assert st.index_postings["exact"] == N_PEOPLE + 1
+
+
+def test_range_count_matches_walked_rows(node):
+    snap = node.snapshot()
+    ti = snap.pred("age").indexes["int"]
+    from dgraph_tpu.query.task import _ineq_rows
+    from dgraph_tpu.utils import tok as tokmod
+    from dgraph_tpu.utils.types import TypeID, Val, convert
+
+    indptr = np.asarray(ti.host_arrays()[0], dtype=np.int64)
+    for op, val in (("ge", 50), ("lt", 30), ("le", 18), ("gt", 76),
+                    ("eq", 40)):
+        tok = tokmod.get("int").tokens(
+            convert(Val(TypeID.INT, val), TypeID.INT))[0][1:]
+        rows = _ineq_rows(ti, op, tok)
+        walked = int(sum(indptr[r + 1] - indptr[r] for r in rows))
+        assert stmod.range_count(ti, op, tok) == walked, (op, val)
+
+
+def test_topk_terms_sketch(node):
+    snap = node.snapshot()
+    top = stmod.topk_terms(snap.pred("genre").indexes["exact"], 4)
+    assert len(top) == 4
+    assert sorted(t for t, _ in top) == ["comedy", "drama", "noir", "scifi"]
+    assert all(n == N_PEOPLE // 4 for _, n in top)
+    # snapshot_stats carries the sketch for the ops readout
+    allstats = stmod.snapshot_stats(snap, top_k=2)
+    assert "top_terms" in allstats["genre"]
+
+
+def test_stats_never_describe_dead_data(node):
+    """A structural change (drop) rebuilds PredData; stats cached on the
+    old object are unreachable from the new snapshot."""
+    snap0 = node.snapshot()
+    st0 = stmod.pred_stats(snap0.pred("follows"))
+    node.alter(drop_attr="follows")
+    snap1 = node.snapshot()
+    assert snap1.pred("follows") is None or \
+        stmod.pred_stats(snap1.pred("follows")) is not st0
+
+
+def test_stats_on_baseless_overlay(node):
+    """A tablet born entirely from deltas (edgeless base) stamps an
+    OverlayCSR with base=None — its stats come purely from the delta."""
+    node.query('{ q(func: uid(0x1)) { name } }')         # warm fold caches
+    node.alter(schema_text="knows: [uid] .")
+    node.query('{ q(func: uid(0x1)) { knows { uid } } }')
+    node.mutate(set_nquads='<0x1> <knows> <0x2> .\n<0x1> <knows> <0x3> .',
+                commit_now=True)
+    pd = node.snapshot().pred("knows")
+    st = stmod.pred_stats(pd)
+    if isinstance(pd.csr, OverlayCSR):       # stamped, not re-folded
+        assert pd.csr.base is None
+        assert st.fwd.via_delta
+    assert st.fwd.n_subjects == 1 and st.fwd.n_edges == 2
+    _same(st, _fresh_stats(node, "knows"))
