@@ -1,0 +1,122 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a CPU-only end-to-end distributed-tracing check.
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 stands up a 2-group cluster over loopback gRPC (zero + 2 workers +
+# ClusterClient), issues a traced 2-hop query, fetches the Chrome
+# trace-event JSON through the embedded node's /debug/traces HTTP surface,
+# and validates it with a minimal schema check (traceEvents list, complete
+# "X" events with ts/dur/pid/tid, thread_name metadata, one trace id); it
+# also parses /metrics with the obs.prom format checker.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== trace smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import random
+import threading
+import urllib.request
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.coord.zero_service import serve_zero
+from dgraph_tpu.obs import prom
+from dgraph_tpu.parallel.client import ClusterClient
+from dgraph_tpu.parallel.remote import serve_worker
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+
+SCHEMA = ("name: string @index(exact) .\n"
+          "follows: [uid] @reverse .")
+
+# -- 2-group cluster over loopback gRPC ------------------------------------
+zero = Zero(2)
+zero.move_tablet("name", 0)
+zero.move_tablet("follows", 1)
+zsrv, zport, _ = serve_zero(zero, "localhost:0")
+stores = []
+workers = []
+for _g in range(2):
+    s = Store()
+    for e in parse_schema(SCHEMA):
+        s.set_schema(e)
+    stores.append(s)
+    workers.append(serve_worker(s, "localhost:0"))
+client = ClusterClient(
+    f"localhost:{zport}",
+    {g: [f"localhost:{workers[g][1]}"] for g in range(2)},
+    span_sample=1.0, trace_rng=random.Random(9))
+client.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                         '_:a <follows> _:b .')
+out = client.query('{ q(func: eq(name, "ann")) { name follows { name } } }')
+assert out["q"][0]["follows"][0]["name"] == "bob", out
+rec = client.tracer.sink.get(client.tracer.sink.index()[0]["trace_id"])
+procs = {s["proc"] for s in rec["spans"]}
+assert sum(p.startswith("worker:") for p in procs) == 2, procs
+assert "zero" in procs and "client" in procs, procs
+assert client.tracer.active_traces() == 0
+print(f"  cluster trace: {rec['nspans']} spans across {sorted(procs)}")
+client.close()
+for w, _p in workers:
+    w.stop(0)
+zsrv.stop(0)
+
+# -- embedded node: Chrome-trace JSON over HTTP + /metrics parse -----------
+node = Node(span_sample=1.0, trace_rng=random.Random(4))
+node.alter(schema_text=SCHEMA)
+node.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                       '_:a <follows> _:b .', commit_now=True)
+srv = make_server(node, "127.0.0.1", 0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{srv.server_address[1]}"
+req = urllib.request.Request(
+    base + "/query",
+    data=b'{ q(func: eq(name, "ann")) { name follows { name } } }',
+    method="POST")
+urllib.request.urlopen(req, timeout=10).read()
+idx = json.loads(urllib.request.urlopen(base + "/debug/traces",
+                                        timeout=5).read())
+tid = next(r["trace_id"] for r in idx if r["root"] == "query")
+ct = json.loads(urllib.request.urlopen(base + f"/debug/traces/{tid}",
+                                       timeout=5).read())
+# minimal Chrome trace-event schema check (the Perfetto-loadable contract)
+assert isinstance(ct.get("traceEvents"), list) and ct["traceEvents"]
+assert ct["otherData"]["trace_id"] == tid
+spans = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+metas = [e for e in ct["traceEvents"] if e.get("ph") == "M"]
+assert spans and metas, ct["traceEvents"][:3]
+for e in spans:
+    assert {"name", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e), e
+    assert e["ts"] >= 0 and e["dur"] > 0, e
+assert any(e["name"] == "query" for e in spans)
+print(f"  chrome trace: {len(spans)} X-events, {len(metas)} meta-events")
+series = prom.parse(urllib.request.urlopen(base + "/metrics",
+                                           timeout=5).read().decode())
+assert series["dgraph_num_queries_total"][0][1] >= 1
+print(f"  /metrics: {len(series)} series parsed clean")
+srv.shutdown()
+node.close()
+print("OK: trace smoke passed")
+PY
+echo "== smoke passed =="

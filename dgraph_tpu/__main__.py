@@ -11,6 +11,8 @@ from __future__ import annotations
 import argparse
 import sys
 
+from dgraph_tpu.utils import log
+
 VERSION = "dgraph-tpu 0.2.0"
 
 
@@ -20,6 +22,7 @@ def cmd_serve(args) -> int:
     from dgraph_tpu.api.http import make_server
     from dgraph_tpu.api.server import Node
 
+    lg = log.get_logger("serve")
     node = Node(dirpath=args.postings, trace_fraction=args.trace,
                 memory_mb=args.memory_mb or None,
                 plan_cache_size=args.plan_cache,
@@ -32,7 +35,10 @@ def cmd_serve(args) -> int:
                 background_rollup=not args.no_background_rollup,
                 fold_workers=args.fold_workers or None,
                 planner=not args.no_planner,
-                stats_top_k=args.stats_top_k)
+                stats_top_k=args.stats_top_k,
+                span_sample=args.span_sample,
+                slow_query_ms=args.slow_query_ms,
+                slow_query_log=args.slow_query_log)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
@@ -44,13 +50,15 @@ def cmd_serve(args) -> int:
         grpc_srv, gport = serve_grpc(node, f"{args.host}:{args.grpc_port}",
                                      tls_cert=args.tls_cert,
                                      tls_key=args.tls_key)
-        print(f"serving gRPC on {args.host}:{gport}"
-              f"{' (TLS)' if args.tls_cert else ''}", flush=True)
+        # startup banners keep the "<role> serving ... on host:port" shape:
+        # tests and contrib/scripts parse the bound port out of text mode
+        lg.info(f"serving gRPC on {args.host}:{gport}",
+                tls=bool(args.tls_cert))
     srv = make_server(node, args.host, args.port,
                       tls_cert=args.tls_cert, tls_key=args.tls_key)
-    print(f"serving HTTP{'S' if args.tls_cert else ''} on "
-          f"{args.host}:{srv.server_address[1]} "
-          f"(postings={args.postings or '<memory>'})", flush=True)
+    lg.info(f"serving HTTP{'S' if args.tls_cert else ''} on "
+            f"{args.host}:{srv.server_address[1]}",
+            postings=args.postings or "<memory>")
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
@@ -63,23 +71,24 @@ def cmd_serve(args) -> int:
 
 
 def cmd_version(_args) -> int:
-    print(VERSION)
+    log.get_logger("version").info(VERSION)
     return 0
 
 
 def cmd_bulk(args) -> int:
     from dgraph_tpu.loader.bulk import bulk_load
 
+    lg = log.get_logger("bulk")
     schema = ""
     if args.schema:
         with open(args.schema) as f:
             schema = f.read()
     stats = bulk_load(args.files, schema, args.out, workers=args.workers,
-                      progress=lambda n: print(f"  parsed {n} quads...",
-                                               flush=True))
-    print(f"bulk: {stats.edges} postings ({stats.uid_edges} uid edges, "
-          f"{stats.values} values) over {stats.nodes} nodes / "
-          f"{stats.predicates} predicates in {stats.seconds:.1f}s -> {args.out}")
+                      progress=lambda n: lg.info("parsing", quads=n))
+    lg.info("bulk load done", postings=stats.edges,
+            uid_edges=stats.uid_edges, values=stats.values,
+            nodes=stats.nodes, predicates=stats.predicates,
+            seconds=round(stats.seconds, 1), out=args.out)
     return 0
 
 
@@ -90,8 +99,8 @@ def cmd_export(args) -> int:
     store = Store(args.postings)
     stats = export_rdf(store, args.out, schema_path=args.out_schema)
     store.close()
-    print(f"export: {stats.quads} quads / {stats.predicates} predicates "
-          f"-> {args.out}")
+    log.get_logger("export").info("export done", quads=stats.quads,
+                                  predicates=stats.predicates, out=args.out)
     return 0
 
 
@@ -99,6 +108,7 @@ def cmd_live(args) -> int:
     from dgraph_tpu.api.server import Node
     from dgraph_tpu.loader.live import live_load
 
+    lg = log.get_logger("live")
     node = Node(dirpath=args.postings)
     if args.schema:
         with open(args.schema) as f:
@@ -106,12 +116,11 @@ def cmd_live(args) -> int:
     try:
         stats = live_load(node, args.files, batch=args.batch,
                           xidmap_path=args.xidmap,
-                          progress=lambda n: print(f"  {n} quads...",
-                                                   flush=True))
+                          progress=lambda n: lg.info("loading", quads=n))
     finally:
         node.close()
-    print(f"live: {stats.quads} quads in {stats.txns} txns "
-          f"({stats.aborts} retried aborts) -> {args.postings}")
+    lg.info("live load done", quads=stats.quads, txns=stats.txns,
+            retried_aborts=stats.aborts, postings=args.postings)
     return 0
 
 
@@ -126,6 +135,7 @@ def cmd_worker(args) -> int:
     from dgraph_tpu.storage.store import Store
     from dgraph_tpu.utils.schema import parse_schema
 
+    lg = log.get_logger("worker")
     store = Store(args.postings)
     if args.schema:
         with open(args.schema) as f:
@@ -143,7 +153,7 @@ def cmd_worker(args) -> int:
         svc = server.dgt_svc
         my_addr = svc.advertise_addr
         group, rid = zc.connect(my_addr, args.group)
-        print(f"worker joined group {group} as replica {rid}", flush=True)
+        lg.info("worker joined group", group=group, replica=rid)
 
         def _learn_members():
             # seed the wire-election membership from Zero's registry so a
@@ -174,8 +184,8 @@ def cmd_worker(args) -> int:
 
         if args.membership_interval > 0:
             threading.Thread(target=membership_loop, daemon=True).start()
-    print(f"worker serving {len(store.predicates())} tablets on "
-          f"{args.host}:{port}", flush=True)
+    lg.info(f"worker serving {len(store.predicates())} tablets on "
+            f"{args.host}:{port}")
     try:
         while True:
             time.sleep(3600)
@@ -198,6 +208,7 @@ def cmd_zero(args) -> int:
     from dgraph_tpu.coord.zero_service import (ZeroOps, serve_zero,
                                                serve_zero_http)
 
+    lg = log.get_logger("zero")
     zero = Zero(n_groups=args.groups, dirpath=args.wal)
     from dgraph_tpu.coord.zero_service import ZeroReplica, ZeroService
 
@@ -213,11 +224,11 @@ def cmd_zero(args) -> int:
     server, port, svc = serve_zero(zero, f"{args.host}:{args.port}", svc=svc)
     if replica is not None:
         replica.start()
-        print(f"zero replica {args.idx} of {len(replica.members)} "
-              f"(leader={replica.is_leader})", flush=True)
+        lg.info("zero replica up", idx=args.idx,
+                members=len(replica.members), leader=replica.is_leader)
     ops = ZeroOps(svc)
     httpd, hport = serve_zero_http(svc, ops, args.host, args.http_port)
-    print(f"zero ops HTTP on {args.host}:{hport}", flush=True)
+    lg.info(f"zero ops HTTP on {args.host}:{hport}")
     if args.rebalance_interval > 0:
         def loop():
             while True:
@@ -225,12 +236,11 @@ def cmd_zero(args) -> int:
                 try:
                     out = ops.rebalance_once()
                     if out:
-                        print(f"rebalanced: {out}", flush=True)
+                        lg.info("rebalanced", **out)
                 except Exception as e:       # noqa: BLE001 — next tick retries
-                    print(f"rebalance error: {e}", flush=True)
+                    lg.error("rebalance error", error=str(e))
         threading.Thread(target=loop, daemon=True).start()
-    print(f"zero serving {args.groups} groups on {args.host}:{port}",
-          flush=True)
+    lg.info(f"zero serving {args.groups} groups on {args.host}:{port}")
     try:
         while True:
             time.sleep(3600)
@@ -246,8 +256,8 @@ def cmd_convert(args) -> int:
     from dgraph_tpu.loader.convert import convert_geojson
 
     stats = convert_geojson(args.geo, args.out, geopred=args.geopred)
-    print(f"convert: {stats.features} features -> {stats.triples} triples "
-          f"-> {args.out}")
+    log.get_logger("convert").info("convert done", features=stats.features,
+                                   triples=stats.triples, out=args.out)
     return 0
 
 
@@ -290,6 +300,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--schema", default=None, help="schema file to apply")
     sp.add_argument("--trace", type=float, default=1.0,
                     help="fraction of requests to trace (/debug/requests)")
+    sp.add_argument("--span_sample", type=float, default=0.01,
+                    help="fraction of requests getting a full span trace "
+                         "(/debug/traces; Chrome trace JSON per trace; "
+                         "set 1.0 when debugging a specific query)")
+    sp.add_argument("--slow_query_ms", type=float, default=0.0,
+                    help="log queries slower than this to /debug/slow "
+                         "(plan + span tree; 0 disables)")
+    sp.add_argument("--slow_query_log", default=None,
+                    help="also append slow-query entries to this JSONL file")
     sp.add_argument("--plan_cache", type=int, default=256,
                     help="parsed-plan cache entries (0 disables)")
     sp.add_argument("--task_cache_mb", type=int, default=64,
@@ -403,12 +422,17 @@ def build_parser() -> argparse.ArgumentParser:
     cp.set_defaults(fn=cmd_convert)
 
     for sp_ in (sp, bp, ep, lp, cp, wp, zp):
+        sp_.add_argument("--log_json", action="store_true",
+                         help="structured single-line JSON logs instead of "
+                              "text (log shippers ingest these directly)")
         _apply_env_defaults(sp_)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "log_json", False):
+        log.configure(json_mode=True)
     return args.fn(args)
 
 

@@ -65,7 +65,8 @@ _DASHBOARD_HTML = """<!doctype html>
  .lat{color:#616e88;font-size:12px;align-self:center}
 </style></head><body>
 <header><b>dgraph-tpu</b><span>query console — POST /query /mutate /alter;
-GET /state /health /debug/vars /debug/metrics</span></header>
+GET /state /health /metrics /debug (index: vars, metrics, traces,
+slow)</span></header>
 <main>
  <div class="col">
   <textarea id="q">{
@@ -254,12 +255,34 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
 
+    # the /debug index: one place that names every diagnostic endpoint
+    _DEBUG_INDEX = {
+        "/debug/vars": "expvar-style dgraph_* counters/histograms",
+        "/debug/requests": "sampled request breadcrumb traces (?n=32)",
+        "/debug/metrics": "serving-layer readout: caches, overlay, planner",
+        "/debug/traces": "distributed span traces index (?n=32)",
+        "/debug/traces/<trace_id>": "one trace as Chrome trace-event JSON "
+                                    "(load in Perfetto / chrome://tracing)",
+        "/debug/slow": "slow-query log ring (?n=32)",
+        "/metrics": "Prometheus text exposition of the metrics registry",
+    }
+
     def _do_get(self):
         path = urlparse(self.path).path.rstrip("/")
         if path == "/health":
             self._send(200, json.dumps(self.node.health()).encode())
         elif path == "/state":
             self._send(200, json.dumps(self.node.state()).encode())
+        elif path == "/metrics":
+            # Prometheus text exposition of the whole Registry (counters,
+            # summaries, labeled gauges) — scrape this endpoint
+            from dgraph_tpu.obs import prom
+
+            self._send(200, prom.render(self.node.metrics).encode(),
+                       ctype="text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/debug":
+            self._send(200, json.dumps(
+                {"endpoints": self._DEBUG_INDEX}).encode())
         elif path == "/debug/vars":
             # expvar-style metrics dump (reference x/metrics.go /debug/vars)
             self._send(200, json.dumps(self.node.metrics.to_dict()).encode())
@@ -271,6 +294,27 @@ class _Handler(BaseHTTPRequestHandler):
             # serving-layer readout: cache hit rates, dispatch gate,
             # per-endpoint QPS + latency histograms (round-6 tier)
             self._send(200, json.dumps(_serving_metrics(self.node)).encode())
+        elif path == "/debug/traces":
+            n = int(self._qs().get("n", "32"))
+            self._send(200, json.dumps(self.node.tracer.sink.index(n),
+                                       default=str).encode())
+        elif path.startswith("/debug/traces/"):
+            from dgraph_tpu.obs import otrace
+
+            rec = self.node.tracer.sink.get(path.rsplit("/", 1)[1])
+            if rec is None:
+                self._send(404, _envelope_err("ErrorInvalidRequest",
+                                              "no such trace"))
+            elif self._qs().get("view") == "tree":
+                self._send(200, json.dumps(otrace.span_tree(rec),
+                                           default=str).encode())
+            else:
+                self._send(200, json.dumps(otrace.chrome_trace(rec),
+                                           default=str).encode())
+        elif path == "/debug/slow":
+            n = int(self._qs().get("n", "32"))
+            self._send(200, json.dumps(self.node.slow_log.recent(n),
+                                       default=str).encode())
         elif path in ("", "/ui"):
             # embedded query console (reference: the static dashboard
             # served by dgraph/cmd/server/dashboard.go)

@@ -26,6 +26,8 @@ import time
 from dataclasses import dataclass, field
 
 from dgraph_tpu.coord.zero import TxnConflict, Zero
+from dgraph_tpu.obs import otrace
+from dgraph_tpu.obs.slowlog import SlowQueryLog
 from dgraph_tpu.query import dql, rdf
 from dgraph_tpu.query import mutation as mut
 from dgraph_tpu.query import qcache
@@ -83,7 +85,11 @@ class Node:
                  background_rollup: bool = True,
                  fold_workers: int | None = None,
                  planner: bool = True,
-                 stats_top_k: int = 8) -> None:
+                 stats_top_k: int = 8,
+                 span_sample: float = 0.01,
+                 trace_rng=None,
+                 slow_query_ms: float = 0.0,
+                 slow_query_log: str | None = None) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -91,7 +97,15 @@ class Node:
                            if memory_mb else None)
         self.zero = Zero(n_groups)
         self.metrics = metrics.Registry()
-        self.traces = metrics.TraceStore(fraction=trace_fraction)
+        self.traces = metrics.TraceStore(fraction=trace_fraction,
+                                         rng=trace_rng)
+        # span tracing + device profiling (obs/otrace.py): root spans start
+        # at query/mutate/alter, children attach via contextvar down to the
+        # device kernels; completed traces export as Chrome trace JSON at
+        # /debug/traces/<id>. slow_query_ms > 0 arms the slow-query log.
+        self.slow_log = SlowQueryLog(slow_query_ms, path=slow_query_log)
+        self.tracer = otrace.Tracer(fraction=span_sample, proc="node",
+                                    rng=trace_rng, slowlog=self.slow_log)
         # round-6 serving tier: parsed-plan cache, snapshot-keyed task
         # result LRU (+ singleflight), bounded device-dispatch gate.
         # Size 0 disables a tier (bench.py's cold-cache mode).
@@ -250,7 +264,7 @@ class Node:
         """CommitOrAbort (edgraph/server.go:462). Returns commit_ts; raises
         TxnConflict after aborting the txn's buffered layers on conflict."""
         t0 = time.perf_counter()
-        with self._lock:
+        with self._span("commit", start_ts=int(start_ts)), self._lock:
             ctx = self._txns.get(start_ts)
             if ctx is None:
                 raise mut.MutationError(f"unknown txn {start_ts}")
@@ -264,7 +278,8 @@ class Node:
                 # a concurrent commit/abort won the race while we waited
                 raise mut.MutationError(f"unknown txn {start_ts}")
             try:
-                commit_ts = self.zero.oracle.commit(start_ts)
+                with otrace.span("zero:commit"):
+                    commit_ts = self.zero.oracle.commit(start_ts)
             except TxnConflict:
                 self.store.abort(start_ts, ctx.keys)
                 ctx.aborted = True
@@ -331,6 +346,19 @@ class Node:
 
     # -- parsing --------------------------------------------------------------
 
+    def _span(self, name: str, **attrs):
+        """Root span when nothing is active on this execution context
+        (direct API / HTTP entry — the sampling decision happens here);
+        child span when nested (upsert inside query, commit inside
+        mutate). An armed slow-query log force-samples every root: a slow
+        query can only be identified AFTER it ran, so the threshold can
+        never be honored from a 1% sample."""
+        cur = otrace.current()
+        if cur is not None:
+            return self.tracer.start(name, parent=cur, attrs=attrs)
+        return self.tracer.root(name, attrs=attrs,
+                                force=self.slow_log.enabled)
+
     def _parse(self, q: str, variables: dict | None = None) -> dql.ParsedRequest:
         """Parse through the plan cache: hot query shapes skip the lexer +
         recursive-descent parser entirely. Parsed trees are read-only
@@ -346,7 +374,11 @@ class Node:
         """Snapshot for a read: committed state at read_ts, with an open
         txn's own uncommitted layers overlaid when start_ts names one
         (posting/list.go:528 — StartTs == readTs visibility)."""
-        read_ts = start_ts if start_ts is not None else self.zero.oracle.read_ts()
+        if start_ts is not None:
+            read_ts = start_ts
+        else:
+            with otrace.span("zero:read_ts"):
+                read_ts = self.zero.oracle.read_ts()
         with self._lock:
             # only an EXPLICIT startTs continues an open txn: a fresh read's
             # ts may numerically equal a pending txn's start_ts and must not
@@ -398,14 +430,17 @@ class Node:
         physical plan tree with estimated vs actual cardinality per step
         (the ?explain=true HTTP surface). Explain requests bypass the
         whole-query result cache so the actuals are real."""
-        tr = self.traces.start(
-            "query", q.strip().splitlines()[0][:120] if q.strip() else "")
+        qtitle = q.strip().splitlines()[0][:120] if q.strip() else ""
+        tr = self.traces.start("query", qtitle)
+        sp = self._span("query", query=qtitle)
         m = self.metrics
         m.counter("dgraph_num_queries_total").inc()
         m.counter("dgraph_pending_queries_total").inc()
         m.meter("query").mark()
         t0 = time.perf_counter()
+        err = ""
         try:
+          with sp:
             req = self._parse(q, variables)
             tr.printf("parsed: %d query blocks", len(req.queries))
             if req.upsert is not None:
@@ -422,6 +457,7 @@ class Node:
                 read_ts, snap = start_ts, self.snapshot(start_ts)
             else:
                 read_ts, snap = self._read_view(start_ts)
+            sp.set(read_ts=int(read_ts))
             tr.printf("snapshot at ts %d (%d preds)", read_ts, len(snap.preds))
             # whole-query result tier: keyed on (plan key, per-predicate
             # token tuple of the plan's read set, edge budget). A commit to
@@ -447,6 +483,7 @@ class Node:
                     cached = self.result_cache.get(rkey)
                     if cached is not None:
                         tr.printf("result cache hit")
+                        sp.set(result_cache="hit")
                         return cached, TxnContext(start_ts=read_ts)
             # cost-based plan (order decisions only): cached alongside the
             # AST, keyed on the per-predicate stats tokens of the plan's
@@ -471,6 +508,14 @@ class Node:
                     self.metrics.counter(
                         "dgraph_planner_fallbacks_total").inc()
                     plan = None
+                if plan is not None and sp:
+                    # compact decision summary for the slow-query log;
+                    # per-step est-vs-actual rides Plan.record span events
+                    sp.set(plan={
+                        "root_swaps": len(plan.root_swap),
+                        "filter_reorders": len(plan.and_order),
+                        "sibling_reorders": len(plan.child_order),
+                        "cutover_overrides": len(plan.cutover)})
             out = Executor(snap, self.store.schema,
                            cache=self.task_cache, gate=self.dispatch_gate,
                            edge_limit=edge_limit, plan=plan,
@@ -486,16 +531,17 @@ class Node:
                                   if plan is not None
                                   else {"planner": "off"})
             return out, TxnContext(start_ts=read_ts)
-        except Exception as e:
-            self.traces.finish(tr, error=str(e))
-            tr = None
+        except BaseException as e:
+            # EVERY failure shape finishes the breadcrumb trace with its
+            # error, exactly once, via the finally below — including
+            # TxnConflict from the upsert path and non-Exception bases
+            err = str(e) or type(e).__name__
             raise
         finally:
             m.counter("dgraph_pending_queries_total").dec()
             m.histogram("dgraph_query_latency_s").observe(
                 time.perf_counter() - t0)
-            if tr is not None:
-                self.traces.finish(tr)
+            self.traces.finish(tr, error=err)
 
     def upsert(self, q: str, mutations: list[dict],
                variables: dict | None = None, start_ts: int | None = None,
@@ -513,41 +559,45 @@ class Node:
                 ctx = self._txns.get(start_ts)
                 if ctx is None:
                     raise mut.MutationError(f"unknown txn {start_ts}")
-        try:
-            out: dict = {}
-            vars_map: dict = {}
-            if q.strip():
-                _, snap = self._read_view(ctx.start_ts)
-                ex = Executor(snap, self.store.schema,
-                              cache=self.task_cache, gate=self.dispatch_gate)
-                out = ex.execute(self._parse(q, variables))
-                vars_map = ex.vars
-            uid_map: dict = {}
-            for m in mutations:
-                cond = m.get("cond", "")
-                if cond and not ups.eval_cond(cond, vars_map):
-                    continue
-                nq_set = ups.expand(rdf.parse(m.get("set", "")), vars_map)
-                nq_del = ups.expand(rdf.parse(m.get("delete", "")), vars_map)
-                if m.get("set_json") is not None:
-                    nq_set += mut.nquads_from_json(m["set_json"], Op.SET)
-                if m.get("delete_json") is not None:
-                    nq_del += mut.nquads_from_json(m["delete_json"], Op.DEL)
-                if not nq_set and not nq_del:
-                    continue   # cond met but every quad's var was empty
-                res = self.mutate_quads(nq_set, nq_del, commit_now=False,
-                                        start_ts=ctx.start_ts)
-                uid_map.update(res.uids)
-        except BaseException:
-            if own_txn:
-                # don't leak the implicit txn (it would pin the oracle's
-                # conflict-GC watermark); an explicit txn stays open for the
-                # client to retry or abort
-                self.abort(ctx.start_ts)
-            raise
-        if commit_now:
-            self.commit(ctx.start_ts)
-        return out, uid_map, ctx
+        with self._span("upsert", mutations=len(mutations)):
+            try:
+                out: dict = {}
+                vars_map: dict = {}
+                if q.strip():
+                    _, snap = self._read_view(ctx.start_ts)
+                    ex = Executor(snap, self.store.schema,
+                                  cache=self.task_cache,
+                                  gate=self.dispatch_gate)
+                    out = ex.execute(self._parse(q, variables))
+                    vars_map = ex.vars
+                uid_map: dict = {}
+                for m in mutations:
+                    cond = m.get("cond", "")
+                    if cond and not ups.eval_cond(cond, vars_map):
+                        continue
+                    nq_set = ups.expand(rdf.parse(m.get("set", "")), vars_map)
+                    nq_del = ups.expand(rdf.parse(m.get("delete", "")),
+                                        vars_map)
+                    if m.get("set_json") is not None:
+                        nq_set += mut.nquads_from_json(m["set_json"], Op.SET)
+                    if m.get("delete_json") is not None:
+                        nq_del += mut.nquads_from_json(m["delete_json"],
+                                                       Op.DEL)
+                    if not nq_set and not nq_del:
+                        continue   # cond met but every quad's var was empty
+                    res = self.mutate_quads(nq_set, nq_del, commit_now=False,
+                                            start_ts=ctx.start_ts)
+                    uid_map.update(res.uids)
+            except BaseException:
+                if own_txn:
+                    # don't leak the implicit txn (it would pin the oracle's
+                    # conflict-GC watermark); an explicit txn stays open for
+                    # the client to retry or abort
+                    self.abort(ctx.start_ts)
+                raise
+            if commit_now:
+                self.commit(ctx.start_ts)
+            return out, uid_map, ctx
 
     def _schema_json(self, preds: list[str]) -> list[dict]:
         from dgraph_tpu.utils.schema import schema_json
@@ -578,12 +628,18 @@ class Node:
         nquads_del = list(nquads_del)
         if not nquads_set and not nquads_del:
             raise mut.MutationError("empty mutation")
+        tr = self.traces.start(
+            "mutate", f"{len(nquads_set)} set / {len(nquads_del)} del")
+        sp = self._span("mutate", set=len(nquads_set),
+                        delete=len(nquads_del))
         m = self.metrics
         m.counter("dgraph_num_mutations_total").inc()
         m.counter("dgraph_active_mutations_total").inc()
         m.meter("mutate").mark()
         t0 = time.perf_counter()
+        err = ""
         try:
+          with sp:
             with self._lock:
                 if start_ts is None:
                     ctx = self.new_txn()
@@ -645,10 +701,14 @@ class Node:
             if commit_now:
                 self.commit(ctx.start_ts)
             return res
+        except BaseException as e:
+            err = str(e) or type(e).__name__
+            raise
         finally:
             m.counter("dgraph_active_mutations_total").dec()
             m.histogram("dgraph_mutation_latency_s").observe(
                 time.perf_counter() - t0)
+            self.traces.finish(tr, error=err)
 
     def run_request(self, q: str, variables: dict | None = None,
                     commit_now: bool = True) -> tuple[dict, MutationResult | None]:
@@ -675,6 +735,22 @@ class Node:
         """Schema mutations + drops (server.go:213), with the reindex
         pipeline (worker/mutation.go:97 runSchemaMutation)."""
         self.metrics.counter("dgraph_num_alters_total").inc()
+        title = ("drop_all" if drop_all else
+                 f"drop {drop_attr}" if drop_attr else
+                 (schema_text.strip().splitlines() or [""])[0][:120])
+        tr = self.traces.start("alter", title)
+        err = ""
+        try:
+          with self._span("alter", op=title):
+            self._alter_locked(schema_text, drop_attr, drop_all)
+        except BaseException as e:
+            err = str(e) or type(e).__name__
+            raise
+        finally:
+            self.traces.finish(tr, error=err)
+
+    def _alter_locked(self, schema_text: str, drop_attr: str,
+                      drop_all: bool) -> None:
         with self._lock:
             if drop_all:
                 for attr in set(self.store.predicates()) | \
@@ -777,4 +853,5 @@ class Node:
 
     def close(self) -> None:
         self._rollup_stop.set()
+        self.slow_log.close()
         self.store.close()

@@ -22,6 +22,7 @@ try:
 except ImportError:              # pragma: no cover
     grpc = None
 
+from ..obs import otrace
 from ..protos import internal_pb2 as ipb
 from ..utils.ballot import tally as _tally
 from .zero import TxnConflict, TxnNotFound, Zero
@@ -39,6 +40,9 @@ class ZeroService:
         self._lock = threading.Lock()
         self._members: dict[int, list[str]] = {}   # group -> member addrs
         self.replica: "ZeroReplica | None" = None  # multi-zero role
+        # trace continuation for coordinator RPCs: a client-propagated span
+        # context puts lease/commit/tablet calls in the query's trace
+        self.tracer = otrace.Tracer(proc="zero")
 
     def _require_leader(self, ctx) -> None:
         if self.replica is not None and not self.replica.is_leader:
@@ -124,26 +128,54 @@ class ZeroService:
         st["tabletMap"] = self.zero.tablets()
         return ipb.ZeroStateResponse(state_json=json.dumps(st))
 
+    def _traced(self, fn, name: str):
+        """Wrap one handler with trace continuation: join a propagated
+        span context, ship the server span back in trailing metadata."""
+        def handler(msg, ctx):
+            wire = None
+            if ctx is not None:
+                for k, v in ctx.invocation_metadata() or ():
+                    if k == otrace.WIRE_KEY:
+                        wire = v
+                        break
+            if not wire:
+                return fn(msg, ctx)
+            sp = self.tracer.join(wire, f"zero:{name}")
+            try:
+                with sp:
+                    return fn(msg, ctx)
+            finally:
+                spans = self.tracer.take(sp.trace_id)
+                if spans:
+                    try:
+                        ctx.set_trailing_metadata(
+                            ((otrace.SPANS_KEY,
+                              otrace.encode_spans(spans)),))
+                    except Exception:
+                        pass     # aborted RPC: spans drop, buffer drained
+        return handler
+
     def handler(self):
-        def u(fn, req_cls, resp_cls):
+        def u(fn, req_cls, resp_cls, name=""):
             return grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString,
+                self._traced(fn, name) if name else fn,
+                request_deserializer=req_cls.FromString,
                 response_serializer=resp_cls.SerializeToString)
         methods = {
             "Connect": u(self.connect, ipb.ZeroConnectRequest,
-                         ipb.ZeroConnectResponse),
+                         ipb.ZeroConnectResponse, "Connect"),
             "NewTxn": u(self.new_txn, ipb.ZeroLeaseRequest,
-                        ipb.ZeroLeaseResponse),
+                        ipb.ZeroLeaseResponse, "NewTxn"),
             "Timestamps": u(self.timestamps, ipb.ZeroLeaseRequest,
-                            ipb.ZeroLeaseResponse),
+                            ipb.ZeroLeaseResponse, "Timestamps"),
             "AssignUids": u(self.assign_uids, ipb.ZeroLeaseRequest,
-                            ipb.ZeroLeaseResponse),
+                            ipb.ZeroLeaseResponse, "AssignUids"),
             "CommitOrAbort": u(self.commit_or_abort, ipb.ZeroCommitRequest,
-                               ipb.ZeroCommitResponse),
+                               ipb.ZeroCommitResponse, "CommitOrAbort"),
             "ShouldServe": u(self.should_serve, ipb.ZeroTabletRequest,
-                             ipb.ZeroTabletResponse),
+                             ipb.ZeroTabletResponse, "ShouldServe"),
             "State": u(self.state, ipb.ZeroStateRequest,
-                       ipb.ZeroStateResponse),
+                       ipb.ZeroStateResponse, "State"),
         }
         if self.replica is not None:
             r = self.replica
@@ -761,9 +793,11 @@ class ZeroClient:
         return self.addrs[self._i]
 
     def _open(self, addr: str) -> None:
+        from ..parallel.remote import GRPC_OPTIONS
+
         if self.channel is not None:
             self.channel.close()
-        self.channel = grpc.insecure_channel(addr)
+        self.channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
         for attr, (name, req_cls, resp_cls) in self._STUBS.items():
             setattr(self, attr, self.channel.unary_unary(
                 f"/{SERVICE}/{name}",
@@ -776,11 +810,33 @@ class ZeroClient:
 
     def _rpc(self, stub_name: str, req, timeout: float = 10.0):
         """Issue an RPC with leader failover: dead zero / standby rejection
-        rotates to the next address (2 passes over the ring)."""
+        rotates to the next address (2 passes over the ring). When a trace
+        is active, the call runs under a client span and propagates the
+        span context to Zero (its server span rides back in trailing
+        metadata), so coordinator hops show in the query's trace."""
+        sp = otrace.current()
+        if sp is None:
+            return self._rpc_raw(stub_name, req, timeout, None)
+        with sp.tracer.start(f"zero:{self._STUBS[stub_name][0]}", parent=sp,
+                             kind="client",
+                             attrs={"addr": self.addr}) as rsp:
+            return self._rpc_raw(stub_name, req, timeout, rsp)
+
+    def _rpc_raw(self, stub_name: str, req, timeout: float, rsp):
         last = None
         for _ in range(max(2 * len(self.addrs), 1)):
             try:
-                return getattr(self, stub_name)(req, timeout=timeout)
+                stub = getattr(self, stub_name)
+                if rsp is None:
+                    return stub(req, timeout=timeout)
+                resp, call = stub.with_call(
+                    req, timeout=timeout,
+                    metadata=((otrace.WIRE_KEY,
+                               f"{rsp.trace_id}:{rsp.span_id}"),))
+                for k, v in call.trailing_metadata() or ():
+                    if k == otrace.SPANS_KEY:
+                        rsp.tracer.add_remote(otrace.decode_spans(v))
+                return resp
             except grpc.RpcError as e:
                 code = e.code()
                 # rotate only on signals that the call was NOT processed

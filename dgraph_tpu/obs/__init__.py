@@ -1,0 +1,10 @@
+"""Observability subsystem: distributed span tracing + device profiling
+(obs/otrace.py), Prometheus text exposition of the metrics Registry
+(obs/prom.py), and the threshold-gated slow-query log (obs/slowlog.py).
+
+The span model is Dapper's (Sigelman et al., 2010): every sampled request
+gets a trace_id; every unit of work a (span_id, parent_id) pair; context
+rides gRPC metadata across the cross-shard fan-out and rides a contextvar
+within a process, so one query's tree covers client dispatch, every
+per-group serve_task, Zero coordinator calls, and the device kernels.
+"""

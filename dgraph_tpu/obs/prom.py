@@ -1,0 +1,135 @@
+"""Prometheus text exposition (version 0.0.4) of a metrics.Registry.
+
+Mapping:
+  Counter       -> `counter` when the name ends in _total, else `gauge`
+                   (the registry uses Counter.set for gauge-shaped values
+                   like dgraph_memory_bytes, matching the reference's
+                   expvar dual use).
+  Histogram     -> a summary: `{quantile="0.5|0.95|0.99"}` rows over the
+                   recent-window ring plus _sum/_count lifetime series.
+  Meter         -> gauge `dgraph_endpoint_qps{endpoint="<name>"}`.
+  KeyedGauge    -> gauge with a `key` label per entry.
+
+Names already follow the dgraph_* vocabulary and are valid Prometheus
+metric names; keys/labels are escaped per the text-format rules.
+"""
+
+from __future__ import annotations
+
+import re
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# registry names that END in _total but are inc/dec LEVELS, not monotonic
+# counters (the reference's expvar dual-use) — a counter TYPE would make
+# every decrease read as a reset, so rate()/increase() would spike
+_LEVEL_TOTALS = frozenset({"dgraph_pending_queries_total",
+                           "dgraph_active_mutations_total"})
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _num(v) -> str:
+    f = float(v)
+    return repr(int(f)) if f.is_integer() else repr(f)
+
+
+def _safe(name: str) -> str:
+    return name if _NAME_OK.match(name) else \
+        re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def render(registry) -> str:
+    """The /metrics payload. The registry's metric MAPS are copied under
+    its lock (a concurrent first-use setdefault must not resize them
+    mid-iteration); the per-metric reads below use each metric's own
+    locking."""
+    lock = getattr(registry, "_lock", None)
+    if lock is not None:
+        with lock:
+            counters = dict(registry.counters)
+            histograms = dict(registry.histograms)
+            meters = dict(registry.meters)
+            keyed = dict(registry.keyed_gauges)
+    else:
+        counters, histograms = dict(registry.counters), \
+            dict(registry.histograms)
+        meters, keyed = dict(registry.meters), dict(registry.keyed_gauges)
+    out: list[str] = []
+
+    for name, c in sorted(counters.items()):
+        name = _safe(name)
+        kind = "counter" if name.endswith("_total") \
+            and name not in _LEVEL_TOTALS else "gauge"
+        out.append(f"# TYPE {name} {kind}")
+        out.append(f"{name} {_num(c.value)}")
+
+    for name, h in sorted(histograms.items()):
+        name = _safe(name)
+        s = h.snapshot()
+        out.append(f"# TYPE {name} summary")
+        for q in ("p50", "p95", "p99"):
+            if q in s:
+                out.append(f'{name}{{quantile="0.{q[1:]}"}} {_num(s[q])}')
+        out.append(f"{name}_sum {_num(h.total)}")
+        out.append(f"{name}_count {_num(s['count'])}")
+
+    if meters:
+        out.append("# TYPE dgraph_endpoint_qps gauge")
+        for name, m in sorted(meters.items()):
+            out.append(f'dgraph_endpoint_qps{{endpoint="{_esc(name)}"}} '
+                       f"{_num(m.rate())}")
+
+    for name, g in sorted(keyed.items()):
+        name = _safe(name)
+        out.append(f"# TYPE {name} gauge")
+        for key, v in sorted(g.snapshot().items()):
+            out.append(f'{name}{{key="{_esc(key)}"}} {_num(v)}')
+
+    return "\n".join(out) + "\n"
+
+
+def parse(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Minimal text-format parse check: returns {metric: [(labels, value)]}
+    and raises ValueError on any malformed line. Used by tests and
+    contrib/scripts/smoke_trace.sh to validate the exposition — not a
+    full Prometheus client."""
+    series: dict[str, list[tuple[dict, float]]] = {}
+    typed: dict[str, str] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                if not _NAME_OK.match(parts[2]):
+                    raise ValueError(f"line {ln}: bad metric name {parts[2]}")
+                if parts[3] not in ("counter", "gauge", "summary",
+                                    "histogram", "untyped"):
+                    raise ValueError(f"line {ln}: bad type {parts[3]}")
+                typed[parts[2]] = parts[3]
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                     r"(?:\{([^}]*)\})?\s+(\S+)$", line)
+        if m is None:
+            raise ValueError(f"line {ln}: malformed sample {line!r}")
+        name, labels_raw, value = m.groups()
+        labels: dict[str, str] = {}
+        if labels_raw:
+            for item in re.finditer(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                    labels_raw):
+                labels[item.group(1)] = item.group(2)
+            if not labels:
+                raise ValueError(f"line {ln}: malformed labels {labels_raw!r}")
+        try:
+            fv = float(value)
+        except ValueError:
+            raise ValueError(f"line {ln}: non-numeric value {value!r}")
+        base = re.sub(r"_(sum|count)$", "", name)
+        if base not in typed and name not in typed:
+            raise ValueError(f"line {ln}: sample {name} without # TYPE")
+        series.setdefault(name, []).append((labels, fv))
+    return series

@@ -15,6 +15,7 @@ import time
 
 from ..coord.zero import TxnConflict
 from ..coord.zero_service import ZeroClient
+from ..obs import otrace
 from ..query import dql
 from ..query import mutation as mut
 from ..query import rdf
@@ -76,7 +77,8 @@ class ClusterClient:
     CACHE_TTL = 1.0
 
     def __init__(self, zero_addr: str,
-                 groups: dict[int, list[str]]) -> None:
+                 groups: dict[int, list[str]],
+                 span_sample: float = 0.0, trace_rng=None) -> None:
         """groups: group id -> replica worker addresses (leader discovered
         via Status polling, re-discovered on failover). Each group is a
         HedgedReplicas set: reads hedge to a second replica after a grace
@@ -98,6 +100,11 @@ class ClusterClient:
         self.metrics = metrics_mod.Registry()
         self.task_cache = TaskResultCache(32 << 20, self.metrics)
         self.dispatch_gate = DispatchGate(8, self.metrics)
+        # distributed tracing: a sampled query roots its trace here and
+        # assembles the full cross-process tree (worker + zero spans ride
+        # back over RPC trailing metadata) in tracer.sink
+        self.tracer = otrace.Tracer(fraction=span_sample, proc="client",
+                                    rng=trace_rng)
 
     def _invalidate(self) -> None:
         for hr in self.replicas.values():
@@ -147,17 +154,20 @@ class ClusterClient:
         re-discovery (the reference client's abort-retry loop)."""
         nq_set = rdf.parse(set_nquads) if set_nquads else []
         nq_del = rdf.parse(del_nquads) if del_nquads else []
-        last: Exception | None = None
-        for _attempt in range(retries):
-            try:
-                return self._mutate_once(nq_set, nq_del)
-            except TxnConflict:
-                raise
-            except Exception as e:       # leader died / NoQuorum: retry
-                last = e
-                self._invalidate()       # re-discover leaders + tablet map
-                time.sleep(0.1)
-        raise last if last else RuntimeError("mutate failed")
+        with self.tracer.root("mutate",
+                              attrs={"set": len(nq_set),
+                                     "delete": len(nq_del)}):
+            last: Exception | None = None
+            for _attempt in range(retries):
+                try:
+                    return self._mutate_once(nq_set, nq_del)
+                except TxnConflict:
+                    raise
+                except Exception as e:       # leader died / NoQuorum: retry
+                    last = e
+                    self._invalidate()   # re-discover leaders + tablet map
+                    time.sleep(0.1)
+            raise last if last else RuntimeError("mutate failed")
 
     def _mutate_once(self, nq_set, nq_del) -> dict[str, int]:
         start_ts = self.zero.new_txn()
@@ -211,15 +221,19 @@ class ClusterClient:
 
         transport_errors = (_grpc.RpcError, ConnectionError, OSError,
                             RuntimeError)   # RuntimeError: no live leader
-        for attempt in (0, 1):
-            try:
-                return self._query_once(q, variables)
-            except transport_errors:
-                # parse/semantic errors propagate directly — only transport
-                # failures warrant cache invalidation + a second fan-out
-                if attempt:
-                    raise
-                self._invalidate()
+        qtitle = q.strip().splitlines()[0][:120] if q.strip() else ""
+        with self.tracer.root("query", kind="client",
+                              attrs={"query": qtitle}):
+            for attempt in (0, 1):
+                try:
+                    return self._query_once(q, variables)
+                except transport_errors:
+                    # parse/semantic errors propagate directly — only
+                    # transport failures warrant cache invalidation + a
+                    # second fan-out
+                    if attempt:
+                        raise
+                    self._invalidate()
 
     def _query_once(self, q: str, variables: dict | None) -> dict:
         parsed = dql.parse(q, variables)

@@ -12,6 +12,7 @@ per-element parse); typed values/facets as the store's JSON value encoding.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
@@ -24,6 +25,7 @@ try:
 except ImportError:              # pragma: no cover
     grpc = None
 
+from ..obs import otrace
 from ..protos import internal_pb2 as ipb
 from ..utils.ballot import tally as _tally
 from ..query.task import TaskQuery, TaskResult, process_task
@@ -37,8 +39,11 @@ SERVICE = "dgraph_tpu.internal.Worker"
 # tablet payloads (snapshot streams) far exceed gRPC's 4 MB default. The
 # reference uses 4 GB (x/x.go:56 GrpcMaxSize); predicate moves chunk at
 # MOVE_CHUNK_BYTES so no single message approaches this cap.
+# max_metadata_size: traced RPCs ship their span subtree back in trailing
+# metadata (obs/otrace.py) — the 8 KB default would reject deep traces.
 GRPC_OPTIONS = [("grpc.max_send_message_length", 1 << 30),
-                ("grpc.max_receive_message_length", 1 << 30)]
+                ("grpc.max_receive_message_length", 1 << 30),
+                ("grpc.max_metadata_size", 4 << 20)]
 
 # per-chunk budget for predicate moves (reference: <=32MB Raft-proposal
 # batches, worker/predicate_move.go:187)
@@ -186,6 +191,11 @@ class WorkerService:
 
         self.store = store
         self.metrics = metrics_mod.Registry()
+        # joins traces propagated over ServeTask metadata; collected spans
+        # ship BACK to the caller in trailing metadata (obs/otrace.py), so
+        # the query node assembles one tree — proc is refined to the bound
+        # address by serve_worker.
+        self.tracer = otrace.Tracer(proc="worker")
         self._assembler = SnapshotAssembler(store, metrics=self.metrics)
         self._lock = threading.Lock()
         # server-side task-result cache: repeated/fanned-out ServeTask
@@ -248,6 +258,37 @@ class WorkerService:
     APPLIED_WAIT = 2.0
 
     def serve_task(self, msg: ipb.TaskRequest, context) -> ipb.TaskResponse:
+        """ServeTask with trace continuation: a caller-propagated span
+        context (invocation metadata) makes this group's work — gate
+        waits, cache hits, device kernels — part of the caller's trace;
+        the collected spans return in trailing metadata. An aborted RPC
+        (gate timeout) cannot carry trailing metadata: the spans drop but
+        the buffer drains either way (no leak on mid-fan-out failures)."""
+        wire = None
+        if context is not None:
+            for k, v in context.invocation_metadata() or ():
+                if k == otrace.WIRE_KEY:
+                    wire = v
+                    break
+        if not wire:
+            return self._serve_task_inner(msg, context)
+        sp = self.tracer.join(wire, "serve_task",
+                              attrs={"attr": msg.attr,
+                                     "addr": self.advertise_addr})
+        try:
+            with sp:
+                return self._serve_task_inner(msg, context)
+        finally:
+            spans = self.tracer.take(sp.trace_id)
+            if spans:
+                try:
+                    context.set_trailing_metadata(
+                        ((otrace.SPANS_KEY, otrace.encode_spans(spans)),))
+                except Exception:
+                    pass     # context already terminated (abort path)
+
+    def _serve_task_inner(self, msg: ipb.TaskRequest,
+                          context) -> ipb.TaskResponse:
         q, read_ts = decode_task(msg)
         if msg.min_applied:
             attr = q.attr[1:] if q.attr.startswith("~") else q.attr
@@ -930,6 +971,7 @@ def serve_worker(store, addr: str = "localhost:0",
 
         host = socket.gethostname()
     svc.advertise_addr = f"{host}:{port}"
+    svc.tracer.proc = f"worker:{svc.advertise_addr}"
     if elections:
         svc.enable_elections()
     server.start()
@@ -1061,8 +1103,22 @@ class RemoteWorker:
 
     def process_task(self, q: TaskQuery, read_ts: int,
                      min_applied: int = 0) -> TaskResult:
-        return decode_result(self._serve(
-            encode_task(q, read_ts, min_applied)))
+        msg = encode_task(q, read_ts, min_applied)
+        sp = otrace.current()
+        if sp is None:
+            return decode_result(self._serve(msg))
+        # propagate the span context; the worker's spans ride back in
+        # trailing metadata and graft into this trace's buffer
+        with sp.tracer.start("rpc:ServeTask", parent=sp, kind="client",
+                             attrs={"addr": self.addr,
+                                    "attr": q.attr}) as rsp:
+            resp, call = self._serve.with_call(
+                msg, metadata=((otrace.WIRE_KEY,
+                                f"{rsp.trace_id}:{rsp.span_id}"),))
+            for k, v in call.trailing_metadata() or ():
+                if k == otrace.SPANS_KEY:
+                    rsp.tracer.add_remote(otrace.decode_spans(v))
+            return decode_result(resp)
 
     def membership(self) -> ipb.MembershipResponse:
         return self._membership(ipb.MembershipRequest())
@@ -1133,6 +1189,13 @@ class HedgedReplicas:
         """Force the next leader_worker() to re-discover (mutate-retry
         invalidation)."""
         self._leader_confirmed = False
+
+    def _submit(self, fn, *args):
+        """Pool submit that carries the caller's contextvars (the active
+        trace span) into the worker thread, so hedged RPCs propagate the
+        span context like the synchronous path does."""
+        ctx = contextvars.copy_context()
+        return self._pool.submit(ctx.run, fn, *args)
 
     def leader_worker(self) -> "RemoteWorker":
         """The group's current leader (single-replica groups lead
@@ -1217,8 +1280,8 @@ class HedgedReplicas:
 
     def _hedged_pair(self, q, read_ts, min_applied, order,
                      errs) -> TaskResult | None:
-        f1 = self._pool.submit(self.workers[order[0]].process_task, q,
-                               read_ts, min_applied)
+        f1 = self._submit(self.workers[order[0]].process_task, q,
+                          read_ts, min_applied)
         try:
             return f1.result(timeout=self.HEDGE_GRACE)
         except futures.TimeoutError:
@@ -1226,8 +1289,8 @@ class HedgedReplicas:
         except Exception as e:
             errs.append(e)
             pending = set()
-        pending.add(self._pool.submit(self.workers[order[1]].process_task,
-                                      q, read_ts, min_applied))
+        pending.add(self._submit(self.workers[order[1]].process_task,
+                                 q, read_ts, min_applied))
         while pending:
             done, pending = futures.wait(
                 pending, return_when=futures.FIRST_COMPLETED)

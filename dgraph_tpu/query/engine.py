@@ -125,6 +125,29 @@ class Executor:
                 task_token(snap, q), q, raw)
         else:
             self._dispatch = raw
+        self._dispatch = self._traced_dispatch(self._dispatch)
+
+    @staticmethod
+    def _traced_dispatch(inner):
+        """Span per task at the dispatch seam: cache tiers and the gate run
+        INSIDE the span, so cache hit/miss and wait time are attributed to
+        the task that caused them. One contextvar read when unsampled."""
+        from dgraph_tpu.obs import otrace
+
+        def traced(q):
+            if otrace.current() is None:
+                return inner(q)
+            attrs = {"attr": q.attr}
+            if q.func is not None:
+                attrs["func"] = q.func[0]
+            if q.frontier is not None:
+                attrs["frontier"] = int(len(q.frontier))
+            with otrace.span("task:" + q.attr, **attrs) as sp:
+                res = inner(q)
+                sp.set(dest=int(len(res.dest_uids)),
+                       edges=int(res.traversed_edges))
+                return res
+        return traced
 
     def edge_budget(self) -> int:
         """Effective traversed-edge budget for this request."""

@@ -45,6 +45,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+from dgraph_tpu.obs import otrace
 from dgraph_tpu.query import dql
 from dgraph_tpu.storage import stats as stmod
 
@@ -121,11 +122,17 @@ class Plan:
             return
         if recorder is not None:
             recorder[sid] = int(actual)
+        est = step.est if bound is None else min(step.est, int(bound))
         if self.metrics is not None:
-            est = step.est if bound is None else min(step.est, int(bound))
             err = abs(math.log2((int(actual) + 1) / (est + 1)))
             self.metrics.histogram(
                 "dgraph_planner_est_error_log2").observe(err)
+        sp = otrace.current()
+        if sp is not None:
+            # est-vs-actual per executed plan step rides the span timeline
+            # (instant events in the Perfetto export / slow-query tree)
+            sp.event("plan_step", kind=step.kind, desc=step.desc,
+                     est=int(est), actual=int(actual))
 
 
 # ---------------------------------------------------------------------------

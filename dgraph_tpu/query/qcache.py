@@ -41,6 +41,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from dgraph_tpu.obs import otrace
 from dgraph_tpu.query.task import TaskQuery, TaskResult
 
 # ---------------------------------------------------------------------------
@@ -305,14 +306,17 @@ class TaskResultCache(_ByteLRU):
             with self._lock:
                 res = self._get_locked(fk)
                 if res is not None:
+                    otrace.event("task_cache", outcome="hit")
                     return copy_result(res)
                 fl = self._flights.get(fk)
                 if fl is None:
                     fl = self._flights[fk] = _Flight()
                     self._misses.inc()
+                    otrace.event("task_cache", outcome="miss")
                     break                       # we are the flight leader
             # follower: wait for the leader's result outside the lock
             self._coalesced.inc()
+            otrace.event("task_cache", outcome="coalesced")
             fl.event.wait()
             if fl.error is not None:
                 raise fl.error

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 import numpy as np
 import jax.numpy as jnp
 
+from dgraph_tpu.obs import otrace
 from dgraph_tpu.ops import csr as csrops
 from dgraph_tpu.ops import uidset as us
 from dgraph_tpu.storage.csr_build import GraphSnapshot, PredCSR, PredData, TokenIndex
@@ -126,9 +127,21 @@ def _expand_overlay(ov, uids: np.ndarray,
                                          offs)
     else:
         cap = 1 << max(int(np.ceil(np.log2(need_base + 1))), 4)
-        res = csrops.expand_masked(base.indptr, base.indices,
-                                   jnp.asarray(rb), ro >= 0, out_cap=cap)
-        base_targets = np.asarray(res.targets)[:need_base].astype(np.int64)
+        with otrace.span("device_kernel", kernel="csr.expand_masked",
+                         need=need_base,
+                         cutover=int(cutover or HOST_EXPAND_MAX)) as sp:
+            res = csrops.expand_masked(base.indptr, base.indices,
+                                       jnp.asarray(rb), ro >= 0, out_cap=cap)
+            if sp:
+                # fence so the kernel's wall time lands in THIS span, not
+                # wherever the lazy value is first read
+                res.targets.block_until_ready()
+            targets_dev = np.asarray(res.targets)   # one D2H, shared below
+            if sp:
+                sp.set(edges=need_base,
+                       transfer_h2d_bytes=int(rb.nbytes),
+                       transfer_d2h_bytes=int(targets_dev.nbytes))
+            base_targets = targets_dev[:need_base].astype(np.int64)
     matrix = [base_targets[offs[i]: offs[i + 1]] for i in range(len(uids))]
     for i in np.flatnonzero(ro >= 0).tolist():
         matrix[i] = ov.delta.rows[ro[i]]
@@ -170,6 +183,8 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
             # a small gather is microseconds on the cached host mirror but
             # pays fixed per-dispatch + sync latency on device — the device
             # path wins only once the edge volume amortizes it
+            otrace.event("host_expand", need=need,
+                         cutover=int(cutover or HOST_EXPAND_MAX))
             offs = np.zeros(len(uids) + 1, dtype=np.int64)
             np.cumsum(deg, out=offs[1:])
             targets = _gather_rows_host(indptr_h, csr.host_arrays()[2],
@@ -179,13 +194,21 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
             total = need
         else:
             cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
-            res = csrops.expand(csr.indptr, csr.indices, jnp.asarray(rows),
-                                out_cap=cap)
-            total = int(res.total)
-            if total > cap:  # capacity retry (cannot happen: cap >= degrees)
+            with otrace.span("device_kernel", kernel="csr.expand",
+                             need=need,
+                             cutover=int(cutover or HOST_EXPAND_MAX)) as sp:
                 res = csrops.expand(csr.indptr, csr.indices,
-                                    jnp.asarray(rows), out_cap=total)
-            targets = np.asarray(res.targets)[:total].astype(np.int64)
+                                    jnp.asarray(rows), out_cap=cap)
+                total = int(res.total)   # device sync point
+                if total > cap:  # capacity retry (cannot happen: cap >= degrees)
+                    res = csrops.expand(csr.indptr, csr.indices,
+                                        jnp.asarray(rows), out_cap=total)
+                targets_dev = np.asarray(res.targets)
+                if sp:
+                    sp.set(edges=total,
+                           transfer_h2d_bytes=int(rows.nbytes),
+                           transfer_d2h_bytes=int(targets_dev.nbytes))
+            targets = targets_dev[:total].astype(np.int64)
             counts = np.asarray(res.counts)[: len(uids)]
             offs = np.zeros(len(uids) + 1, dtype=np.int64)
             np.cumsum(counts, out=offs[1:])
@@ -221,8 +244,14 @@ def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
             else np.zeros(0, np.int64)
     rows_arr = us.make_set(np.asarray(rows, dtype=np.int32), capacity=len(rows))
     cap = int(indptr_h[-1]) or 1
-    dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr, out_cap=cap)
-    return us.to_numpy(dest).astype(np.int64)
+    with otrace.span("device_kernel", kernel="csr.expand_dest",
+                     need=total, rows=len(rows)) as sp:
+        dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr,
+                                          out_cap=cap)
+        out = us.to_numpy(dest).astype(np.int64)
+        if sp:
+            sp.set(edges=int(len(out)), transfer_d2h_bytes=int(out.nbytes))
+    return out
 
 
 def _index_uids_intersect_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:

@@ -74,9 +74,11 @@ class Histogram:
 
 class Meter:
     """Sliding-window event rate (per-endpoint QPS for /debug/metrics).
-    Marks keep a bounded timestamp ring; rate() counts events inside the
-    trailing window. The ring bounds memory, so a sustained burst beyond
-    `cap` events/window under-reports — fine for an ops readout."""
+    Marks keep a bounded timestamp ring; rate() PRUNES timestamps older
+    than the retention window from the left (they can never count again)
+    instead of rescanning the full ring per call — O(expired + recent),
+    not O(cap). The ring bounds memory, so a sustained burst beyond `cap`
+    events/window under-reports — fine for an ops readout."""
 
     __slots__ = ("_ring", "_lock", "window")
 
@@ -90,10 +92,28 @@ class Meter:
             self._ring.append(time.monotonic())
 
     def rate(self, window: float | None = None) -> float:
-        w = window or self.window
-        cut = time.monotonic() - w
+        """Events/sec over the trailing `window` seconds, clamped to the
+        meter's retention window: pruning discards marks older than
+        self.window, so a wider request would silently undercount — it
+        gets the full-retention rate instead."""
+        w = min(window or self.window, self.window)
+        now = time.monotonic()
         with self._lock:
-            n = sum(1 for t in self._ring if t >= cut)
+            ring = self._ring
+            # retention is the DEFAULT window: a narrower custom window
+            # must not discard marks the next default-window call needs
+            retain = now - self.window
+            while ring and ring[0] < retain:
+                ring.popleft()
+            if w >= self.window:
+                n = len(ring)
+            else:
+                cut = now - w
+                n = 0
+                for t in reversed(ring):   # recent marks sit at the right
+                    if t < cut:
+                        break
+                    n += 1
         return round(n / w, 3)
 
 
@@ -124,7 +144,11 @@ class KeyedGauge:
                 self._vals.pop(key, None)
 
     def get(self, key: str) -> int:
-        return self._vals.get(key, 0)
+        # dict reads race dict writes in free-threaded builds, and even on
+        # the GIL a concurrent resize can surface torn iteration states —
+        # reads take the same lock the writers do
+        with self._lock:
+            return self._vals.get(key, 0)
 
     def snapshot(self) -> dict[str, int]:
         with self._lock:
@@ -253,15 +277,22 @@ NULL_TRACE = _NullTrace()
 
 class TraceStore:
     """Sampled request traces, newest-first ring (reference: --trace fraction
-    gating tr.New, /debug/requests rendering)."""
+    gating tr.New, /debug/requests rendering).
 
-    def __init__(self, fraction: float = 1.0, keep: int = 64) -> None:
+    rng is injectable (anything with .random()) so tests drive the
+    sampling decision deterministically instead of flaking on the global
+    unseeded generator."""
+
+    def __init__(self, fraction: float = 1.0, keep: int = 64,
+                 rng=None) -> None:
         self.fraction = fraction
+        self.rng = rng if rng is not None else random
         self._ring: deque[Trace] = deque(maxlen=keep)
         self._lock = threading.Lock()
 
     def start(self, kind: str, title: str):
-        if self.fraction <= 0 or random.random() >= self.fraction:
+        if self.fraction <= 0 or \
+                (self.fraction < 1.0 and self.rng.random() >= self.fraction):
             return NULL_TRACE
         return Trace(kind, title)
 

@@ -92,3 +92,106 @@ def test_histogram_percentiles():
         h.observe(float(i))
     s = h.snapshot()
     assert s["count"] == 100 and s["p50"] == 51.0 and s["max"] == 100.0
+
+
+def test_meter_rate_prunes_expired_marks():
+    m = metrics.Meter(window=10.0, cap=8192)
+    import time as _time
+
+    now = _time.monotonic()
+    with m._lock:
+        # 500 expired marks + 3 live ones, planted directly in the ring
+        for dt in range(500):
+            m._ring.append(now - 20.0 - dt * 0.01)
+        for _ in range(3):
+            m._ring.append(now)
+    assert m.rate() == pytest.approx(3 / 10.0)
+    # expired timestamps were dropped from the ring, not rescanned forever
+    assert len(m._ring) == 3
+    # a NARROWER window must not evict marks the default window still needs
+    with m._lock:
+        m._ring.appendleft(now - 5.0)       # inside 10s, outside 1s
+    assert m.rate(window=1.0) == pytest.approx(3 / 1.0)
+    assert len(m._ring) == 4
+    assert m.rate() == pytest.approx(4 / 10.0)
+
+
+def test_keyed_gauge_get_is_locked_and_consistent():
+    g = metrics.KeyedGauge()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            g.set(f"k{i % 50}", i % 7)      # zero values delete keys
+            i += 1
+
+    def reader():
+        try:
+            while not stop.is_set():
+                g.get("k3")
+                g.snapshot()
+        except Exception as e:              # torn dict state surfaces here
+            errors.append(e)
+
+    ts = [threading.Thread(target=writer) for _ in range(2)] + \
+         [threading.Thread(target=reader) for _ in range(2)]
+    for t in ts:
+        t.start()
+    stop.wait(0.3)
+    stop.set()
+    for t in ts:
+        t.join()
+    assert not errors
+    g.set("x", 5)
+    assert g.get("x") == 5 and g.get("missing") == 0
+
+
+def test_trace_store_injectable_rng():
+    class Seq:
+        def __init__(self, vals):
+            self.vals = list(vals)
+
+        def random(self):
+            return self.vals.pop(0)
+
+    ts = metrics.TraceStore(fraction=0.5, rng=Seq([0.1, 0.9, 0.4, 0.6]))
+    picks = [ts.start("query", "t") is not metrics.NULL_TRACE
+             for _ in range(4)]
+    assert picks == [True, False, True, False]
+    # fraction 1.0 never consults the rng (hot path stays coin-flip free)
+    ts_all = metrics.TraceStore(fraction=1.0, rng=Seq([]))
+    assert ts_all.start("query", "t") is not metrics.NULL_TRACE
+
+
+def test_traces_finish_on_every_error_path():
+    """query/mutate/alter breadcrumb traces must finish (with the error)
+    on every failure shape — parse errors, unknown txns, bad schema."""
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    with pytest.raises(Exception):
+        n.query("{ q(func: bogus~~ }")                    # parse error
+    with pytest.raises(Exception):
+        n.mutate(set_nquads='<0x1> <name> "x" .', start_ts=999999)
+    with pytest.raises(Exception):
+        n.alter(schema_text="name: notatype .")
+    kinds = [(t["kind"], t["error"] != "") for t in n.traces.recent()]
+    assert ("query", True) in kinds
+    assert ("mutate", True) in kinds
+    assert ("alter", True) in kinds
+    # the span-trace buffers drained too (no active-trace leaks)
+    assert n.tracer.active_traces() == 0
+
+
+def test_meter_rate_wider_window_clamps_to_retention():
+    """Pruning keeps only self.window of history, so a wider request
+    clamps instead of silently undercounting over the longer divisor."""
+    m = metrics.Meter(window=10.0)
+    import time as _time
+
+    now = _time.monotonic()
+    with m._lock:
+        for _ in range(5):
+            m._ring.append(now - 1.0)
+    assert m.rate(window=60.0) == pytest.approx(5 / 10.0)

@@ -1,0 +1,334 @@
+"""End-to-end distributed tracing (obs/otrace.py): one trace id spans
+client -> every group's serve_task -> Zero coordinator calls -> device
+kernels, with parent/child links intact; traces export as Chrome
+trace-event JSON (Perfetto-loadable, validated structurally); /metrics
+serves a parseable Prometheus exposition; the slow-query log captures
+plan + span tree for threshold-crossing queries."""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.coord.zero_service import serve_zero
+from dgraph_tpu.obs import otrace, prom
+from dgraph_tpu.parallel.client import ClusterClient
+from dgraph_tpu.parallel.remote import serve_worker
+from dgraph_tpu.query import task as taskmod
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+
+SCHEMA = """
+    name: string @index(exact) .
+    age: int @index(int) .
+    follows: [uid] @reverse .
+"""
+
+
+def _mk_store():
+    s = Store()
+    for e in parse_schema(SCHEMA):
+        s.set_schema(e)
+    return s
+
+
+@pytest.fixture
+def wire_cluster():
+    """2 worker groups + a zero, all over real loopback gRPC; name lives
+    on group 0, follows/age on group 1, so a 2-hop query fans to both."""
+    zero = Zero(2)
+    zero.move_tablet("name", 0)
+    zero.move_tablet("follows", 1)
+    zero.move_tablet("age", 1)
+    zsrv, zport, _zsvc = serve_zero(zero, "localhost:0")
+    stores = [_mk_store(), _mk_store()]
+    w0, p0 = serve_worker(stores[0], "localhost:0")
+    w1, p1 = serve_worker(stores[1], "localhost:0")
+    client = ClusterClient(f"localhost:{zport}",
+                           {0: [f"localhost:{p0}"], 1: [f"localhost:{p1}"]},
+                           span_sample=1.0, trace_rng=random.Random(7))
+    client.mutate(set_nquads="""
+        _:a <name> "ann" .
+        _:b <name> "bob" .
+        _:c <name> "cid" .
+        _:a <age> "30" .
+        _:b <age> "41" .
+        _:a <follows> _:b .
+        _:a <follows> _:c .
+    """)
+    yield client, (f"localhost:{p0}", f"localhost:{p1}"), (w0, w1)
+    client.close()
+    w0.stop(0)
+    w1.stop(0)
+    zsrv.stop(0)
+
+
+def _links_intact(spans):
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans if not s["parent_id"]]
+    assert len(roots) == 1, f"expected one root, got {roots}"
+    for s in spans:
+        if s["parent_id"]:
+            assert s["parent_id"] in ids, \
+                f"dangling parent {s['parent_id']} for {s['name']}"
+    return roots[0]
+
+
+def test_single_trace_spans_client_workers_zero_device(wire_cluster,
+                                                       monkeypatch):
+    client, addrs, _srvs = wire_cluster
+    # force the device expand path for tiny frontiers so the trace carries
+    # a real device-kernel span with transfer bytes
+    monkeypatch.setattr(taskmod, "HOST_EXPAND_MAX", 0)
+    out = client.query(
+        '{ q(func: eq(name, "ann")) { name age follows { name } } }')
+    assert out["q"][0]["name"] == "ann"
+    assert len(out["q"][0]["follows"]) == 2
+
+    idx = client.tracer.sink.index()
+    rec = client.tracer.sink.get(
+        next(r["trace_id"] for r in idx if r["root"] == "query"))
+    spans = rec["spans"]
+    # exactly one trace id across every span
+    assert {s["trace_id"] for s in spans} == {rec["trace_id"]}
+    root = _links_intact(spans)
+    assert root["name"] == "query" and root["proc"] == "client"
+
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    # client-side fan-out spans hit BOTH workers
+    rpc_addrs = {s["attrs"]["addr"] for s in by_name["rpc:ServeTask"]}
+    assert set(addrs) <= rpc_addrs
+    # each worker's server span arrived over trailing metadata, with its
+    # proc naming the worker
+    worker_procs = {s["proc"] for s in by_name["serve_task"]}
+    assert len(worker_procs) == 2
+    # Zero coordinator calls are part of the same trace
+    assert any(n.startswith("zero:") for n in by_name), by_name.keys()
+    assert any(s["proc"] == "zero" for s in spans)
+    # at least one device-kernel span with transfer bytes, under a worker
+    kernels = by_name.get("device_kernel", [])
+    assert kernels, f"no device span; names={sorted(by_name)}"
+    assert any(k["attrs"].get("transfer_d2h_bytes", 0) > 0 for k in kernels)
+    assert all(k["proc"].startswith("worker:") for k in kernels)
+    # no span buffers left behind anywhere
+    assert client.tracer.active_traces() == 0
+
+
+def test_failed_fanout_leaks_no_spans(wire_cluster):
+    client, _addrs, (w0, w1) = wire_cluster
+    client.query('{ q(func: eq(name, "ann")) { name follows { name } } }')
+    w1.stop(0)            # group 1 (follows/age) dies mid-cluster
+    client.task_cache.clear()   # don't let cached tasks mask the dead group
+    with pytest.raises(Exception):
+        client.query(
+            '{ q(func: eq(name, "bob")) { name follows { name } } }')
+    # the root span finished with the error and the trace assembled —
+    # nothing lingers in the per-trace buffers
+    assert client.tracer.active_traces() == 0
+    failed = [r for r in client.tracer.sink.index() if r["error"]]
+    assert failed, "failed query should still produce an assembled trace"
+
+
+def test_deterministic_sampling_with_injected_rng():
+    class FlipFlop:
+        def __init__(self):
+            self.i = 0
+
+        def random(self):
+            self.i += 1
+            return 0.0 if self.i % 2 else 0.99
+
+        def getrandbits(self, n):
+            return random.getrandbits(n)
+
+    tr = otrace.Tracer(fraction=0.5, rng=FlipFlop())
+    kinds = [bool(tr.root("q")) for _ in range(6)]
+    assert kinds == [True, False, True, False, True, False]
+    # finish the sampled roots so nothing leaks
+    # (roots 0/2/4 were real spans)
+
+
+def test_join_take_roundtrip_and_remote_merge():
+    a = otrace.Tracer(fraction=1.0, proc="caller", rng=random.Random(1))
+    b = otrace.Tracer(proc="callee", rng=random.Random(2))
+    with a.root("query") as root:
+        wire = otrace.wire_context()
+        assert wire and wire.startswith(root.trace_id)
+        with b.join(wire, "serve_task") as srv:
+            with b.start("device", parent=srv):
+                pass
+        shipped = b.take(root.trace_id)
+        assert len(shipped) == 2 and b.active_traces() == 0
+        a.add_remote(shipped)
+    rec = a.sink.get(root.trace_id)
+    assert rec["nspans"] == 3
+    tree = otrace.span_tree(rec)
+    q = tree["tree"][0]
+    assert q["name"] == "query"
+    assert q["children"][0]["name"] == "serve_task"
+    assert q["children"][0]["children"][0]["name"] == "device"
+
+
+# ---------------------------------------------------------------------------
+# embedded node: HTTP surface + Chrome JSON + Prometheus + slow log
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def http_node():
+    node = Node(span_sample=1.0, trace_rng=random.Random(3),
+                slow_query_ms=0.0001)   # everything is "slow": log fills
+    node.alter(schema_text=SCHEMA)
+    node.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                           '_:a <follows> _:b .', commit_now=True)
+    srv = make_server(node, "127.0.0.1", 0)
+    import threading
+
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield node, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    node.close()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return r.status, r.read()
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(base + path, data=body.encode(),
+                                 method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_chrome_trace_export_loads_structurally(http_node):
+    node, base = http_node
+    _post(base, "/query", '{ q(func: eq(name, "ann")) { name follows '
+                          '{ name } } }')
+    st, body = _get(base, "/debug/traces")
+    assert st == 200
+    idx = json.loads(body)
+    tid = next(r["trace_id"] for r in idx if r["root"] == "query")
+    st, body = _get(base, f"/debug/traces/{tid}")
+    assert st == 200
+    ct = json.loads(body)
+    # the Perfetto/chrome://tracing JSON object-format contract
+    assert isinstance(ct["traceEvents"], list) and ct["traceEvents"]
+    assert ct["otherData"]["trace_id"] == tid
+    phases = {e["ph"] for e in ct["traceEvents"]}
+    assert "X" in phases and "M" in phases
+    for e in ct["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] > 0
+    # thread names label the processes
+    names = [e["args"]["name"] for e in ct["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert "node" in names
+    # tree view renders too
+    st, body = _get(base, f"/debug/traces/{tid}?view=tree")
+    tree = json.loads(body)
+    assert tree["tree"][0]["name"] == "query"
+    # unknown id 404s
+    with pytest.raises(urllib.error.HTTPError):
+        _get(base, "/debug/traces/ffffffffffffffff")
+
+
+def test_prometheus_exposition_parses(http_node):
+    node, base = http_node
+    _post(base, "/query", '{ q(func: has(name)) { name } }')
+    st, body = _get(base, "/metrics")
+    assert st == 200
+    series = prom.parse(body.decode())      # raises on malformed output
+    assert series["dgraph_num_queries_total"][0][1] >= 1
+    # histogram summary shape: quantile labels + _sum/_count
+    assert any(lbl.get("quantile") == "0.50"
+               for lbl, _ in series.get("dgraph_query_latency_s", []))
+    assert "dgraph_query_latency_s_count" in series
+    # meters render as labeled endpoint gauges
+    assert any(lbl.get("endpoint") == "query"
+               for lbl, _ in series.get("dgraph_endpoint_qps", []))
+
+
+def test_slow_query_log_captures_plan_and_tree(http_node):
+    node, base = http_node
+    _post(base, "/query", '{ q(func: eq(name, "ann")) { name follows '
+                          '{ name } } }')
+    st, body = _get(base, "/debug/slow")
+    entries = json.loads(body)
+    assert entries, "threshold 0.1us should log every query"
+    e = next(x for x in entries if x["root"] == "query")
+    assert e["trace_id"] and e["elapsed_ms"] > 0
+    assert e["query"].startswith("{ q(func:")
+    assert e["plan"] is not None and "root_swaps" in e["plan"]
+    names = set()
+
+    def walk(nodes):
+        for n in nodes:
+            names.add(n["name"])
+            walk(n.get("children", ()))
+
+    walk(e["tree"])
+    assert "query" in names and any(n.startswith("task:") for n in names)
+
+
+def test_slow_query_log_jsonl_file(tmp_path):
+    path = tmp_path / "slow.jsonl"
+    node = Node(span_sample=1.0, trace_rng=random.Random(5),
+                slow_query_ms=0.0001, slow_query_log=str(path))
+    node.alter(schema_text=SCHEMA)
+    node.mutate(set_nquads='_:a <name> "ann" .', commit_now=True)
+    node.query('{ q(func: eq(name, "ann")) { name } }')
+    node.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert any(e["root"] == "query" for e in lines)
+
+
+def test_debug_index_names_new_endpoints(http_node):
+    _node, base = http_node
+    st, body = _get(base, "/debug")
+    eps = json.loads(body)["endpoints"]
+    for p in ("/debug/traces", "/debug/slow", "/metrics"):
+        assert p in eps
+
+
+def test_unsampled_query_costs_no_trace():
+    # no slow log armed (an armed slow log force-samples every root)
+    node = Node(span_sample=0.0)
+    node.alter(schema_text=SCHEMA)
+    node.mutate(set_nquads='_:a <name> "ann" .', commit_now=True)
+    before = len(node.tracer.sink)
+    node.query('{ q(func: has(name)) { name } }')
+    assert len(node.tracer.sink) == before
+    assert node.tracer.active_traces() == 0
+    node.close()
+
+
+def test_slow_log_fires_even_when_span_sampling_is_off():
+    """An armed slow-query log force-samples roots: the threshold must be
+    honored even at the production 1% (here 0%) span_sample default."""
+    node = Node(span_sample=0.0, slow_query_ms=0.0001)
+    node.alter(schema_text=SCHEMA)
+    node.mutate(set_nquads='_:a <name> "ann" .', commit_now=True)
+    node.query('{ q(func: eq(name, "ann")) { name } }')
+    assert any(e["root"] == "query" for e in node.slow_log.recent())
+    node.close()
+
+
+def test_prom_level_shaped_totals_render_as_gauges():
+    """pending/active '_total' names are inc/dec levels — a counter TYPE
+    would make Prometheus read every decrease as a reset."""
+    from dgraph_tpu.utils import metrics as metrics_mod
+
+    text = prom.render(metrics_mod.Registry())
+    assert "# TYPE dgraph_pending_queries_total gauge" in text
+    assert "# TYPE dgraph_active_mutations_total gauge" in text
+    assert "# TYPE dgraph_num_queries_total counter" in text
