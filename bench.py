@@ -35,10 +35,13 @@ loops; the reference's own inner loops are scalar Go over bp128 blocks).
     off, outputs asserted byte-identical.
   * `trace` — the observability round: warm mixed-replay QPS at span
     sampling 0% / 1% / 100% (obs/otrace.py), gated <2% regression at 1%.
+  * `ingest` — the out-of-core round: bulk-load edges/s in-RAM vs the
+    spill tier (byte-identical output asserted) and the streaming
+    checkpoint's peak transient (spool-bounded, independent of keys).
 
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "band", "query_path", "query_configs", "throughput", "freshness",
-"planner", "trace"}.
+"planner", "trace", "ingest"}.
 """
 
 import json
@@ -445,6 +448,71 @@ def bench_planner(n_people=20000, follows=12, iters=5):
     return out
 
 
+def bench_ingest(scale=16, ef=16):
+    """Out-of-core ingest battery (round 10): bulk-load an R-MAT graph
+    in-RAM and again with the spill tier (sorted runs + streaming k-way
+    merge reduce, ingest/spill.py), assert the snapshots byte-identical,
+    and stream-checkpoint the paged output. Reports edges/s both ways and
+    the checkpoint's peak transient (spool-bounded, independent of keys)."""
+    import hashlib
+    import os
+    import shutil
+    import tempfile
+
+    from dgraph_tpu.loader.bulk import bulk_load
+    from dgraph_tpu.models.rmat import rmat_csr
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils import log as _log
+
+    subjects, indptr, indices = rmat_csr(scale, ef, seed=9)
+    tmp = tempfile.mkdtemp(prefix="dgt-ingest-")
+    rdf = os.path.join(tmp, "g.rdf")
+    src = np.repeat(subjects, np.diff(indptr))
+    with open(rdf, "w") as f:
+        for s, d in zip(src.tolist(), indices.tolist()):
+            f.write(f"<0x{s + 1:x}> <follows> <0x{d + 1:x}> .\n")
+        for s in subjects.tolist():
+            f.write(f'<0x{s + 1:x}> <score> "{s % 1000}"^^<xs:int> .\n')
+    schema = "follows: [uid] .\nscore: int @index(int) .\n"
+    nq = len(indices) + len(subjects)
+
+    def sha(d):
+        with open(os.path.join(tmp, d, "snapshot.bin"), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+
+    # the spill tier logs map/reduce milestones through utils/log, which
+    # writes to stdout by default — bench.py's contract is exactly ONE
+    # JSON line on stdout, so route them to stderr for this section
+    _log.configure(stream=sys.stderr)
+    try:
+        t0 = time.perf_counter()
+        bulk_load(rdf, schema, os.path.join(tmp, "inram"))
+        t_in = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        st = bulk_load(rdf, schema, os.path.join(tmp, "spill"), spill_mb=32,
+                       xidmap_cache=1 << 20)
+        t_sp = time.perf_counter() - t0
+        identical = sha("inram") == sha("spill")
+
+        s = Store(os.path.join(tmp, "spill"), memory_budget=64 << 20)
+        t0 = time.perf_counter()
+        s.checkpoint(s.snapshot_ts)
+        t_ck = time.perf_counter() - t0
+        peak = s.last_checkpoint_stats["peak_transient_bytes"]
+        rows = s.last_checkpoint_stats["rows"]
+        s.close()
+    finally:
+        _log.configure(stream=None)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"quads": nq, "identical": identical,
+            "inram_quads_s": round(nq / t_in),
+            "spill_quads_s": round(nq / t_sp),
+            "spill_runs": st.spill_runs, "merge_fanin": st.merge_fanin,
+            "spill_mb_written": round(st.spill_bytes / (1 << 20), 1),
+            "checkpoint_s": round(t_ck, 2), "checkpoint_rows": rows,
+            "checkpoint_peak_transient_mb": round(peak / (1 << 20), 2)}
+
+
 def bench_trace(n_people=8000, follows=8, workers=4, reps=4, batches=3):
     """Tracing-overhead battery (the observability round): the warm mixed
     replay of bench_throughput run at span sampling 0%, 1%, and 100%.
@@ -616,6 +684,10 @@ def main():
         trace = bench_trace()
     except Exception as e:  # tracing battery must not sink it either
         trace = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        ingest = bench_ingest()
+    except Exception as e:  # ingest battery must not sink it either
+        ingest = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -630,6 +702,7 @@ def main():
         "freshness": freshness,
         "planner": planner,
         "trace": trace,
+        "ingest": ingest,
     }))
 
 

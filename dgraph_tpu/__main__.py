@@ -84,12 +84,28 @@ def cmd_bulk(args) -> int:
         with open(args.schema) as f:
             schema = f.read()
     stats = bulk_load(args.files, schema, args.out, workers=args.workers,
+                      spill_mb=args.spill_mb or None,
+                      xidmap_cache=_xidmap_entries(args.xidmap_cache_mb),
                       progress=lambda n: lg.info("parsing", quads=n))
-    lg.info("bulk load done", postings=stats.edges,
-            uid_edges=stats.uid_edges, values=stats.values,
-            nodes=stats.nodes, predicates=stats.predicates,
-            seconds=round(stats.seconds, 1), out=args.out)
+    fields = dict(postings=stats.edges, uid_edges=stats.uid_edges,
+                  values=stats.values, nodes=stats.nodes,
+                  predicates=stats.predicates,
+                  seconds=round(stats.seconds, 1), out=args.out)
+    if args.spill_mb:
+        fields.update(spill_runs=stats.spill_runs,
+                      spill_mb=round(stats.spill_bytes / (1 << 20), 1),
+                      merge_fanin=stats.merge_fanin,
+                      xidmap_hit_rate=round(stats.xidmap_hit_rate, 4))
+    lg.info("bulk load done", **fields)
     return 0
+
+
+def _xidmap_entries(cache_mb) -> int | None:
+    """--xidmap_cache_mb → resident-entry bound (~96B per mapping: short
+    key string + dict slot + uid)."""
+    if not cache_mb:
+        return None
+    return max(1, int(cache_mb * (1 << 20)) // 96)
 
 
 def cmd_export(args) -> int:
@@ -116,6 +132,7 @@ def cmd_live(args) -> int:
     try:
         stats = live_load(node, args.files, batch=args.batch,
                           xidmap_path=args.xidmap,
+                          xidmap_cache=_xidmap_entries(args.xidmap_cache_mb),
                           progress=lambda n: lg.info("loading", quads=n))
     finally:
         node.close()
@@ -351,6 +368,14 @@ def build_parser() -> argparse.ArgumentParser:
     bp.add_argument("-s", "--schema", default=None)
     bp.add_argument("-o", "--out", required=True, help="output posting dir")
     bp.add_argument("-j", "--workers", type=int, default=None)
+    bp.add_argument("--spill_mb", type=float, default=0,
+                    help="out-of-core map buffer budget in MB: mapped edges "
+                         "spill as sorted runs and the reduce streams a "
+                         "k-way merge — peak RAM stops scaling with graph "
+                         "size, output byte-identical (0 = all in RAM)")
+    bp.add_argument("--xidmap_cache_mb", type=float, default=0,
+                    help="resident bound for the sharded xid→uid map; "
+                         "cold shards page to disk (0 = unbounded)")
     bp.set_defaults(fn=cmd_bulk)
 
     ep = sub.add_parser("export", help="export a posting dir to RDF(.gz)")
@@ -369,6 +394,10 @@ def build_parser() -> argparse.ArgumentParser:
     lp.add_argument("--xidmap", default=None,
                     help="crash-resumable identity log: re-running an "
                          "interrupted load reuses already-assigned uids")
+    lp.add_argument("--xidmap_cache_mb", type=float, default=0,
+                    help="resident bound for the sharded xid→uid map "
+                         "(needs --xidmap; cold shards page to "
+                         "<xidmap>.shards/; 0 = unbounded)")
     lp.set_defaults(fn=cmd_live)
 
     wp = sub.add_parser("worker", help="serve one group's tablets over the "

@@ -97,6 +97,9 @@ class Node:
                            if memory_mb else None)
         self.zero = Zero(n_groups)
         self.metrics = metrics.Registry()
+        # checkpoint/ingest gauges (peak transient bytes etc.) land in this
+        # node's registry — they show on /metrics next to the query tiers
+        self.store.metrics = self.metrics
         self.traces = metrics.TraceStore(fraction=trace_fraction,
                                          rng=trace_rng)
         # span tracing + device profiling (obs/otrace.py): root spans start

@@ -1,0 +1,17 @@
+"""External-memory ingest primitives shared by bulk, live, and checkpoint.
+
+Reference semantics: dgraph/cmd/bulk — the map stage spills sorted runs to
+tmp files sharded by predicate (mapper.go:121-175), the shuffle/reduce
+k-way-merges them into packed posting lists written straight to badger SSTs
+(merge_shards.go:30, reduce.go:36-53). Here the same spill/merge/stream
+shape feeds the repo's own columnar snapshot format:
+
+  spill.py      bounded in-RAM buffers -> sorted per-channel run files
+                (uid pairs ride the storage/packed.py block codec; typed
+                values/facets/tokens ride framed byte-keyed records) plus
+                streaming k-way merge iterators over the runs.
+  snapwrite.py  streaming tablet-sectioned snapshot writer (DGTS3): rows
+                stream in, columns spool to bounded buffers, peak transient
+                memory is independent of total key count. Shared by
+                Store.checkpoint and the bulk loader's spill reduce.
+"""

@@ -13,13 +13,27 @@ token/reverse/count indexes built directly from numpy-grouped edge arrays —
 then one `Store.checkpoint` makes the snapshot durable. A `Node` opened on
 the output dir serves queries immediately (uid lease + ts recovery are the
 normal restart path, api/server.py Node.__init__).
+
+Two reduce tiers share one map stage and one snapshot writer:
+
+  - in-RAM (default): all parsed columns group in dicts, one vectorized
+    pack, `bulk_install` + `Store.checkpoint` — fastest when the dataset
+    fits in host memory.
+  - OUT-OF-CORE (`spill_mb`): mapped edges spill as sorted per-predicate
+    runs (ingest/spill.py, the reference's mapper.go:121-175 shape), a
+    streaming k-way merge feeds the reduce, and packed rows stream
+    straight into DGTS3 tablet sections (ingest/snapwrite.py) — peak RAM
+    is the spill budget + merge buffers, independent of graph size, and
+    the output is BYTE-IDENTICAL to the in-RAM path.
 """
 
 from __future__ import annotations
 
 import gzip
+import json
 import os
 import pickle
+import shutil
 import time
 from concurrent.futures import ProcessPoolExecutor
 from dataclasses import dataclass
@@ -27,13 +41,16 @@ from dataclasses import dataclass
 import numpy as np
 
 from dgraph_tpu.coord.zero import UidLease
+from dgraph_tpu.ingest import spill as _spill
+from dgraph_tpu.ingest.snapwrite import SnapshotWriter
 from dgraph_tpu.loader.xidmap import XidMap
 from dgraph_tpu.storage import keys as K
 from dgraph_tpu.storage import native, packed
 from dgraph_tpu.storage.index import index_tokens
 from dgraph_tpu.storage.postings import (Op, Posting, PostingList, lang_uid,
                                          value_fingerprint)
-from dgraph_tpu.storage.store import Store
+from dgraph_tpu.storage.store import Store, posting_to_json
+from dgraph_tpu.utils import log
 from dgraph_tpu.utils.schema import parse_schema
 from dgraph_tpu.utils.types import TypeID, Val, convert
 
@@ -51,6 +68,12 @@ class BulkStats:
     predicates: int = 0
     xids: int = 0             # mapped external ids
     seconds: float = 0.0
+    # out-of-core tier (spill_mb): ingest observability satellite
+    spill_bytes: int = 0      # bytes written to sorted run files
+    spill_runs: int = 0       # run files written
+    merge_fanin: int = 0      # max runs k-way-merged for one channel
+    buffered_peak: int = 0    # max in-RAM map-buffer estimate
+    xidmap_hit_rate: float = 1.0
 
 
 CHUNK_LINES = 65536
@@ -188,8 +211,16 @@ def _group_rows(subs: np.ndarray, objs: np.ndarray):
 
 def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
               workers: int | None = None, commit_ts: int = 1,
-              progress=None) -> BulkStats:
-    """Load RDF file(s) into a fresh posting snapshot at out_dir."""
+              progress=None, spill_mb: float | None = None,
+              xidmap_cache: int | None = None, metrics=None) -> BulkStats:
+    """Load RDF file(s) into a fresh posting snapshot at out_dir.
+
+    spill_mb: in-RAM map-buffer budget in MB — when set, the out-of-core
+    tier runs (sorted spill runs + streaming merge/reduce; byte-identical
+    output, bounded RSS). xidmap_cache: resident xid→uid entry bound for
+    the sharded identity map (None = unbounded). metrics: optional
+    utils/metrics.Registry — in-process (embedded-node) loads feed the
+    dgraph_ingest_*/dgraph_xidmap_* counters so they show on /metrics."""
     t0 = time.perf_counter()
     paths = [rdf_paths] if isinstance(rdf_paths, str) else list(rdf_paths)
     for p in paths:
@@ -200,9 +231,18 @@ def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
         store.close()
         raise BulkError(f"{out_dir} already contains a posting store")
     workers = workers if workers is not None else min(8, os.cpu_count() or 1)
+    if spill_mb:
+        if not out_dir:
+            store.close()
+            raise BulkError("spill_mb needs a durable out_dir for run files")
+        return _bulk_load_spill(paths, schema_text, out_dir, store, workers,
+                                commit_ts, progress,
+                                int(spill_mb * (1 << 20)), xidmap_cache, t0,
+                                metrics)
 
     lease = UidLease()
-    xm = XidMap(lease)
+    xm = XidMap(lease, dirpath=os.path.join(out_dir, "xidmap")
+                if out_dir else None, cache_entries=xidmap_cache)
     stats = BulkStats()
 
     # -- map + shuffle: group parsed quads by predicate ----------------------
@@ -328,8 +368,299 @@ def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
         stats.xids = len(xm)
         stats.edges = stats.uid_edges + stats.values
     store.checkpoint(commit_ts)
-    if out_dir:
-        xm.save(os.path.join(out_dir, "xidmap.json"))
+    xm.close()     # sharded identity map lands next to the snapshot
+    store.close()
+    stats.xidmap_hit_rate = xm.stats.hit_rate
+    stats.seconds = time.perf_counter() - t0
+    _ingest_metrics(metrics, stats, xm)
+    return stats
+
+
+def _ingest_metrics(reg, stats: BulkStats, xm: XidMap) -> None:
+    """Feed an embedded node's registry (satellite: ingest counters on
+    /metrics). The offline CLI has no registry — there the same numbers
+    ride BulkStats and the structured 'bulk load done' log event."""
+    if reg is None:
+        return
+    reg.counter("dgraph_ingest_spill_bytes_total").inc(stats.spill_bytes)
+    reg.counter("dgraph_ingest_spill_runs_total").inc(stats.spill_runs)
+    if stats.merge_fanin:
+        reg.counter("dgraph_ingest_merge_fanin").set(stats.merge_fanin)
+    reg.counter("dgraph_xidmap_lookups_total").inc(xm.stats.lookups)
+    reg.counter("dgraph_xidmap_shard_loads_total").inc(xm.stats.shard_loads)
+    reg.counter("dgraph_xidmap_evictions_total").inc(xm.stats.evictions)
+
+
+# -- out-of-core tier ---------------------------------------------------------
+
+_ROW_BATCH = 4096          # rows per pack_many call in the streaming reduce
+
+
+class _SectionBatch:
+    """Stream rows into one tablet section, packing in bounded batches —
+    pack()/pack_many() are per-row independent, so any batching yields the
+    byte-identical columns the in-RAM path's single global pack produces."""
+
+    __slots__ = ("sec", "ts", "keys", "rows", "posts")
+
+    def __init__(self, sec, base_ts: int) -> None:
+        self.sec = sec
+        self.ts = base_ts
+        self.keys: list[bytes] = []
+        self.rows: list[np.ndarray] = []
+        self.posts: list[bytes] = []
+
+    def add(self, kb: bytes, row: np.ndarray, post: bytes = b"") -> None:
+        self.keys.append(kb)
+        self.rows.append(row)
+        self.posts.append(post)
+        if len(self.keys) >= _ROW_BATCH:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self.keys:
+            return
+        for kb, pu, post in zip(self.keys, native.pack_many(self.rows),
+                                self.posts):
+            self.sec.add_row(kb, self.ts, pu, post)
+        self.keys.clear()
+        self.rows.clear()
+        self.posts.clear()
+
+
+def _post_json(postings: dict[int, Posting] | None) -> bytes:
+    """Same serialization Store's checkpoint uses for base_postings — the
+    byte-identity contract between the two reduce tiers."""
+    if not postings:
+        return b""
+    return json.dumps([posting_to_json(p) for p in postings.values()]).encode()
+
+
+def _bulk_load_spill(paths: list[str], schema_text: str, out_dir: str,
+                     store: Store, workers: int, commit_ts: int, progress,
+                     spill_bytes: int, xidmap_cache: int | None,
+                     t0: float, metrics=None) -> BulkStats:
+    """External-memory bulk load (reference cmd/bulk shape): map spills
+    sorted per-predicate runs, the reduce k-way-merges them and streams
+    packed rows straight into DGTS3 tablet sections. RAM is bounded by
+    the spill budget + merge chunk buffers + the xidmap cache — never by
+    graph size."""
+    try:
+        return _bulk_load_spill_inner(
+            paths, schema_text, out_dir, store, workers, commit_ts,
+            progress, spill_bytes, xidmap_cache, t0, metrics)
+    except BaseException:
+        # embedded callers live on past a BulkError: release the store's
+        # WAL fd and reap the graph-sized run files + half-written snapshot
+        store.close()
+        shutil.rmtree(os.path.join(out_dir, ".spill"), ignore_errors=True)
+        try:
+            os.unlink(os.path.join(out_dir, "snapshot.bin.tmp"))
+        except OSError:
+            pass
+        raise
+
+
+def _bulk_load_spill_inner(paths: list[str], schema_text: str, out_dir: str,
+                           store: Store, workers: int, commit_ts: int,
+                           progress, spill_bytes: int,
+                           xidmap_cache: int | None,
+                           t0: float, metrics=None) -> BulkStats:
+    lg = log.get_logger("bulk")
+    lease = UidLease()
+    xm = XidMap(lease, dirpath=os.path.join(out_dir, "xidmap"),
+                cache_entries=xidmap_cache)
+    stats = BulkStats()
+    tmp_dir = os.path.join(out_dir, ".spill")
+    sstats = _spill.SpillStats()
+    pool = _spill.SpillSet(tmp_dir, spill_bytes, sstats)
+    pool.on_flush = lambda st: lg.info(
+        "spill", runs=st.spill_runs, bytes=st.spill_bytes)
+    pairs = _spill.UidPairSpiller(pool)
+    frames = _spill.FramedSpiller(pool)
+    with store.suspend_wal():   # schema durability comes from snapshot meta
+        for e in parse_schema(schema_text or ""):
+            store.set_schema(e)
+
+    # -- map: parse + xid + spill into per-(kind, predicate) channels -------
+    uid_preds: set[str] = set()
+    val_preds: dict[str, TypeID] = {}   # pred -> first-seen value type
+    n = 0
+    xid = xm.uid
+    u64 = lambda u: u.to_bytes(8, "big")  # noqa: E731 — sort-key encoding
+    for subs_c, preds_c, objs_c, vals_c, langs_c, facets_c, stars_c in \
+            _map_stage(paths, workers):
+        for subj, pred, obj, val, lang, facets, star in \
+                zip(subs_c, preds_c, objs_c, vals_c, langs_c, facets_c,
+                    stars_c):
+            if star or pred == "*":
+                raise BulkError("deletes are not valid in a bulk load")
+            s = xid(subj)
+            if obj:
+                if pred in val_preds:
+                    raise BulkError(
+                        f"predicate <{pred}> carries both uid edges and "
+                        f"literal values in the input — pick one "
+                        f"representation")
+                uid_preds.add(pred)
+                o = xid(obj)
+                pairs.add(("d", pred), s, o)
+                entry = store.schema.get(pred)
+                if entry is not None and entry.reverse:
+                    pairs.add(("r", pred), o, s)
+                if facets:
+                    frames.add(("f", pred), u64(s) + u64(o),
+                               pickle.dumps(facets,
+                                            pickle.HIGHEST_PROTOCOL))
+            else:
+                if pred in uid_preds:
+                    raise BulkError(
+                        f"predicate <{pred}> carries both uid edges and "
+                        f"literal values in the input — pick one "
+                        f"representation")
+                if pred not in val_preds:
+                    val_preds[pred] = val.tid
+                frames.add(("v", pred), u64(s),
+                           pickle.dumps((lang, val, facets or ()),
+                                        pickle.HIGHEST_PROTOCOL))
+        n += len(subs_c)
+        if progress and n % 500000 < len(subs_c):
+            progress(n)
+    pool.flush()
+    lg.info("map done", quads=n, spill_runs=sstats.spill_runs,
+            spill_mb=round(sstats.spill_bytes / (1 << 20), 1))
+
+    # -- reduce: merge runs, stream packed rows into tablet sections --------
+    subj_ch = ("s", "")              # distinct-subject accounting channel
+    snap_tmp = os.path.join(out_dir, "snapshot.bin.tmp")
+    with open(snap_tmp, "wb") as f:
+        w = SnapshotWriter(f, commit_ts, spool_max=store.SNAP_SPOOL_MAX)
+
+        for attr in sorted(uid_preds):
+            entry = store.schema.ensure(attr, TypeID.UID)
+            batch = _SectionBatch(
+                w.section(int(K.KeyKind.DATA), attr), commit_ts)
+            facet_it = iter(_spill.merge_framed(frames.runs(("f", attr)),
+                                                sstats))
+            fpend = next(facet_it, None)
+
+            def facets_for(s: int):
+                nonlocal fpend
+                out = {}
+                skey = u64(s)
+                while fpend is not None and fpend[0][:8] <= skey:
+                    if fpend[0][:8] == skey:
+                        out[int.from_bytes(fpend[0][8:], "big")] = \
+                            pickle.loads(fpend[2])   # last occurrence wins
+                    fpend = next(facet_it, None)
+                return out
+
+            for s, row in _spill.merge_pairs(pairs.runs(("d", attr)),
+                                             sstats):
+                fmap = facets_for(s)
+                postings = {int(o): Posting(int(o), Op.SET,
+                                            facets=fmap[int(o)])
+                            for o in row.tolist()
+                            if int(o) in fmap} if fmap else None
+                batch.add(K.data_key(attr, s).encode(), row,
+                          _post_json(postings))
+                stats.uid_edges += len(row)
+                pairs.add(subj_ch, s, 0)
+                if entry.count:
+                    pairs.add(("c", attr), len(row), s)
+            batch.flush()
+            pairs.discard(("d", attr))
+            frames.discard(("f", attr))
+            if entry.reverse:
+                rbatch = _SectionBatch(
+                    w.section(int(K.KeyKind.REVERSE), attr), commit_ts)
+                for o, srcs in _spill.merge_pairs(pairs.runs(("r", attr)),
+                                                  sstats):
+                    rbatch.add(K.reverse_key(attr, o).encode(), srcs)
+                rbatch.flush()
+                pairs.discard(("r", attr))
+            if entry.count:
+                pool.flush()
+                cbatch = _SectionBatch(
+                    w.section(int(K.KeyKind.COUNT), attr), commit_ts)
+                for d, ss in _spill.merge_pairs(pairs.runs(("c", attr)),
+                                                sstats):
+                    cbatch.add(K.count_key(attr, d).encode(), ss)
+                cbatch.flush()
+                pairs.discard(("c", attr))
+
+        for attr in sorted(val_preds):
+            entry = store.schema.ensure(attr, val_preds[attr])
+            batch = _SectionBatch(
+                w.section(int(K.KeyKind.DATA), attr), commit_ts)
+            tok_ch = ("t", attr)
+            saw_tokens = False
+            for key, payloads in _spill.group_framed(
+                    _spill.merge_framed(frames.runs(("v", attr)), sstats)):
+                s = int.from_bytes(key, "big")
+                slots, postings = [], {}
+                for pb in payloads:
+                    lang, v, fa = pickle.loads(pb)
+                    if entry.type_id not in (TypeID.DEFAULT, v.tid):
+                        try:
+                            v = convert(v, entry.type_id)
+                        except ValueError as e:
+                            raise BulkError(
+                                f"predicate <{attr}>, subject 0x{s:x}: "
+                                f"{e}") from e
+                    slot = value_fingerprint(v) if entry.is_list \
+                        else lang_uid(lang)
+                    slots.append(slot)
+                    postings[slot] = Posting(slot, Op.SET, v, lang, fa)
+                    if entry.indexed:
+                        for tk in index_tokens(entry, v, lang):
+                            frames.add(tok_ch, tk, u64(s))
+                            saw_tokens = True
+                    stats.values += 1
+                batch.add(K.data_key(attr, s).encode(),
+                          np.unique(np.asarray(slots, dtype=np.uint64)),
+                          _post_json(postings))
+                pairs.add(subj_ch, s, 0)
+            batch.flush()
+            frames.discard(("v", attr))
+            if saw_tokens:
+                pool.flush()
+                ibatch = _SectionBatch(
+                    w.section(int(K.KeyKind.INDEX), attr), commit_ts)
+                for tk, subs in _spill.group_framed(
+                        _spill.merge_framed(frames.runs(tok_ch), sstats)):
+                    ss = np.unique(np.frombuffer(
+                        b"".join(subs), dtype=">u8").astype(np.int64))
+                    ibatch.add(K.index_key(attr, tk).encode(), ss)
+                ibatch.flush()
+                frames.discard(tok_ch)
+
+        # distinct subjects across every DATA tablet (stats.nodes), via the
+        # same merge machinery — no resident subject set
+        pool.flush()
+        stats.nodes = sum(1 for _ in _spill.merge_pairs(
+            pairs.runs(subj_ch), sstats))
+        pairs.discard(subj_ch)
+
+        w.finish({"schema": store.schema.to_text(),
+                  "max_commit_ts": commit_ts})
+    os.replace(snap_tmp, os.path.join(out_dir, "snapshot.bin"))
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    stats.predicates = len(uid_preds) + len(val_preds)
+    stats.xids = len(xm)
+    stats.edges = stats.uid_edges + stats.values
+    stats.spill_bytes = sstats.spill_bytes
+    stats.spill_runs = sstats.spill_runs
+    stats.merge_fanin = sstats.merge_fanin
+    stats.buffered_peak = sstats.buffered_peak
+    stats.xidmap_hit_rate = xm.stats.hit_rate
+    xm.close()
     store.close()
     stats.seconds = time.perf_counter() - t0
+    _ingest_metrics(metrics, stats, xm)
+    lg.info("reduce done", rows=w.rows,
+            peak_transient_mb=round(w.peak_transient / (1 << 20), 1),
+            merge_fanin=stats.merge_fanin,
+            xidmap_hit_rate=round(stats.xidmap_hit_rate, 4))
     return stats

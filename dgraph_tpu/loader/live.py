@@ -29,6 +29,7 @@ class LiveStats:
 def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
               retries: int = 3, workers: int = 1,
               xm: XidMap | None = None, xidmap_path: str | None = None,
+              xidmap_cache: int | None = None,
               progress=None) -> LiveStats:
     """Stream RDF file(s) into a node as committed transactions.
 
@@ -36,13 +37,25 @@ def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
     badger-persisted map, in append-log form) — assignments are fsynced
     BEFORE each txn commits, so a re-run of an interrupted load reuses
     every identity it had already assigned instead of minting duplicates.
+
+    xidmap_cache: resident-entry bound for the sharded identity map
+    (requires xidmap_path; shards page to <xidmap_path>.shards/): external
+    id cardinality is no longer capped by host RAM — the reference's
+    badger-backed sharded LRU, xidmap/xidmap.go:30-80.
     """
     paths = [rdf_paths] if isinstance(rdf_paths, str) else list(rdf_paths)
     own_xm = xm is None
     if own_xm:
-        xm = (XidMap.open(xidmap_path, node.zero.uids) if xidmap_path
+        if xidmap_cache is not None and not xidmap_path:
+            raise ValueError("xidmap_cache needs xidmap_path (the shard "
+                             "dir lives next to the log)")
+        xm = (XidMap.open(xidmap_path, node.zero.uids,
+                          cache_entries=xidmap_cache) if xidmap_path
               else XidMap(node.zero.uids))
     stats = LiveStats()
+    # snapshot so a SHARED xm across resumed loads reports per-call deltas,
+    # not its cumulative lifetime totals again
+    stats0 = (xm.stats.lookups, xm.stats.shard_loads, xm.stats.evictions)
     pending: list = []
 
     def flush():
@@ -76,4 +89,12 @@ def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
     flush()
     if own_xm:
         xm.close()
+    reg = getattr(node, "metrics", None)
+    if reg is not None:    # xidmap LRU behavior shows on the node's /metrics
+        reg.counter("dgraph_xidmap_lookups_total").inc(
+            xm.stats.lookups - stats0[0])
+        reg.counter("dgraph_xidmap_shard_loads_total").inc(
+            xm.stats.shard_loads - stats0[1])
+        reg.counter("dgraph_xidmap_evictions_total").inc(
+            xm.stats.evictions - stats0[2])
     return stats

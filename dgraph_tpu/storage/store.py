@@ -89,7 +89,7 @@ def posting_from_json(d: dict) -> Posting:
 # VERSIONING: tags 0x01-0x03 denote EXACTLY this layout (u32 key lengths,
 # u16 lang/facet lengths). Any future layout change must claim NEW tag
 # bytes — the tag byte is the format version, like the snapshot header
-# (DGTS1/DGTS2 below).
+# (DGTS1/DGTS2/DGTS3 below; the writer emits DGTS3, all three still load).
 
 _REC_M, _REC_C, _REC_A = 0x01, 0x02, 0x03
 _Q = struct.Struct("<q")
@@ -556,17 +556,6 @@ class Store:
             return [seg.build_list(i) for i in range(seg.n)]
         return [self.lists.get(kb) for kb in kbs]
 
-    def iter_all_keys(self):
-        """Every key: segment-backed plus materialized/new — globally
-        sorted (checkpoint's stable write order)."""
-        extra = set(dict.keys(self.lists))
-        if not self._segments:
-            return sorted(extra)
-        seen = set()
-        for seg in self._segments.values():
-            seen.update(seg.iter_keys())
-        return sorted(seen | extra)
-
     def get_no_store(self, key: K.Key) -> PostingList | None:
         """Read-only peek (reference posting/lists.go GetNoStore :274)."""
         return self.lists.get(key.encode())
@@ -948,11 +937,22 @@ class Store:
     # -- snapshot / checkpoint ---------------------------------------------
 
     def checkpoint(self, upto_ts: int) -> None:
-        """Roll lists up to upto_ts, write a snapshot, truncate the WAL.
+        """Roll lists up to upto_ts, STREAM a snapshot tablet-by-tablet,
+        truncate the WAL.
+
+        The write is external-memory (ingest/snapwrite.py DGTS3): pristine
+        mmap'd tablets copy file-to-file with zero per-row work, touched
+        tablets merge resident lists over their segment rows, and rows of
+        purely-resident tablets stream one at a time — peak transient
+        memory is the writer's spool ceiling, independent of key count
+        (the v2 writer materialized a PostingList per row and held every
+        column in RAM, making a 100M-key checkpoint a memory event).
 
         Uncommitted txns and layers above upto_ts survive via the fresh WAL.
         (Reference: worker/draft.go snapshot at min pending-txn ts.)
         """
+        from dgraph_tpu.ingest.snapwrite import SnapshotWriter
+
         self._packed_tablets.clear()   # rollup replaces packed bases
         if self.dir is None:
             for pl in list(self.lists.values()):
@@ -963,7 +963,17 @@ class Store:
             self.snapshot_ts = max(self.snapshot_ts, upto_ts)
             snap_path = os.path.join(self.dir, "snapshot.bin.tmp")
             with open(snap_path, "wb") as f:
-                self._write_snapshot_v2(f, upto_ts)
+                w = SnapshotWriter(f, upto_ts, spool_max=self.SNAP_SPOOL_MAX)
+                self._write_sections(w, upto_ts)
+                w.finish({"schema": self.schema.to_text(),
+                          "max_commit_ts": self.max_seen_commit_ts})
+            self.last_checkpoint_stats = {
+                "rows": w.rows,
+                "peak_transient_bytes": w.peak_transient}
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "dgraph_checkpoint_peak_transient_bytes").set(
+                        w.peak_transient)
             os.replace(snap_path, os.path.join(self.dir, "snapshot.bin"))
             # reset WAL with still-relevant records (uncommitted + layers > upto_ts)
             if self._wal is not None:
@@ -996,65 +1006,74 @@ class Store:
             self._wal = open(wal_path, "ab")
             self.dirty.clear()
 
-    @staticmethod
-    def _cat(dt, arrs):
-        arrs = [np.asarray(a, dt) for a in arrs if len(a)]
-        return np.concatenate(arrs) if arrs else np.zeros(0, dt)
+    # spool ceiling per section column before the writer rolls to disk
+    # (class attr so tests can shrink it to prove bounded transients)
+    SNAP_SPOOL_MAX = 1 << 22
+    metrics = None                  # optional utils/metrics.Registry
+    last_checkpoint_stats: dict = {}
 
-    def _write_snapshot_v2(self, f, upto_ts: int) -> None:
-        """Columnar snapshot (DGTS2): every list's packed metadata rides in a
-        handful of big arrays, so load is a few frombuffer slices instead of
-        nine reads per list (1.2M numpy calls per million edges in the v1
-        row format — the cold-open bottleneck)."""
-        f.write(b"DGTS2")
-        f.write(struct.pack("<Q", upto_ts))
-        meta = {"schema": self.schema.to_text(),
-                "max_commit_ts": self.max_seen_commit_ts}
-        mb = json.dumps(meta).encode()
-        f.write(_U32.pack(len(mb)) + mb)
-        keys = self.iter_all_keys() if self.paged else sorted(self.lists)
-        pls = []
-        for kb in keys:
-            pl = dict.get(self.lists, kb)
-            if pl is None:         # paged: transient, not cached — a
-                pl = self._materialize(kb, cache=False)   # checkpoint must
-            had_fold = any(l.commit_ts <= upto_ts for l in pl.layers)
-            pl.rollup(upto_ts)     # not blow the memory budget
-            if not had_fold and hasattr(pl, "_seg_ts"):
-                # content unchanged (only the watermark moved): keep the
-                # list evictable, or the first checkpoint would pin every
-                # resident list for the life of the process
-                pl._seg_ts = pl.base_ts
-            pls.append(pl)
-        N = len(keys)
-        f.write(_U32.pack(N))
-        key_lens = np.fromiter((len(k) for k in keys), np.uint32, count=N)
-        posts = [b"" if not pl.base_postings else json.dumps(
+    def _write_sections(self, w, upto_ts: int) -> None:
+        """Feed the DGTS3 writer one tablet at a time.
+
+        Three shapes, cheapest first:
+          - pristine segment tablet (paged, untouched since load): attach
+            the mmap'd run wholesale — file-to-file column copy;
+          - touched segment tablet: two-pointer merge of the (sorted)
+            resident keys over the (sorted) segment rows; resident lists
+            shadow their row, untouched rows copy as metadata VIEWS —
+            no PostingList is ever built for them;
+          - memory-only tablet: stream the resident lists in key order.
+        """
+        self._lock.assert_held()
+        resident: dict[tuple[int, str], list[bytes]] = {}
+        for kb in dict.keys(self.lists):
+            resident.setdefault(K.kind_attr_of(kb), []).append(kb)
+        for t in set(resident) | set(self._segments):
+            seg = self._segments.get(t)
+            res = sorted(resident.get(t, ()))
+            if seg is not None and not res and t not in self._touched \
+                    and not self.by_pred.get(t):
+                w.add_run(t[0], t[1], seg)
+                continue
+            sec = w.section(t[0], t[1])
+            si, seg_n = 0, (seg.n if seg is not None else 0)
+            for kb in res:
+                while si < seg_n and seg.key_at(si) < kb:
+                    self._emit_segment_row(sec, seg, si)
+                    si += 1
+                if si < seg_n and seg.key_at(si) == kb:
+                    si += 1          # the resident copy shadows its row
+                self._emit_resident_row(sec, kb, upto_ts)
+            while si < seg_n:
+                self._emit_segment_row(sec, seg, si)
+                si += 1
+
+    @staticmethod
+    def _emit_segment_row(sec, seg: "SegmentRun", i: int) -> None:
+        """Copy one pristine row segment->section as column slices (the
+        packed list is a bundle of views into the mmap, never decoded)."""
+        b0, b1 = int(seg.bstarts[i]), int(seg.bstarts[i + 1])
+        w0, w1 = int(seg.wstarts[i]), int(seg.wstarts[i + 1])
+        p0, p1 = int(seg.pstarts[i]), int(seg.pstarts[i + 1])
+        pu = packed.PackedUidList(
+            int(seg.counts[i]), seg.bfirst[b0:b1], seg.blast[b0:b1],
+            seg.bcount[b0:b1], seg.bwidth[b0:b1], seg.boff[b0:b1],
+            seg.words[w0:w1])
+        sec.add_row(seg.key_at(i), int(seg.base_ts[i]), pu,
+                    bytes(seg.post_blob[p0:p1]))
+
+    def _emit_resident_row(self, sec, kb: bytes, upto_ts: int) -> None:
+        pl = dict.get(self.lists, kb)
+        had_fold = any(l.commit_ts <= upto_ts for l in pl.layers)
+        pl.rollup(upto_ts)
+        if not had_fold and hasattr(pl, "_seg_ts"):
+            # content unchanged (only the watermark moved): keep the
+            # list evictable, or the first checkpoint would pin every
+            # resident list for the life of the process
+            pl._seg_ts = pl.base_ts
+        post = b"" if not pl.base_postings else json.dumps(
             [posting_to_json(p) for p in pl.base_postings.values()]).encode()
-            for pl in pls]
-        post_lens = np.fromiter((len(p) for p in posts), np.uint32, count=N)
-        bps = [pl.base_packed for pl in pls]
-        cols = [
-            key_lens,
-            np.frombuffer(b"".join(keys), np.uint8),
-            np.fromiter((pl.base_ts for pl in pls), np.uint64, count=N),
-            np.fromiter((bp.count for bp in bps), np.uint32, count=N),
-            np.fromiter((bp.nblocks for bp in bps), np.uint32, count=N),
-            self._cat(np.uint64, [bp.block_first for bp in bps]),
-            self._cat(np.uint64, [bp.block_last for bp in bps]),
-            self._cat(np.int32, [bp.block_count for bp in bps]),
-            self._cat(np.int32, [bp.block_width for bp in bps]),
-            self._cat(np.int64, [bp.block_off for bp in bps]),
-            np.fromiter((len(bp.words) for bp in bps), np.uint64, count=N),
-            self._cat(np.uint32, [bp.words for bp in bps]),
-            post_lens,
-            np.frombuffer(b"".join(posts), np.uint8) if posts
-            else np.zeros(0, np.uint8),
-        ]
-        for arr in cols:
-            b = arr.tobytes()
-            f.write(struct.pack("<Q", len(b)))
-            f.write(b)
+        sec.add_row(kb, int(pl.base_ts), pl.base_packed, post)
 
     def _load(self) -> None:
         snap = os.path.join(self.dir, "snapshot.bin")
@@ -1063,14 +1082,19 @@ class Store:
                 # mmap: columns become file-backed views the OS pages in
                 # and out — the dataset no longer has to fit in RAM
                 raw = np.memmap(snap, dtype=np.uint8, mode="r")
-                if bytes(raw[:5]) == b"DGTS2":
+                magic = bytes(raw[:5])
+                if magic == b"DGTS3":
+                    self._load_v3(raw)
+                elif magic == b"DGTS2":
                     self._load_v2(raw)
                 else:
                     self._load_v1(bytes(raw))     # legacy format: eager
             else:
                 with open(snap, "rb") as f:
                     raw = f.read()
-                if raw[:5] == b"DGTS2":
+                if raw[:5] == b"DGTS3":
+                    self._load_v3(raw)
+                elif raw[:5] == b"DGTS2":
                     self._load_v2(raw)
                 else:
                     self._load_v1(raw)
@@ -1078,6 +1102,119 @@ class Store:
         # journal; the WAL tail replay below records everything above it
         self._delta_base_floor = self.snapshot_ts
         self._replay_wal(os.path.join(self.dir, "wal.log"))
+
+    def _load_v3(self, raw) -> None:
+        """Tablet-sectioned columnar snapshot (DGTS3, the checkpoint's
+        streaming write format — ingest/snapwrite.py). Sections arrive in
+        globally sorted key order, so each one IS a tablet run: no run
+        detection pass, the per-tablet structures build directly."""
+        off = 5
+        (self.snapshot_ts,) = struct.unpack_from("<Q", raw, off)
+        off += 8
+        (n,) = _U32.unpack_from(raw, off)
+        off += 4
+        meta = json.loads(bytes(raw[off: off + n]))
+        off += n
+        for e in parse_schema(meta.get("schema", "")):
+            self.schema.set(e)
+        self.max_seen_commit_ts = meta.get("max_commit_ts", 0)
+        paged = self.paged and isinstance(raw, np.memmap)
+        total = len(raw)
+        while off + 4 <= total:
+            off = self._load_v3_section(raw, off, paged)
+
+    def _load_v3_section(self, raw, off: int, paged: bool) -> int:
+        (N,) = _U32.unpack_from(raw, off)
+        off += 4
+
+        def col(dt):
+            nonlocal off
+            (blen,) = struct.unpack_from("<Q", raw, off)
+            off += 8
+            if paged:
+                # file-backed view (see _load_v2.col for the downcast note)
+                arr = raw[off: off + blen].view(dt).view(np.ndarray)
+            else:
+                arr = np.frombuffer(raw[off: off + blen], dtype=dt)
+            off += blen
+            return arr
+
+        key_lens = col(np.uint32)
+        keys_blob_arr = col(np.uint8)
+        base_ts = col(np.uint64)
+        counts = col(np.uint32)
+        nblocks = col(np.uint32)
+        bfirst = col(np.uint64)
+        blast = col(np.uint64)
+        bcount = col(np.int32)
+        bwidth = col(np.int32)
+        boff = col(np.int64)
+        word_lens = col(np.uint64)
+        words = col(np.uint32)
+        post_lens = col(np.uint32)
+        post_blob_arr = col(np.uint8)
+        if N == 0:
+            return off
+
+        kends = np.cumsum(key_lens.astype(np.int64))
+        bends = np.cumsum(nblocks.astype(np.int64))
+        wends = np.cumsum(word_lens.astype(np.int64))
+        pends = np.cumsum(post_lens.astype(np.int64))
+        first_key = bytes(keys_blob_arr[: int(kends[0])]) if paged \
+            else keys_blob_arr[: int(kends[0])].tobytes()
+        kind, attr = K.kind_attr_of(first_key)
+
+        def starts(ends):
+            out = np.zeros(len(ends) + 1, np.int64)
+            out[1:] = ends
+            return out
+
+        if paged:
+            self._segments[(kind, attr)] = SegmentRun(
+                n=N,
+                uid_keyed=kind in (int(K.KeyKind.DATA),
+                                   int(K.KeyKind.REVERSE)),
+                keys_blob=keys_blob_arr, kends=kends,
+                base_ts=base_ts, counts=counts, nbs=nblocks,
+                bstarts=starts(bends), wstarts=starts(wends),
+                pstarts=starts(pends),
+                bfirst=bfirst, blast=blast, bcount=bcount, bwidth=bwidth,
+                boff=boff, words=words, post_blob=post_blob_arr)
+        else:
+            keys_blob = keys_blob_arr.tobytes()
+            post_blob = post_blob_arr.tobytes()
+            k0 = b0 = w0 = p0 = 0
+            preds = self.by_pred.setdefault((kind, attr), set())
+            for i in range(N):
+                k1, b1 = int(kends[i]), int(bends[i])
+                w1, p1 = int(wends[i]), int(pends[i])
+                kb = keys_blob[k0:k1]
+                pl = PostingList()
+                pl.base_ts = int(base_ts[i])
+                # zero-copy slices of the shared (read-only) buffers:
+                # packed bases are immutable — rollup REPLACES base_packed
+                pl.base_packed = packed.PackedUidList(
+                    int(counts[i]), bfirst[b0:b1], blast[b0:b1],
+                    bcount[b0:b1], bwidth[b0:b1], boff[b0:b1], words[w0:w1])
+                if p1 > p0:
+                    pl.base_postings = {
+                        p.uid: p for p in map(posting_from_json,
+                                              json.loads(post_blob[p0:p1]))}
+                self.lists[kb] = pl
+                preds.add(kb)
+                k0, b0, w0, p0 = k1, b1, w1, p1
+        if kind in (int(K.KeyKind.DATA), int(K.KeyKind.REVERSE)):
+            wl = word_lens.astype(np.int64)
+            self._packed_tablets[(kind, attr)] = TabletPacked(
+                n=N,
+                counts=counts.astype(np.int64),
+                nbs=nblocks.astype(np.int64),
+                row_word_start=wends - wl,
+                bfirst=bfirst, bcount=bcount, bwidth=bwidth, boff=boff,
+                words=words,
+                pure=not post_lens.any(),
+                max_base_ts=int(base_ts.max()))
+        return off
 
     def _load_v2(self, raw: bytes) -> None:
         off = 5

@@ -205,7 +205,15 @@ class Registry:
                      "dgraph_planner_cache_misses_total",
                      "dgraph_planner_fallbacks_total",
                      "dgraph_stats_builds_total",
-                     "dgraph_stats_delta_updates_total"):
+                     "dgraph_stats_delta_updates_total",
+                     # out-of-core ingest tier (ingest/, loader/)
+                     "dgraph_ingest_spill_bytes_total",
+                     "dgraph_ingest_spill_runs_total",
+                     "dgraph_ingest_merge_fanin",
+                     "dgraph_xidmap_lookups_total",
+                     "dgraph_xidmap_shard_loads_total",
+                     "dgraph_xidmap_evictions_total",
+                     "dgraph_checkpoint_peak_transient_bytes"):
             self.counters[name] = Counter()
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s", "dgraph_compaction_s",

@@ -328,14 +328,77 @@ def test_v1_snapshot_still_loads(tmp_path):
         f.write(_u32.pack(2) + b"[]")
     s = _Store(str(d))
     _np.testing.assert_array_equal(s.lists[kb].uids(5), [3, 7, 9])
-    # and the next checkpoint upgrades it to v2 transparently
+    # and the next checkpoint upgrades it to the current format (DGTS3)
     s.checkpoint(5)
     s.close()
     with open(d / "snapshot.bin", "rb") as f:
-        assert f.read(5) == b"DGTS2"
+        assert f.read(5) == b"DGTS3"
     s2 = _Store(str(d))
     _np.testing.assert_array_equal(s2.lists[kb].uids(5), [3, 7, 9])
     s2.close()
+
+
+def test_v2_snapshot_still_loads(tmp_path):
+    """Snapshots written by the file-global-column DGTS2 format (the writer
+    before the streaming tablet-sectioned DGTS3) must keep loading, eager
+    AND paged — the fixture is handwritten so the frozen layout can never
+    drift with the code."""
+    import json as _json
+    import struct as _struct
+
+    import numpy as _np
+
+    from dgraph_tpu.storage import keys as _K
+    from dgraph_tpu.storage import packed as _packed
+    from dgraph_tpu.storage.store import Store as _Store
+    _u32 = _struct.Struct("<I")
+
+    rows = [(_K.data_key("name", 1).encode(), _np.array([3, 7], _np.uint64)),
+            (_K.data_key("name", 2).encode(), _np.array([9], _np.uint64))]
+    bps = [_packed.pack(u) for _, u in rows]
+    keys = [kb for kb, _ in rows]
+    N = len(rows)
+
+    def cat(dt, arrs):
+        arrs = [_np.asarray(a, dt) for a in arrs if len(a)]
+        return _np.concatenate(arrs) if arrs else _np.zeros(0, dt)
+
+    d = tmp_path / "v2store"
+    d.mkdir()
+    with open(d / "snapshot.bin", "wb") as f:
+        f.write(b"DGTS2")
+        f.write(_struct.pack("<Q", 5))
+        meta = _json.dumps({"schema": "name: uid .",
+                            "max_commit_ts": 5}).encode()
+        f.write(_u32.pack(len(meta)) + meta)
+        f.write(_u32.pack(N))
+        cols = [
+            _np.fromiter((len(k) for k in keys), _np.uint32, count=N),
+            _np.frombuffer(b"".join(keys), _np.uint8),
+            _np.full(N, 5, _np.uint64),
+            _np.fromiter((bp.count for bp in bps), _np.uint32, count=N),
+            _np.fromiter((bp.nblocks for bp in bps), _np.uint32, count=N),
+            cat(_np.uint64, [bp.block_first for bp in bps]),
+            cat(_np.uint64, [bp.block_last for bp in bps]),
+            cat(_np.int32, [bp.block_count for bp in bps]),
+            cat(_np.int32, [bp.block_width for bp in bps]),
+            cat(_np.int64, [bp.block_off for bp in bps]),
+            _np.fromiter((len(bp.words) for bp in bps), _np.uint64, count=N),
+            cat(_np.uint32, [bp.words for bp in bps]),
+            _np.zeros(N, _np.uint32),
+            _np.zeros(0, _np.uint8),
+        ]
+        for arr in cols:
+            b = arr.tobytes()
+            f.write(_struct.pack("<Q", len(b)))
+            f.write(b)
+    s = _Store(str(d))
+    _np.testing.assert_array_equal(s.lists[keys[0]].uids(5), [3, 7])
+    _np.testing.assert_array_equal(s.lists[keys[1]].uids(5), [9])
+    s.close()
+    sp = _Store(str(d), memory_budget=1 << 20)     # paged mmap path
+    _np.testing.assert_array_equal(sp.lists[keys[0]].uids(5), [3, 7])
+    sp.close()
 
 
 # -- binary WAL record codec (round 4) ---------------------------------------
