@@ -572,6 +572,165 @@ def bench_trace(n_people=8000, follows=8, workers=4, reps=4, batches=3):
     return out
 
 
+MESH_ARTIFACT = "MESH_r06.json"
+_MESH_N = 3000          # nodes per chain graph (3 edges/node/predicate)
+
+
+def _mesh_quads():
+    """Deterministic 4-predicate graph: p0/p1/p2 form the 3-hop chain the
+    acceptance gate measures; follows is the recurse/shortest predicate."""
+    quads = []
+    for i in range(1, _MESH_N + 1):
+        for attr, mul, off in (("p0", 3, 1), ("p1", 5, 2), ("p2", 7, 3),
+                               ("follows", 11, 5)):
+            for k in range(3):
+                t = (i * mul + off + k) % _MESH_N + 1
+                if t != i:
+                    quads.append(f"<0x{i:x}> <{attr}> <0x{t:x}> .")
+    return quads
+
+
+_MESH_SCHEMA = ("p0: [uid] .\np1: [uid] .\np2: [uid] .\n"
+                "follows: [uid] .\n")
+_MESH_BATTERY = [
+    ("chain3", '{ q(func: uid(0x1, 0x2, 0x3, 0x4)) { p0 { p1 { p2 } } } }'),
+    ("recurse3", '{ q(func: uid(0x1)) @recurse(depth: 3) { follows } }'),
+    ("shortest", '{ p as shortest(from: 0x1, to: 0x51) { follows } '
+                 ' r(func: uid(p)) { uid } }'),
+]
+
+
+def _mesh_child():
+    """Runs INSIDE the forced-8-device CPU subprocess: mesh node vs a
+    3-group gRPC wire cluster on the same graph — dispatches per query,
+    p50, QPS, traversed edges/sec for the 3-hop chain, outputs asserted
+    byte-identical."""
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.coord.zero import Zero
+    from dgraph_tpu.coord.zero_service import serve_zero
+    from dgraph_tpu.parallel import remote as remote_mod
+    from dgraph_tpu.parallel.client import ClusterClient
+    from dgraph_tpu.parallel.remote import serve_worker
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
+
+    import jax
+
+    quads = _mesh_quads()
+
+    # -- mesh node (mesh_min_edges=1: this graph's tablets are deliberately
+    # CPU-small; treat them as device-class so the fused regime is measured)
+    mnode = Node(mesh_devices=8, mesh_min_edges=1)
+    mnode.alter(schema_text=_MESH_SCHEMA)
+    mnode.mutate(set_nquads="\n".join(quads), commit_now=True)
+    mnode.plan_cache = mnode.task_cache = mnode.result_cache = None
+
+    # -- 3-group wire cluster over loopback gRPC -----------------------------
+    zero = Zero(3)
+    for attr, g in (("p0", 0), ("p1", 1), ("p2", 2), ("follows", 0)):
+        zero.move_tablet(attr, g)
+    zsrv, zport, _ = serve_zero(zero, "localhost:0")
+    workers = []
+    for _g in range(3):
+        s = Store()
+        for e in parse_schema(_MESH_SCHEMA):
+            s.set_schema(e)
+        workers.append(serve_worker(s, "localhost:0"))
+    client = ClusterClient(
+        f"localhost:{zport}",
+        {g: [f"localhost:{workers[g][1]}"] for g in range(3)})
+    for lo in range(0, len(quads), 8000):
+        client.mutate(set_nquads="\n".join(quads[lo: lo + 8000]))
+    client.task_cache = None               # count every wire dispatch
+
+    rpc_calls = [0]
+    orig = remote_mod.RemoteWorker.process_task
+
+    def counted(self, q, read_ts, min_applied=0):
+        rpc_calls[0] += 1
+        return orig(self, q, read_ts, min_applied)
+
+    remote_mod.RemoteWorker.process_task = counted
+
+    mdisp = mnode.metrics.counter("dgraph_mesh_dispatches_total")
+    medge = mnode.metrics.counter("dgraph_mesh_traversed_edges_total")
+    out = {"n_devices": len(jax.devices()), "hops": 3, "ok": True,
+           "identical": True, "battery": {}}
+    for name, q in _MESH_BATTERY:
+        mjson, _ = mnode.query(q)                       # warmup + compile
+        wjson = client.query(q)
+        same = json.dumps(mjson, sort_keys=True) == \
+            json.dumps(wjson, sort_keys=True)
+        out["identical"] &= same
+        d0 = mdisp.value
+        mnode.query(q)
+        mesh_disp = mdisp.value - d0
+        rpc_calls[0] = 0
+        client.query(q)
+        grpc_disp = rpc_calls[0]
+        iters = 15
+        mlat, wlat = [], []
+        e0, t0 = medge.value, time.perf_counter()
+        for _ in range(iters):
+            s0 = time.perf_counter()
+            mnode.query(q)
+            mlat.append((time.perf_counter() - s0) * 1e3)
+        m_eps = (medge.value - e0) / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s0 = time.perf_counter()
+            client.query(q)
+            wlat.append((time.perf_counter() - s0) * 1e3)
+        out["battery"][name] = {
+            "identical": same,
+            "dispatches_per_query": {"mesh": mesh_disp, "grpc": grpc_disp},
+            "p50_ms": {"mesh": _band(mlat)["median"],
+                       "grpc": _band(wlat)["median"]},
+            "qps": {"mesh": round(1e3 / max(_band(mlat)["median"], 1e-9), 1),
+                    "grpc": round(1e3 / max(_band(wlat)["median"], 1e-9), 1)},
+            "traversed_edges_per_sec": round(m_eps),
+        }
+    b = out["battery"]["chain3"]
+    out["chain3_one_dispatch"] = b["dispatches_per_query"]["mesh"] == 1
+    out["dispatches_per_query"] = b["dispatches_per_query"]
+    out["traversed_edges_per_sec_3hop"] = b["traversed_edges_per_sec"]
+    out["ok"] = bool(out["identical"] and out["chain3_one_dispatch"])
+    remote_mod.RemoteWorker.process_task = orig
+    client.close()
+    for w, _p in workers:
+        w.stop(0)
+    zsrv.stop(0)
+    mnode.close()
+    return out
+
+
+def bench_mesh():
+    """Mesh-deployment battery (ISSUE 6): runs in a SUBPROCESS with the
+    8-virtual-device CPU mesh forced (XLA device count is fixed at backend
+    init, so the parent process cannot flip it) and writes the
+    MULTICHIP_r0*-style trajectory artifact MESH_r06.json."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh-child"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    if proc.returncode != 0:
+        raise RuntimeError(f"mesh child failed: {proc.stderr[-500:]}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           MESH_ARTIFACT), "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return out
+
+
 def bench_query_configs():
     """BASELINE configs 2-5: DQL text in -> JSON out on the film graph."""
     from dgraph_tpu.models.film import film_node
@@ -614,6 +773,10 @@ def bench_query_configs():
 
 
 def main():
+    if "--mesh-child" in sys.argv:
+        # forced-8-device CPU subprocess (bench_mesh): one JSON line out
+        print(json.dumps(_mesh_child()))
+        return
     # the axon relay can hang forever inside backend init (observed all of
     # round 3: make_c_api_client never returns, blocking even SIGALRM
     # delivery). Probe the backend in a SUBPROCESS — the parent's timeout
@@ -688,6 +851,10 @@ def main():
         ingest = bench_ingest()
     except Exception as e:  # ingest battery must not sink it either
         ingest = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        mesh = bench_mesh()
+    except Exception as e:  # mesh battery must not sink it either
+        mesh = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -703,6 +870,7 @@ def main():
         "planner": planner,
         "trace": trace,
         "ingest": ingest,
+        "mesh": mesh,
     }))
 
 

@@ -1,0 +1,131 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + the mesh-deployment acceptance gate on CPU.
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 forces the 8-virtual-device CPU mesh and runs the mixed battery
+# (3-hop chain, fused recurse, shortest / k-shortest) on a mesh-mode Node
+# AND on a 3-group gRPC wire cluster over loopback, asserting:
+#   * every battery query's JSON is byte-identical mesh vs wire,
+#   * the 3-hop chain crossing 3 predicate shards is ONE mesh dispatch
+#     (dgraph_mesh_dispatches_total delta == 1) while the wire path pays
+#     one ServeTask RPC per hop,
+#   * /metrics exposes the dgraph_mesh_* series and parses clean.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== mesh smoke (forced 8-device CPU) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import json
+
+import jax
+
+assert len(jax.devices()) >= 8, jax.devices()
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.coord.zero_service import serve_zero
+from dgraph_tpu.obs import prom
+from dgraph_tpu.parallel import remote as remote_mod
+from dgraph_tpu.parallel.client import ClusterClient
+from dgraph_tpu.parallel.remote import serve_worker
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+
+SCHEMA = "p0: [uid] .\np1: [uid] .\np2: [uid] .\nfollows: [uid] .\n"
+N = 400
+quads = []
+for i in range(1, N + 1):
+    for attr, mul, off in (("p0", 3, 1), ("p1", 5, 2), ("p2", 7, 3),
+                           ("follows", 11, 5)):
+        for k in range(3):
+            t = (i * mul + off + k) % N + 1
+            if t != i:
+                quads.append(f"<0x{i:x}> <{attr}> <0x{t:x}> .")
+
+BATTERY = [
+    ("chain3", '{ q(func: uid(0x1, 0x2, 0x3)) { p0 { p1 { p2 } } } }'),
+    ("recurse3", '{ q(func: uid(0x1)) @recurse(depth: 3) { follows } }'),
+    ("shortest", '{ p as shortest(from: 0x1, to: 0x51) { follows } '
+                 ' r(func: uid(p)) { uid } }'),
+    ("kshortest", '{ p as shortest(from: 0x1, to: 0x51, numpaths: 2) '
+                  '{ follows }  r(func: uid(p)) { uid } }'),
+]
+
+# -- mesh-mode node (every tablet sharded over the 8-device mesh) ----------
+mnode = Node(mesh_devices=8, mesh_min_edges=1)
+mnode.alter(schema_text=SCHEMA)
+mnode.mutate(set_nquads="\n".join(quads), commit_now=True)
+mnode.plan_cache = mnode.task_cache = mnode.result_cache = None
+
+# -- 3-group wire cluster over loopback gRPC -------------------------------
+zero = Zero(3)
+for attr, g in (("p0", 0), ("p1", 1), ("p2", 2), ("follows", 0)):
+    zero.move_tablet(attr, g)
+zsrv, zport, _ = serve_zero(zero, "localhost:0")
+workers = []
+for _g in range(3):
+    s = Store()
+    for e in parse_schema(SCHEMA):
+        s.set_schema(e)
+    workers.append(serve_worker(s, "localhost:0"))
+client = ClusterClient(f"localhost:{zport}",
+                       {g: [f"localhost:{workers[g][1]}"] for g in range(3)})
+client.mutate(set_nquads="\n".join(quads))
+client.task_cache = None      # count every wire dispatch
+
+rpc = [0]
+orig = remote_mod.RemoteWorker.process_task
+def counted(self, q, read_ts, min_applied=0):
+    rpc[0] += 1
+    return orig(self, q, read_ts, min_applied)
+remote_mod.RemoteWorker.process_task = counted
+
+mdisp = mnode.metrics.counter("dgraph_mesh_dispatches_total")
+for name, q in BATTERY:
+    mjson, _ = mnode.query(q)
+    wjson = client.query(q)
+    assert json.dumps(mjson, sort_keys=True) == \
+        json.dumps(wjson, sort_keys=True), f"{name}: mesh != wire"
+    d0, rpc[0] = mdisp.value, 0
+    mnode.query(q)
+    client.query(q)
+    print(f"  {name}: identical; dispatches mesh={mdisp.value - d0} "
+          f"grpc={rpc[0]}")
+    if name == "chain3":
+        assert mdisp.value - d0 == 1, "3-hop chain must be ONE dispatch"
+        assert rpc[0] == 3, "wire path pays one RPC per hop"
+
+series = prom.parse(prom.render(mnode.metrics))
+assert series["dgraph_mesh_dispatches_total"][0][1] >= 1
+assert series["dgraph_mesh_sharded_tablets"][0][1] >= 4
+print(f"  /metrics: {sum(1 for k in series if k.startswith('dgraph_mesh'))} "
+      f"dgraph_mesh_* series")
+remote_mod.RemoteWorker.process_task = orig
+client.close()
+for w, _p in workers:
+    w.stop(0)
+zsrv.stop(0)
+mnode.close()
+print("OK: mesh smoke passed")
+PY
+echo "== smoke passed =="

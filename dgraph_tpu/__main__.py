@@ -38,7 +38,9 @@ def cmd_serve(args) -> int:
                 stats_top_k=args.stats_top_k,
                 span_sample=args.span_sample,
                 slow_query_ms=args.slow_query_ms,
-                slow_query_log=args.slow_query_log)
+                slow_query_log=args.slow_query_log,
+                mesh_devices=(args.mesh_devices or (-1 if args.mesh else 0)),
+                mesh_min_edges=args.mesh_min_edges or None)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
@@ -352,6 +354,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--stats_top_k", type=int, default=8,
                     help="top-K term-frequency sketch size per index "
                          "tokenizer (EXPLAIN / stats readout)")
+    sp.add_argument("--mesh", action="store_true",
+                    help="mesh deployment mode: shard large tablets across "
+                         "every visible device and fuse multi-hop "
+                         "traversals into one jitted dispatch (per-hop "
+                         "frontier exchange over ICI; docs/ops.md)")
+    sp.add_argument("--mesh_devices", type=int, default=0,
+                    help="shard over the first N devices instead of all "
+                         "(implies --mesh; 0 = follow --mesh)")
+    sp.add_argument("--mesh_min_edges", type=int, default=0,
+                    help="tablets below this edge count stay replicated on "
+                         "the classic path (0 = default 65536)")
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")

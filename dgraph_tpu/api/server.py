@@ -89,7 +89,9 @@ class Node:
                  span_sample: float = 0.01,
                  trace_rng=None,
                  slow_query_ms: float = 0.0,
-                 slow_query_log: str | None = None) -> None:
+                 slow_query_log: str | None = None,
+                 mesh_devices: int = 0,
+                 mesh_min_edges: int | None = None) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -160,6 +162,21 @@ class Node:
             self.zero.uids.assign(maxuid)
         self.memory_budget = 0          # 0 = unbounded
         self._enforcer_started = False
+        # mesh deployment mode (ISSUE 6 / ROADMAP item 1): at snapshot
+        # assembly, large uid tablets are placed across a jax.sharding.Mesh
+        # as row-range-sharded NamedSharding arrays and multi-hop
+        # traversals fuse into ONE device dispatch whose per-hop frontier
+        # exchange rides ICI (parallel/mesh_exec.py). 0 = off, -1 = every
+        # visible device, N = first N devices. The classic per-task path
+        # (and the gRPC wire path on a cluster) remains the fallback for
+        # shapes the fused programs do not cover.
+        self.mesh_exec = None
+        if mesh_devices:
+            from dgraph_tpu.parallel.mesh_exec import MeshExecutor
+
+            self.mesh_exec = MeshExecutor(
+                n_devices=None if mesh_devices < 0 else mesh_devices,
+                metrics=self.metrics, shard_min_edges=mesh_min_edges)
 
     def set_memory_budget(self, budget_bytes: int) -> None:
         """Install/retarget the memory budget and ensure the background
@@ -317,6 +334,12 @@ class Node:
             if self.background_rollup and not self._rollup_started and \
                     self._assembler._overlays:
                 self._start_rollup_loop()
+            if self.mesh_exec is not None:
+                # mesh placement at snapshot assembly — identity-cached at
+                # the snapshot AND PredData level, so repeated reads keep
+                # their qcache tokens and delta-overlay predicates keep
+                # serving host-side until compaction folds a fresh base
+                snap = self.mesh_exec.place_snapshot(snap)
             return snap
 
     # overlays older than this many seconds (or deeper than the stamp
@@ -522,7 +545,8 @@ class Node:
             out = Executor(snap, self.store.schema,
                            cache=self.task_cache, gate=self.dispatch_gate,
                            edge_limit=edge_limit, plan=plan,
-                           explain=recorder).execute(req)
+                           explain=recorder,
+                           mesh=self.mesh_exec).execute(req)
             tr.printf("executed")
             if rkey is not None:
                 self.result_cache.put(rkey, out)
@@ -570,7 +594,8 @@ class Node:
                     _, snap = self._read_view(ctx.start_ts)
                     ex = Executor(snap, self.store.schema,
                                   cache=self.task_cache,
-                                  gate=self.dispatch_gate)
+                                  gate=self.dispatch_gate,
+                                  mesh=self.mesh_exec)
                     out = ex.execute(self._parse(q, variables))
                     vars_map = ex.vars
                 uid_map: dict = {}

@@ -20,7 +20,7 @@ Layout notes (How-to-Scale mental model):
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import numpy as np
@@ -29,15 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-
-    def shard_map(f=None, **kw):          # new API: check_vma replaces check_rep
-        kw["check_vma"] = kw.pop("check_rep", kw.pop("check_vma", True))
-        return _shard_map(f, **kw) if f is not None else partial(_shard_map, **kw)
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
+from dgraph_tpu.parallel.mesh import shard_map
 from dgraph_tpu.ops.uidset import sentinel, _dedup_sorted
 from dgraph_tpu.ops.csr import expand
 
@@ -61,12 +53,19 @@ class ShardedCSR(NamedTuple):
         return self.subjects.shape[0]
 
 
+def shard_rows_per(n_rows: int, n_shards: int) -> int:
+    """Rows per shard for a contiguous row-range partition (shared by
+    shard_csr and the host-side uidMatrix reassembly, which must agree on
+    which shard owns which row)."""
+    return -(-max(n_rows, 1) // n_shards)
+
+
 def shard_csr(subjects: np.ndarray, indptr: np.ndarray, indices: np.ndarray,
               mesh: Mesh) -> ShardedCSR:
     """Partition host CSR into contiguous row chunks, pad, and place."""
     n_shards = mesh.shape["shard"]
     n_rows = len(subjects)
-    rows_per = -(-max(n_rows, 1) // n_shards)
+    rows_per = shard_rows_per(n_rows, n_shards)
     sub_chunks, ptr_chunks, idx_chunks = [], [], []
     max_edges = 1
     for s in range(n_shards):
@@ -101,39 +100,82 @@ def _local_rows(subjects: jax.Array, frontier: jax.Array) -> jax.Array:
     return jnp.where(ok, pos_c, SNT).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("mesh", "edge_cap"))
-def _sharded_expand(subjects, indptr, indices, frontier, *, mesh, edge_cap):
-    """Per-shard frontier expand: each shard resolves the replicated frontier
-    against its local subject rows and gathers its adjacency slices. Output
-    keeps the shard axis — the host (or a downstream collective) reassembles
-    the uidMatrix. This is ProcessTaskOverNetwork's scatter (worker/task.go:137)
-    with the gRPC fan-out replaced by SPMD over the mesh."""
+@lru_cache(maxsize=64)
+def _expand_program(mesh: Mesh, fcap: int, edge_cap: int):
+    """ONE compiled sharded-expand per (mesh, frontier cap, edge cap) —
+    rebuilding the shard_map closure per call would retrace + recompile
+    every dispatch (the host-round-trip tax PERF.md measured at
+    ~100-150 ms). Each shard resolves the replicated frontier against its
+    local subject rows and gathers its adjacency slices — this is
+    ProcessTaskOverNetwork's scatter (worker/task.go:137) with the gRPC
+    fan-out replaced by SPMD over the mesh; the host reassembles the
+    uidMatrix (assemble_matrix). Besides the per-shard (counts, targets)
+    the program emits the MERGED next frontier (dedup of the all-gathered
+    dest sets) so a stepped multi-hop caller can stage it on device
+    between hops instead of re-uploading seeds each step."""
 
     @partial(
         shard_map, mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P()),
-        out_specs=(P("shard"), P("shard")),
+        out_specs=(P("shard"), P("shard"), P()),
         check_rep=False,
     )
     def run(sub, ptr, idx, fr):
         rows = _local_rows(sub[0], fr)
         res = expand(ptr[0], idx[0], rows, edge_cap)
-        return res.counts[None, :], res.targets[None, :]
+        dest = _dedup_sorted(jnp.sort(res.targets))
+        gathered = lax.all_gather(dest, "shard")         # the ICI hop
+        merged = _dedup_sorted(jnp.sort(gathered.reshape(-1)))[:fcap]
+        return res.counts[None, :], res.targets[None, :], merged
 
-    return run(subjects, indptr, indices, frontier)
+    return jax.jit(run)
+
+
+def assemble_matrix(counts: np.ndarray, targets: np.ndarray,
+                    F: int) -> list[np.ndarray]:
+    """Host uidMatrix reassembly from per-shard (counts [S, fcap],
+    targets [S, edge_cap]): each subject row lives on exactly one shard
+    (contiguous row ranges), so each frontier slot picks the one shard
+    with a nonzero count and slices its local target run."""
+    offs = np.zeros((counts.shape[0], counts.shape[1] + 1), dtype=np.int64)
+    np.cumsum(counts, axis=1, out=offs[:, 1:])
+    matrix: list[np.ndarray] = []
+    for i in range(F):
+        owners = np.nonzero(counts[:, i])[0]
+        if len(owners) == 0:
+            matrix.append(np.zeros(0, np.int64))
+            continue
+        s = int(owners[0])
+        o = offs[s, i]
+        matrix.append(targets[s, o: o + counts[s, i]].astype(np.int64))
+    return matrix
+
+
+def pad_frontier(uids: np.ndarray, fcap: int) -> np.ndarray:
+    fr = np.full(fcap, int(SNT), dtype=np.int32)
+    fr[: len(uids)] = uids
+    return fr
 
 
 class DistPredCSR:
     """Mesh-sharded drop-in for csr_build.PredCSR.
 
     The expand hot path (the uidMatrix gather) runs SPMD over the mesh via
-    `_sharded_expand`; `subjects`/`indptr`/`indices` host mirrors keep the
-    scalar paths (count-index degrees, reflexive scans) working unchanged.
-    Tablet routing: the mesh passed here is the predicate's group submesh
-    (worker/groups.go:292 BelongsTo — see parallel/worker.py).
+    the cached `_expand_program`; `subjects`/`indptr`/`indices` host
+    mirrors keep the scalar paths (count-index degrees, reflexive scans)
+    working unchanged. Tablet routing: the mesh passed here is the
+    predicate's group submesh (worker/groups.go:292 BelongsTo — see
+    parallel/worker.py). Multi-hop traversals should go through
+    parallel/mesh_exec.MeshExecutor, which fuses the whole hop loop into
+    one dispatch; the per-task path here still stages its merged next
+    frontier on device so stepped callers replaying it skip the re-upload.
     """
 
     is_dist = True
+    # metrics Registry installed by the placing MeshExecutor (None for
+    # direct constructions): per-task mesh dispatches count alongside the
+    # fused-program dispatches so dispatches-per-query is honest
+    metrics = None
 
     def __init__(self, subjects, indptr, indices, mesh: Mesh) -> None:
         self.subjects = np.asarray(subjects)
@@ -141,6 +183,18 @@ class DistPredCSR:
         self.indices = np.asarray(indices)
         self.mesh = mesh
         self.sharded = shard_csr(self.subjects, self.indptr, self.indices, mesh)
+        # host metadata mirroring shard_csr's partition: row r lives on
+        # shard r // rows_per with local edge base edge_lo[shard]
+        n_shards = mesh.shape["shard"]
+        self.rows_per = shard_rows_per(len(self.subjects), n_shards)
+        self.edge_lo = np.asarray(
+            [int(self.indptr[min(s * self.rows_per, len(self.subjects))])
+             for s in range(n_shards)], dtype=np.int64)
+        # device staging: (host uids of the staged frontier, device array)
+        # — a stepped caller whose next frontier IS the previous merged
+        # dest set reuses the on-device copy instead of re-uploading
+        self._staged: tuple[np.ndarray, jax.Array] | None = None
+        self._host: tuple | None = None
 
     @property
     def num_subjects(self) -> int:
@@ -150,52 +204,54 @@ class DistPredCSR:
     def num_edges(self) -> int:
         return len(self.indices)
 
-    def expand_matrix(self, uids: np.ndarray) -> tuple[list[np.ndarray], int]:
-        """uidMatrix rows for `uids`, gathered across shards.
+    def host_arrays(self) -> tuple:
+        """(subjects, indptr, indices) numpy mirrors — the PredCSR surface
+        stats/known-uid/has() paths consume without a device fetch."""
+        if self._host is None:
+            self._host = (self.subjects, self.indptr, self.indices)
+        return self._host
 
-        Each subject row lives on exactly one shard (contiguous row ranges),
-        so reassembly picks, per frontier slot, the one shard with a nonzero
-        count and slices its local target run."""
+    def expand_matrix(self, uids: np.ndarray) -> tuple[list[np.ndarray], int]:
+        """uidMatrix rows for `uids`, gathered across shards in ONE cached
+        mesh dispatch. The merged next-frontier stays staged on device: a
+        stepped multi-hop caller re-expanding exactly the previous merged
+        dest set pays no H2D upload for it."""
         F = len(uids)
         if F == 0 or self.num_edges == 0:
             return [np.zeros(0, np.int64) for _ in range(F)], 0
-        fcap = 1 << max(int(np.ceil(np.log2(F))), 4)
-        fr = np.full(fcap, int(SNT), dtype=np.int32)
-        fr[:F] = uids
         edge_cap = int(self.sharded.indices.shape[-1])
+        staged = self._staged
+        if staged is not None and len(staged[0]) == F and \
+                np.array_equal(staged[0], uids):
+            fr_dev, fcap = staged[1], int(staged[1].shape[0])
+        else:
+            fcap = 1 << max(int(np.ceil(np.log2(F))), 4)
+            fr_dev = jnp.asarray(pad_frontier(np.asarray(uids), fcap))
         with self.mesh:
-            counts_all, targets_all = _sharded_expand(
+            counts_all, targets_all, next_fr = _expand_program(
+                self.mesh, fcap, edge_cap)(
                 self.sharded.subjects, self.sharded.indptr,
-                self.sharded.indices, jnp.asarray(fr),
-                mesh=self.mesh, edge_cap=edge_cap)
+                self.sharded.indices, fr_dev)
         counts = np.asarray(counts_all)          # [S, fcap]
         targets = np.asarray(targets_all)        # [S, edge_cap]
-        offs = np.zeros((counts.shape[0], fcap + 1), dtype=np.int64)
-        np.cumsum(counts, axis=1, out=offs[:, 1:])
-        matrix: list[np.ndarray] = []
-        for i in range(F):
-            owners = np.nonzero(counts[:, i])[0]
-            if len(owners) == 0:
-                matrix.append(np.zeros(0, np.int64))
-                continue
-            s = int(owners[0])
-            o = offs[s, i]
-            matrix.append(targets[s, o : o + counts[s, i]].astype(np.int64))
-        return matrix, int(counts[:, :F].sum())
+        matrix = assemble_matrix(counts, targets, F)
+        next_h = np.asarray(next_fr)
+        self._staged = (next_h[next_h != int(SNT)].astype(np.int64), next_fr)
+        total = int(counts[:, :F].sum())
+        if self.metrics is not None:
+            self.metrics.counter("dgraph_mesh_dispatches_total").inc()
+            self.metrics.counter("dgraph_mesh_traversed_edges_total").inc(
+                total)
+        return matrix, total
 
 
-def dist_k_hop(csr: ShardedCSR, seeds: jax.Array, mesh: Mesh, *, hops: int,
-               frontier_cap: int, num_nodes: int, edge_cap: int | None = None):
-    """Multi-device k-hop BFS. Returns (visited bool[num_nodes], frontier,
-    traversed:int32) — all replicated.
-
-    Per hop, per shard: resolve frontier against local subjects → local CSR
-    gather → local dedup; then ONE all_gather of [edge_cap]-sized dest sets
-    over ICI and a replicated merge + visited update. psum sums edge counts.
-    edge_cap must cover one shard's largest per-level edge gather (a shard's
-    total edge count, csr.indices.shape[-1], is always safe).
-    """
-    edge_cap = edge_cap or frontier_cap
+@lru_cache(maxsize=64)
+def _k_hop_program(mesh: Mesh, hops: int, frontier_cap: int, num_nodes: int,
+                   edge_cap: int):
+    """Cached jitted k-hop program — building the shard_map closure inside
+    dist_k_hop made EVERY call a fresh function identity, so jax retraced
+    the whole hop loop per query (the dominant fixed cost of the
+    MULTICHIP_r0* dryruns)."""
 
     def step(sub, ptr, idx, frontier, visited):
         # sub/ptr/idx are this shard's blocks (leading axis stripped by shard_map)
@@ -227,6 +283,21 @@ def dist_k_hop(csr: ShardedCSR, seeds: jax.Array, mesh: Mesh, *, hops: int,
         return lax.fori_loop(0, hops, body,
                              (seeds_in, visited0, jnp.int32(0)))
 
+    return jax.jit(run)
+
+
+def dist_k_hop(csr: ShardedCSR, seeds: jax.Array, mesh: Mesh, *, hops: int,
+               frontier_cap: int, num_nodes: int, edge_cap: int | None = None):
+    """Multi-device k-hop BFS. Returns (visited bool[num_nodes], frontier,
+    traversed:int32) — all replicated.
+
+    Per hop, per shard: resolve frontier against local subjects → local CSR
+    gather → local dedup; then ONE all_gather of [edge_cap]-sized dest sets
+    over ICI and a replicated merge + visited update. psum sums edge counts.
+    edge_cap must cover one shard's largest per-level edge gather (a shard's
+    total edge count, csr.indices.shape[-1], is always safe).
+    """
+    edge_cap = edge_cap or frontier_cap
     if seeds.shape[0] < frontier_cap:
         seeds = jnp.concatenate(
             [seeds, jnp.full((frontier_cap - seeds.shape[0],), SNT, jnp.int32)])
@@ -236,4 +307,5 @@ def dist_k_hop(csr: ShardedCSR, seeds: jax.Array, mesh: Mesh, *, hops: int,
     visited0 = visited0.at[jnp.where(seeds == SNT, num_nodes, seeds)].set(
         True, mode="drop")
     with mesh:
-        return jax.jit(run)(csr.subjects, csr.indptr, csr.indices, seeds, visited0)
+        return _k_hop_program(mesh, hops, frontier_cap, num_nodes, edge_cap)(
+            csr.subjects, csr.indptr, csr.indices, seeds, visited0)

@@ -89,9 +89,16 @@ class Executor:
     def __init__(self, snap: GraphSnapshot, schema: SchemaState,
                  dispatch=None, cache=None, gate=None,
                  edge_limit: int | None = None,
-                 plan=None, explain: dict | None = None):
+                 plan=None, explain: dict | None = None,
+                 mesh=None):
         self.snap = snap
         self.schema = schema
+        # mesh deployment mode (parallel/mesh_exec.MeshExecutor): pure
+        # multi-hop expansion chains over mesh-sharded tablets fuse into
+        # ONE device dispatch (expand + per-hop ICI all-gather of frontier
+        # UID blocks) instead of one dispatch per hop; recurse/shortest
+        # consult it too. None = classic per-task dispatch only.
+        self.mesh = mesh
         self.vars: dict[str, VarValue] = {}
         self.traversed_edges = 0
         self.sort_index_buckets = -1  # sortWithIndex instrumentation
@@ -326,6 +333,9 @@ class Executor:
         gq = sg.gq
         frontier = np.sort(sg.dest_uids)
         eff = self._effective_children(gq, frontier)
+        if self.mesh is not None and len(eff) == 1 and len(frontier) and \
+                self._mesh_fused_chain(sg, eff[0], frontier):
+            return
         order = None
         if self.plan is not None:
             order = self.plan.child_order.get(id(gq))
@@ -382,6 +392,84 @@ class Executor:
             if cgq.children or cgq.cascade:
                 self._finish_level(child, is_root=False)
         sg.children.extend(c for c in slots if c is not None)
+
+    # ------------------------------------------------------------- mesh mode
+
+    def _mesh_chain_csr(self, cgq: dql.GraphQuery):
+        """The mesh-sharded adjacency a chain node expands over, or None."""
+        attr = cgq.attr
+        rev = attr.startswith("~")
+        pd = self.snap.pred(attr[1:] if rev else attr)
+        if pd is None:
+            return None
+        csr = pd.rev_csr if rev else pd.csr
+        return csr if (csr is not None and self.mesh.owns(csr)) else None
+
+    def _mesh_chain_ok(self, cgq: dql.GraphQuery) -> bool:
+        """A chain node is a PLAIN uid expansion: anything that needs host
+        logic between hops (filters, facets, pagination, lang, cascade,
+        count/val/math pseudo-attrs) breaks the fusion and falls back to
+        the classic per-hop dispatch — results are identical either way."""
+        if cgq.expand or cgq.is_uid_node or cgq.is_count or cgq.checkpwd:
+            return False
+        if cgq.attr in ("val", "math") or cgq.attr.startswith("__agg_"):
+            return False
+        if cgq.filter is not None or cgq.facets is not None:
+            return False
+        if cgq.lang or cgq.cascade or cgq.groupby is not None or cgq.order:
+            return False
+        if cgq.args.get("first") or cgq.args.get("offset") \
+                or cgq.args.get("after"):
+            return False
+        return self._mesh_chain_csr(cgq) is not None
+
+    def _mesh_fused_chain(self, sg: SubGraph, c0: dql.GraphQuery,
+                          frontier: np.ndarray) -> bool:
+        """Fuse a pure expansion chain (p0 { p1 { p2 … } }) into ONE mesh
+        dispatch (parallel/mesh_exec.run_chain): N hops crossing N
+        predicate shards cost one device program whose only inter-device
+        traffic is the per-hop ICI all-gather of frontier UID blocks —
+        instead of N×hops dispatches (or gRPC round trips on the wire
+        path). Returns False when the shape doesn't qualify; the caller
+        runs the classic loop, byte-identical."""
+        from dgraph_tpu.parallel.mesh_exec import MeshCapacityError
+
+        chain: list[dql.GraphQuery] = []
+        node = c0
+        while self._mesh_chain_ok(node):
+            chain.append(node)
+            if len(node.children) != 1:
+                break
+            node = node.children[0]
+        if len(chain) < 2:
+            return False
+        csrs = [self._mesh_chain_csr(c) for c in chain]
+        try:
+            levels = self.gated(
+                lambda: self.mesh.run_chain(csrs, frontier))
+        except MeshCapacityError:
+            self.mesh.metrics.counter(
+                "dgraph_mesh_fallbacks_total").inc()
+            return False
+        parent = sg
+        for cgq, (fr, matrix, counts, dest, traversed) in zip(chain, levels):
+            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=fr)
+            child.uid_matrix = matrix
+            child.counts = counts
+            child.dest_uids = dest
+            child.traversed = traversed
+            if self.plan is not None:
+                self.plan.record(cgq, traversed, self.explain)
+            self.traversed_edges += traversed
+            if self.traversed_edges > self.edge_budget():
+                raise QueryError("query exceeded edge budget (ErrTooBig)")
+            self._record_child_vars(cgq, child, fr)
+            parent.children.append(child)
+            parent = child
+        # the last chain node's own (non-chain) subtree continues classic
+        if chain[-1].children or chain[-1].cascade:
+            self._finish_level(parent, is_root=False)
+        return True
 
     def _apply_child_row_mods(self, child: SubGraph) -> None:
         """Filter dest uids, then prune + paginate each uidMatrix row
@@ -804,13 +892,11 @@ def _known_uids(snap: GraphSnapshot) -> np.ndarray:
     for pd in snap.preds.values():
         parts.append(pd.has_subjects().astype(np.int64))
         if pd.csr is not None:
-            if hasattr(pd.csr, "host_arrays"):
-                # cached host mirror (PredCSR) / host-side merge (overlay)
-                # — never a device upload + download just to enumerate uids
-                parts.append(np.asarray(
-                    pd.csr.host_arrays()[2]).astype(np.int64))
-            else:    # mesh-sharded tablet: device fetch
-                parts.append(np.asarray(pd.csr.indices).astype(np.int64))
+            # cached host mirror — every CSR variant (PredCSR, overlay,
+            # mesh-sharded DistPredCSR) exposes host_arrays(): never a
+            # device upload + download just to enumerate uids
+            parts.append(np.asarray(
+                pd.csr.host_arrays()[2]).astype(np.int64))
     out = np.unique(np.concatenate(parts)) if parts else np.zeros(0, np.int64)
     snap._known_uids_cache = out
     return out

@@ -242,6 +242,16 @@ def recurse(ex, sg: SubGraph) -> None:
             _recurse_fused_path(ex, sg, cgq, csr, depth, spec.allow_loop)
             ex._record_uid_var(gq, sg)
             return
+        mesh = getattr(ex, "mesh", None)
+        if mesh is not None and mesh.owns(csr):
+            # MESH FUSED PATH: all levels in one shard_map dispatch, the
+            # per-level frontier exchanged as ICI all-gathered UID blocks
+            # (parallel/mesh_exec.run_recurse) — instead of one mesh (or
+            # gRPC) dispatch per level
+            _mesh_recurse_path(ex, sg, cgq, csr, depth, spec.allow_loop,
+                               mesh)
+            ex._record_uid_var(gq, sg)
+            return
 
     def build_level(frontier: np.ndarray, remaining: int) -> list[SubGraph]:
         nonlocal edges
@@ -328,6 +338,33 @@ def recurse(ex, sg: SubGraph) -> None:
 
     sg.children = build_level(sg.dest_uids, depth)
     ex._record_uid_var(gq, sg)
+
+
+def _mesh_recurse_path(ex, sg: SubGraph, cgq, csr, depth: int,
+                       allow_loop: bool, mesh) -> None:
+    """All levels of a mesh-sharded recurse in ONE device dispatch: the
+    seen-edge vector lives per shard on device across levels and the
+    fresh dest blocks all-gather into the next frontier over ICI
+    (mesh_exec.run_recurse). SubGraph chain built exactly like the
+    stepped wire path's (attr, from, to)-dedup levels (equality-gated
+    by tests/test_mesh_exec.py)."""
+    seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
+    levels = ex.gated(lambda: mesh.run_recurse(csr, seeds, depth,
+                                               allow_loop))
+    attach = sg.children = []
+    cum = 0
+    for frontier, matrix, counts, dest, traversed in levels:
+        if len(frontier) == 0:
+            break
+        cum += traversed
+        if cum > ex.edge_budget():
+            raise QueryError("recurse exceeded edge budget (ErrTooBig)")
+        child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
+        child.uid_matrix = matrix
+        child.counts = counts
+        child.dest_uids = dest
+        attach.append(child)
+        attach = child.children
 
 
 def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,

@@ -187,6 +187,57 @@ def _device_shortest(attr: str, csr, src: int, dst: int, max_depth: int):
     return (dist, path[::-1], [attr] * (len(path) - 1))
 
 
+def _mesh_csr(ex, sg: SubGraph):
+    """(attr, mesh-sharded CSR) when the block's expansion can iterate on
+    the mesh: one uid child, no filter/lang/facet cost — the same terms a
+    per-level wire expansion would need host logic for. Works for both
+    single and k-shortest (the adjacency feeds either)."""
+    mesh = getattr(ex, "mesh", None)
+    if mesh is None or len(sg.gq.children) != 1:
+        return None
+    cgq = sg.gq.children[0]
+    if cgq.filter is not None or cgq.lang:
+        return None
+    if cgq.facets is not None and cgq.facets.keys:
+        return None
+    rev = cgq.attr.startswith("~")
+    pd = ex.snap.pred(cgq.attr[1:] if rev else cgq.attr)
+    if pd is None:
+        return None
+    csr = pd.rev_csr if rev else pd.csr
+    if csr is None or not mesh.owns(csr):
+        return None
+    return cgq.attr, csr
+
+
+def _mesh_adjacency(ex, sg: SubGraph, attr: str, csr, src: int):
+    """expandOut's level loop (query/shortest.go:134) as mesh collective
+    steps: the frontier AND the visited set stay staged on device between
+    hops (mesh_exec.MeshTraversal) — each level is one dispatch whose only
+    inter-device traffic is the ICI all-gather of frontier UID blocks,
+    instead of one gRPC round trip per level per group. Adjacency/cost
+    semantics identical to _build_adjacency (cost 1.0, all targets
+    recorded, unvisited targets advance the frontier)."""
+    spec = sg.gq.shortest
+    max_depth = spec.depth if spec.depth > 0 else 64
+    adj: dict[int, list[tuple[int, float, str]]] = {}
+    trav = ex.mesh.start_traversal(csr, np.asarray([src], dtype=np.int64))
+    edges = 0
+    for _level in range(max_depth):
+        frontier = trav.frontier
+        if len(frontier) == 0:
+            break
+        matrix, _next, traversed = ex.gated(trav.step)
+        edges += traversed
+        if edges > ex.edge_budget():
+            raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
+        for u, targets in zip(frontier, matrix):
+            if len(targets):
+                adj.setdefault(int(u), []).extend(
+                    (int(t), 1.0, attr) for t in targets)
+    return adj
+
+
 def shortest_path(ex, sg: SubGraph) -> None:
     spec = sg.gq.shortest
     src = _resolve_end(ex, spec.from_)
@@ -197,11 +248,15 @@ def shortest_path(ex, sg: SubGraph) -> None:
         sg.paths = [(0.0, [src], [])]
     else:
         dev = _device_csr(ex, sg)
+        mesh = _mesh_csr(ex, sg) if dev is None else None
         if dev is not None:
             p = _device_shortest(dev[0], dev[1], src, dst, max_depth)
             sg.paths = [p] if p is not None else []
         else:
-            adj = _build_adjacency(ex, sg, src, dst)
+            if mesh is not None:
+                adj = _mesh_adjacency(ex, sg, mesh[0], mesh[1], src)
+            else:
+                adj = _build_adjacency(ex, sg, src, dst)
             if spec.numpaths <= 1:
                 p = _dijkstra(adj, src, dst)
                 sg.paths = [p] if p is not None else []

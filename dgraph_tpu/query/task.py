@@ -91,6 +91,30 @@ def rows_for_uids(csr: PredCSR, uids: np.ndarray) -> np.ndarray:
     return us.host_rank_of(subjects, uids, us.SENTINEL32).astype(np.int32)
 
 
+def _frontier_degrees(csr, uids: np.ndarray):
+    """(rows, indptr_h, deg, need) for a frontier over one adjacency's host
+    mirrors — the shared first pass of every size-adaptive expand branch."""
+    rows = rows_for_uids(csr, uids)
+    indptr_h = csr.host_arrays()[1]
+    rc = np.clip(rows, 0, max(len(indptr_h) - 2, 0))
+    ok = rows != us.SENTINEL32
+    deg = np.where(ok, indptr_h[rc + 1] - indptr_h[rc], 0)
+    return rows, indptr_h, deg, int(deg.sum())
+
+
+def _host_expand_matrix(indptr_h: np.ndarray, indices_h: np.ndarray,
+                        rows: np.ndarray, deg: np.ndarray, uids: np.ndarray,
+                        need: int, cutover: int) -> list[np.ndarray]:
+    """Below-cutover uidMatrix straight from the host mirrors (shared by
+    the resident and mesh-sharded branches of _expand_csr)."""
+    otrace.event("host_expand", need=need,
+                 cutover=int(cutover or HOST_EXPAND_MAX))
+    offs = np.zeros(len(uids) + 1, dtype=np.int64)
+    np.cumsum(deg, out=offs[1:])
+    targets = _gather_rows_host(indptr_h, indices_h, rows, deg, offs)
+    return [targets[offs[i]: offs[i + 1]] for i in range(len(uids))]
+
+
 def _gather_rows_host(indptr_h: np.ndarray, indices_h: np.ndarray,
                       rows: np.ndarray, deg: np.ndarray,
                       offs: np.ndarray) -> np.ndarray:
@@ -165,32 +189,31 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
     if len(uids) == 0 or csr is None:
         return [np.zeros(0, np.int64) for _ in range(len(uids))], 0
     if getattr(csr, "is_dist", False):
-        # mesh-sharded tablet: SPMD expand over the owning group's submesh
-        # (ProcessTaskOverNetwork remapped to ICI, parallel/dist.DistPredCSR)
-        matrix, total = csr.expand_matrix(uids)
+        # mesh-sharded tablet: the SAME size-adaptive host/device cutover
+        # as the resident path (the planner's estimated-frontier decision
+        # applies unchanged) — a small frontier gathers from the host
+        # mirrors in microseconds; past the cutover the expand runs SPMD
+        # over the owning group's submesh (ProcessTaskOverNetwork remapped
+        # to ICI, parallel/dist.DistPredCSR)
+        rows, indptr_h, deg, need = _frontier_degrees(csr, uids)
+        if need <= (cutover or HOST_EXPAND_MAX):
+            matrix = _host_expand_matrix(indptr_h, csr.host_arrays()[2],
+                                         rows, deg, uids, need, cutover)
+            total = need
+        else:
+            matrix, total = csr.expand_matrix(uids)
     elif isinstance(csr, OverlayCSR):
         matrix, total = _expand_overlay(csr, uids, cutover)
     else:
-        rows = rows_for_uids(csr, uids)
-        indptr_h = csr.host_arrays()[1]
-        rc = np.clip(rows, 0, max(len(indptr_h) - 2, 0))
-        ok = rows != us.SENTINEL32
-        deg = np.where(ok, indptr_h[rc + 1] - indptr_h[rc], 0)
-        need = int(deg.sum())
+        rows, indptr_h, deg, need = _frontier_degrees(csr, uids)
         if need <= (cutover or HOST_EXPAND_MAX):
             # size-adaptive strategy (the TPU-era analog of the reference's
             # linear/gallop/binary ratio switch, algo/uidlist.go:147-155):
             # a small gather is microseconds on the cached host mirror but
             # pays fixed per-dispatch + sync latency on device — the device
             # path wins only once the edge volume amortizes it
-            otrace.event("host_expand", need=need,
-                         cutover=int(cutover or HOST_EXPAND_MAX))
-            offs = np.zeros(len(uids) + 1, dtype=np.int64)
-            np.cumsum(deg, out=offs[1:])
-            targets = _gather_rows_host(indptr_h, csr.host_arrays()[2],
-                                        rows, deg, offs)
-            matrix = [targets[offs[i]: offs[i + 1]]
-                      for i in range(len(uids))]
+            matrix = _host_expand_matrix(indptr_h, csr.host_arrays()[2],
+                                         rows, deg, uids, need, cutover)
             total = need
         else:
             cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
