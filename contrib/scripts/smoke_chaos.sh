@@ -1,0 +1,168 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + the seeded chaos battery on a 2-group wire
+# cluster (ISSUE 7 request lifelines).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1
+# to skip when the full suite already ran in an earlier CI stage).
+# Step 2 stands up zero + 2 workers + ClusterClient over loopback gRPC and
+# runs the mixed battery under a seeded fault schedule (transport errors +
+# delays at the serve seam), asserting the lifeline contract: every
+# request returns byte-identical results or a typed error within its
+# deadline — zero hangs (watchdog), zero wrong results. It then checks
+# degraded-mode reads after killing Zero, and that the new lifeline
+# metrics render on /metrics and prom-parse clean.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== chaos smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import threading
+import time
+import urllib.request
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.coord.zero_service import serve_zero
+from dgraph_tpu.obs import prom
+from dgraph_tpu.parallel.client import ClusterClient
+from dgraph_tpu.parallel.remote import serve_worker
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils import faults
+from dgraph_tpu.utils.deadline import DeadlineExceeded, ResourceExhausted
+from dgraph_tpu.utils.retry import CommitAmbiguous
+from dgraph_tpu.utils.schema import parse_schema
+
+SCHEMA = ("name: string @index(exact) .\n"
+          "age: int @index(int) .\n"
+          "follows: [uid] @reverse .")
+BATTERY = [
+    '{ q(func: eq(name, "p1")) { name age } }',
+    '{ q(func: eq(name, "p1")) { name follows { name age } } }',
+    '{ q(func: eq(name, "p3")) { name ~follows { name } } }',
+    '{ q(func: ge(age, 25)) { name } }',
+]
+TYPED = (DeadlineExceeded, ResourceExhausted, CommitAmbiguous,
+         ConnectionError, OSError, RuntimeError)
+import grpc
+TYPED = TYPED + (grpc.RpcError,)
+
+# -- 2-group wire cluster ---------------------------------------------------
+zero = Zero(2)
+zero.move_tablet("name", 0)
+zero.move_tablet("age", 0)
+zero.move_tablet("follows", 1)
+zsrv, zport, _ = serve_zero(zero, "localhost:0")
+stores, workers = [], []
+for _g in range(2):
+    s = Store()
+    for e in parse_schema(SCHEMA):
+        s.set_schema(e)
+    stores.append(s)
+    workers.append(serve_worker(s, "localhost:0"))
+client = ClusterClient(
+    f"localhost:{zport}",
+    {g: [f"localhost:{workers[g][1]}"] for g in range(2)},
+    default_timeout_ms=4000)
+nq = []
+for i in range(8):
+    nq.append(f'_:p{i} <name> "p{i}" .')
+    nq.append(f'_:p{i} <age> "{20 + i}"^^<xs:int> .')
+for i in range(7):
+    nq.append(f"_:p{i} <follows> _:p{i + 1} .")
+client.mutate(set_nquads="\n".join(nq))
+
+golden = []
+for q in BATTERY:
+    client.task_cache.clear()
+    golden.append(json.dumps(client.query(q), sort_keys=True))
+
+# -- seeded fault schedule over the battery ---------------------------------
+faults.GLOBAL.reseed(20260803)
+faults.GLOBAL.install("worker.serve_task", "error", p=0.2)
+faults.GLOBAL.install("rpc.send", "delay", p=0.2, delay_s=0.05)
+DEADLINE_MS = 3000
+ok = typed = wrong = untyped = hangs = 0
+for _round in range(6):
+    for qi, q in enumerate(BATTERY):
+        t0 = time.monotonic()
+        try:
+            client.task_cache.clear()
+            got = json.dumps(client.query(q, timeout_ms=DEADLINE_MS),
+                             sort_keys=True)
+            if got == golden[qi]:
+                ok += 1
+            else:
+                wrong += 1
+        except TYPED:
+            typed += 1
+        except BaseException:
+            untyped += 1
+        if time.monotonic() - t0 > DEADLINE_MS / 1000 + 3.0:
+            hangs += 1
+faults.GLOBAL.clear()
+total = ok + typed + wrong + untyped
+assert wrong == 0, f"{wrong} WRONG results under faults"
+assert untyped == 0, f"{untyped} untyped errors escaped"
+assert hangs == 0, f"{hangs} requests hung"
+assert ok > 0, "nothing succeeded under the schedule"
+print(f"  chaos battery: {total} requests -> {ok} byte-identical, "
+      f"{typed} typed errors, 0 wrong / 0 untyped / 0 hangs")
+
+# -- degraded mode after Zero death -----------------------------------------
+zsrv.stop(0)
+time.sleep(0.1)
+client.task_cache.clear()
+got = json.dumps(client.query(BATTERY[1]), sort_keys=True)
+assert got == golden[1], "degraded read diverged"
+assert client.last_degraded and client.last_degraded["degraded"]
+print(f"  degraded read OK (staleness "
+      f"{client.last_degraded['staleness_s']}s)")
+client.close()
+for w, _p in workers:
+    w.stop(0)
+
+# -- lifeline metrics on /metrics, prom-parse checked -----------------------
+node = Node(default_timeout_ms=0)
+node.alter(schema_text=SCHEMA)
+node.mutate(set_nquads='_:a <name> "x" .', commit_now=True)
+srv = make_server(node, "127.0.0.1", 0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{srv.server_address[1]}"
+req = urllib.request.Request(
+    base + "/query?timeoutMs=2000",
+    data=b'{ q(func: eq(name, "x")) { name } }', method="POST")
+urllib.request.urlopen(req, timeout=10).read()
+text = urllib.request.urlopen(base + "/metrics", timeout=5).read().decode()
+series = prom.parse(text)
+for name in ("dgraph_retry_total", "dgraph_shed_total",
+             "dgraph_deadline_exceeded_total", "dgraph_hedge_fired_total",
+             "dgraph_breaker_open_total", "dgraph_degraded_reads_total",
+             "dgraph_fault_injected_total"):
+    assert name in series, name
+assert "# TYPE dgraph_breaker_state gauge" in text
+print(f"  /metrics: {len(series)} series parsed clean, lifelines present")
+srv.shutdown()
+node.close()
+print("OK: chaos smoke passed")
+PY
+echo "== smoke passed =="

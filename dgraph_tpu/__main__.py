@@ -40,7 +40,17 @@ def cmd_serve(args) -> int:
                 slow_query_ms=args.slow_query_ms,
                 slow_query_log=args.slow_query_log,
                 mesh_devices=(args.mesh_devices or (-1 if args.mesh else 0)),
-                mesh_min_edges=args.mesh_min_edges or None)
+                mesh_min_edges=args.mesh_min_edges or None,
+                default_timeout_ms=args.default_timeout_ms)
+    if args.faults or args.faults_seed is not None:
+        from dgraph_tpu.utils import faults as faults_mod
+
+        if args.faults_seed is not None:    # 0 is a valid seed
+            faults_mod.GLOBAL.reseed(args.faults_seed)
+        if args.faults:
+            faults_mod.GLOBAL.configure(args.faults)
+        lg.info("fault injection armed", points=args.faults or "",
+                seed=args.faults_seed)
     if args.memory_mb:
         node.set_memory_budget(args.memory_mb * (1 << 20))
     if args.schema:
@@ -368,6 +378,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")
+    sp.add_argument("--default_timeout_ms", type=float, default=0,
+                    help="end-to-end deadline budget for requests without "
+                         "an explicit ?timeoutMs= — consumed at every wait "
+                         "point, typed DeadlineExceeded on overrun, never "
+                         "a hang (0 = unbudgeted)")
+    sp.add_argument("--faults", default=None,
+                    help="arm fault injection: 'name:mode:p[:delay_s]"
+                         "[:count],...' over the points in docs/ops.md "
+                         "(modes error/delay/drop; chaos testing only)")
+    sp.add_argument("--faults_seed", type=int, default=None,
+                    help="deterministic PRNG seed for --faults schedules "
+                         "(same seed replays the same fault sequence; "
+                         "0 is a valid seed)")
     sp.add_argument("--tls_cert", default=None,
                     help="PEM certificate: serve HTTP and gRPC over TLS")
     sp.add_argument("--tls_key", default=None, help="PEM private key")

@@ -34,6 +34,8 @@ from urllib.parse import parse_qs, urlparse
 
 from dgraph_tpu.api.server import Node
 from dgraph_tpu.coord.zero import TxnConflict
+from dgraph_tpu.utils import faults
+from dgraph_tpu.utils.deadline import DeadlineExceeded, ResourceExhausted
 
 
 def _envelope_ok(data: dict, extensions: dict | None = None) -> bytes:
@@ -210,6 +212,19 @@ def _serving_metrics(node: Node) -> dict:
                 "delta_updates": c("dgraph_stats_delta_updates_total"),
             },
         },
+        # request lifelines (ISSUE 7): retries / sheds / deadline
+        # overruns / hedges / breaker trips / degraded reads / injected
+        # faults — the failure-mode readout the runbook points at
+        "lifelines": {
+            "retries": c("dgraph_retry_total"),
+            "sheds": c("dgraph_shed_total"),
+            "deadline_exceeded": c("dgraph_deadline_exceeded_total"),
+            "hedges": c("dgraph_hedge_fired_total"),
+            "breaker_opens": c("dgraph_breaker_open_total"),
+            "breaker_state": m.keyed("dgraph_breaker_state").snapshot(),
+            "degraded_reads": c("dgraph_degraded_reads_total"),
+            "faults_injected": c("dgraph_fault_injected_total"),
+        },
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),
                  "latency": m.histogram(
@@ -264,6 +279,9 @@ class _Handler(BaseHTTPRequestHandler):
         "/debug/traces/<trace_id>": "one trace as Chrome trace-event JSON "
                                     "(load in Perfetto / chrome://tracing)",
         "/debug/slow": "slow-query log ring (?n=32)",
+        "/debug/faults": "fault-injection registry (GET snapshot; POST "
+                         '{"install": {...}} / {"spec": "..."} / '
+                         '{"clear": true} / {"seed": N} — chaos tests)',
         "/metrics": "Prometheus text exposition of the metrics registry",
     }
 
@@ -315,6 +333,8 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self._qs().get("n", "32"))
             self._send(200, json.dumps(self.node.slow_log.recent(n),
                                        default=str).encode())
+        elif path == "/debug/faults":
+            self._send(200, json.dumps(faults.GLOBAL.snapshot()).encode())
         elif path in ("", "/ui"):
             # embedded query console (reference: the static dashboard
             # served by dgraph/cmd/server/dashboard.go)
@@ -347,11 +367,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._admin_shutdown()
             elif path == "/admin/config/memory_mb":
                 self._admin_memory()
+            elif path == "/debug/faults":
+                self._debug_faults()
             else:
                 self._send(404, _envelope_err("ErrorInvalidRequest",
                                               "no such path"))
         except TxnConflict as e:
             self._send(409, _envelope_err("ErrorAborted", str(e)))
+        except DeadlineExceeded as e:
+            # the request's ?timeoutMs= / --default_timeout_ms budget ran
+            # out — typed, bounded, never a hang (504 Gateway Timeout)
+            self._send(504, _envelope_err("ErrorDeadlineExceeded", str(e)))
+        except ResourceExhausted as e:
+            # shed under overload before consuming device time (429)
+            self._send(429, _envelope_err("ErrorResourceExhausted", str(e)))
         except Exception as e:  # surface parse/exec errors in the envelope
             self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
         finally:
@@ -399,6 +428,30 @@ class _Handler(BaseHTTPRequestHandler):
             {"code": "Success", "message": "Server is shutting down"}).encode())
         threading.Thread(target=self.server.shutdown, daemon=True).start()
 
+    def _debug_faults(self):
+        """Drive the process-global fault-injection registry over HTTP
+        (utils/faults.py; the chaos harness' live-process lever). Body:
+        {"seed": N} reseeds the deterministic PRNG, {"spec": "name:mode:
+        p[:delay_s][:count],..."} or {"install": {"name":..., "mode":...,
+        "p":..., "delay_s":..., "count":...}} arms points, {"clear": true
+        | "name"} disarms."""
+        j = json.loads(self._read_body() or "{}")
+        if "seed" in j:
+            faults.GLOBAL.reseed(int(j["seed"]))
+        if j.get("spec"):
+            faults.GLOBAL.configure(j["spec"])
+        if j.get("install"):
+            ins = dict(j["install"])
+            faults.GLOBAL.install(
+                ins["name"], ins.get("mode", "error"),
+                p=float(ins.get("p", 1.0)),
+                delay_s=float(ins.get("delay_s", 0.0)),
+                count=ins.get("count"))
+        clear = j.get("clear")
+        if clear:
+            faults.GLOBAL.clear(None if clear is True else str(clear))
+        self._send(200, json.dumps(faults.GLOBAL.snapshot()).encode())
+
     def _admin_memory(self):
         """Live memory budget reconfig + enforcement pass (the reference's
         POST /admin/config/memory_mb, admin.go)."""
@@ -425,11 +478,13 @@ class _Handler(BaseHTTPRequestHandler):
         ro = qs.get("ro", qs.get("readOnly", "")).lower() == "true"
         edge_limit = qs.get("edgeLimit")   # per-request edge budget override
         explain = qs.get("explain", "").lower() == "true"
+        timeout_ms = qs.get("timeoutMs")   # per-request deadline budget
         t0 = time.perf_counter_ns()
         out, ctx = self.node.query(
             q, variables, int(start_ts) if start_ts else None, read_only=ro,
             edge_limit=int(edge_limit) if edge_limit else None,
-            explain=explain)
+            explain=explain,
+            timeout_ms=float(timeout_ms) if timeout_ms else None)
         ext = {"txn": {"start_ts": ctx.start_ts},
                "server_latency": {"total_ns": time.perf_counter_ns() - t0}}
         if explain:
@@ -445,11 +500,14 @@ class _Handler(BaseHTTPRequestHandler):
                       or self.headers.get("X-Dgraph-CommitNow", "").lower()
                       == "true")
         start_ts = int(qs["startTs"]) if "startTs" in qs else None
+        timeout_ms = (float(qs["timeoutMs"])
+                      if qs.get("timeoutMs") else None)
         if self.headers.get("Content-Type", "").startswith("application/json"):
             j = json.loads(body)
             res = self.node.mutate(
                 set_json=j.get("set"), delete_json=j.get("delete"),
-                commit_now=commit_now, start_ts=start_ts)
+                commit_now=commit_now, start_ts=start_ts,
+                timeout_ms=timeout_ms)
             uids, ctx = res.uids, res.context
         elif body.lstrip().startswith("upsert"):
             # DQL upsert block through /mutate (dgraph/cmd/server/http.go
@@ -462,7 +520,8 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             sets, dels = _split_mutation_blocks(body)
             res = self.node.mutate(set_nquads=sets, del_nquads=dels,
-                                   commit_now=commit_now, start_ts=start_ts)
+                                   commit_now=commit_now, start_ts=start_ts,
+                                   timeout_ms=timeout_ms)
             uids, ctx = res.uids, res.context
         self._send(200, _envelope_ok(
             {"code": "Success", "message": "Done",

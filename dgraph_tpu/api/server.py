@@ -91,7 +91,8 @@ class Node:
                  slow_query_ms: float = 0.0,
                  slow_query_log: str | None = None,
                  mesh_devices: int = 0,
-                 mesh_min_edges: int | None = None) -> None:
+                 mesh_min_edges: int | None = None,
+                 default_timeout_ms: float = 0.0) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -129,6 +130,12 @@ class Node:
         # (--no_planner) restores exact parse-order execution.
         self.planner_enabled = planner
         self.stats_top_k = int(stats_top_k)
+        # request lifelines (ISSUE 7): a per-request deadline budget
+        # (query/mutate timeout_ms arg, HTTP ?timeoutMs=, --default_
+        # timeout_ms flag) consumed at the dispatch gate + task seams;
+        # overruns are typed DeadlineExceeded, overload sheds typed
+        # ResourceExhausted — never a hang. 0 = unbudgeted.
+        self.default_timeout_ms = float(default_timeout_ms)
         self._txns: dict[int, TxnContext] = {}
         self._lock = threading.RLock()       # commit/read linearization
         self._inflight_cv = threading.Condition(self._lock)
@@ -437,11 +444,21 @@ class Node:
                 snap = self.snapshot(read_ts)
         return read_ts, snap
 
+    def _deadline_scope(self, timeout_ms: float | None):
+        """Deadline scope for one request: explicit timeout_ms beats the
+        node default; 0/None = unbudgeted (a no-op scope)."""
+        from dgraph_tpu.utils import deadline as dl
+
+        ms = self.default_timeout_ms if timeout_ms is None \
+            else float(timeout_ms)
+        return dl.scope(ms / 1000.0 if ms and ms > 0 else None)
+
     def query(self, q: str, variables: dict | None = None,
               start_ts: int | None = None,
               read_only: bool = False,
               edge_limit: int | None = None,
-              explain: bool = False) -> tuple[dict, TxnContext]:
+              explain: bool = False,
+              timeout_ms: float | None = None) -> tuple[dict, TxnContext]:
         """Parse + execute a DQL request (edgraph/server.go:373).
 
         read_only treats start_ts purely as a snapshot timestamp: it never
@@ -466,7 +483,7 @@ class Node:
         t0 = time.perf_counter()
         err = ""
         try:
-          with sp:
+          with sp, self._deadline_scope(timeout_ms):
             req = self._parse(q, variables)
             tr.printf("parsed: %d query blocks", len(req.queries))
             if req.upsert is not None:
@@ -563,6 +580,10 @@ class Node:
             # error, exactly once, via the finally below — including
             # TxnConflict from the upsert path and non-Exception bases
             err = str(e) or type(e).__name__
+            from dgraph_tpu.utils.deadline import DeadlineExceeded
+
+            if isinstance(e, DeadlineExceeded):
+                m.counter("dgraph_deadline_exceeded_total").inc()
             raise
         finally:
             m.counter("dgraph_pending_queries_total").dec()
@@ -636,7 +657,8 @@ class Node:
 
     def mutate(self, set_nquads: str = "", del_nquads: str = "",
                set_json=None, delete_json=None, commit_now: bool = False,
-               start_ts: int | None = None) -> MutationResult:
+               start_ts: int | None = None,
+               timeout_ms: float | None = None) -> MutationResult:
         """Buffer (and optionally commit) one mutation (server.go:267)."""
         nquads_set = rdf.parse(set_nquads) if set_nquads else []
         nquads_del = rdf.parse(del_nquads) if del_nquads else []
@@ -645,11 +667,13 @@ class Node:
         if delete_json is not None:
             nquads_del += mut.nquads_from_json(delete_json, Op.DEL)
         return self.mutate_quads(nquads_set, nquads_del,
-                                 commit_now=commit_now, start_ts=start_ts)
+                                 commit_now=commit_now, start_ts=start_ts,
+                                 timeout_ms=timeout_ms)
 
     def mutate_quads(self, nquads_set, nquads_del=(), *,
                      commit_now: bool = False,
-                     start_ts: int | None = None) -> MutationResult:
+                     start_ts: int | None = None,
+                     timeout_ms: float | None = None) -> MutationResult:
         """Mutate with pre-parsed NQuads (the loaders' entry — skips text
         parsing; dgraph/cmd/live/batch.go feeds api.Mutation.Set directly)."""
         nquads_set = list(nquads_set)
@@ -667,7 +691,7 @@ class Node:
         t0 = time.perf_counter()
         err = ""
         try:
-          with sp:
+          with sp, self._deadline_scope(timeout_ms):
             with self._lock:
                 if start_ts is None:
                     ctx = self.new_txn()

@@ -24,7 +24,11 @@ except ImportError:              # pragma: no cover
 
 from ..obs import otrace
 from ..protos import internal_pb2 as ipb
+from ..utils import deadline as dl
+from ..utils import faults
 from ..utils.ballot import tally as _tally
+from ..utils.deadline import DeadlineExceeded
+from ..utils.retry import backoff_s
 from .zero import TxnConflict, TxnNotFound, Zero
 
 SERVICE = "dgraph_tpu.internal.Zero"
@@ -823,32 +827,66 @@ class ZeroClient:
             return self._rpc_raw(stub_name, req, timeout, rsp)
 
     def _rpc_raw(self, stub_name: str, req, timeout: float, rsp):
+        import random as _random
+
         last = None
-        for _ in range(max(2 * len(self.addrs), 1)):
+        for attempt in range(max(2 * len(self.addrs), 1)):
+            # budgeted callers never start an attempt past their deadline
+            # — a pre-send check is unambiguous (nothing went out)
+            dl.check(f"zero:{self._STUBS[stub_name][0]}")
+            faults.fire("zero.rpc")
             try:
                 stub = getattr(self, stub_name)
+                call_timeout = dl.clamp(timeout)
+                if call_timeout <= 0:
+                    # budget hit zero between the check above and here:
+                    # a pre-send raise is unambiguous (nothing went out),
+                    # unlike falling back to the full unclamped timeout
+                    raise DeadlineExceeded(
+                        f"zero:{self._STUBS[stub_name][0]} budget "
+                        "exhausted before send")
+                md = []
+                ddl = dl.to_metadata()
+                if ddl is not None:
+                    md.append(ddl)
                 if rsp is None:
-                    return stub(req, timeout=timeout)
+                    if not md:
+                        return stub(req, timeout=call_timeout)
+                    return stub(req, timeout=call_timeout,
+                                metadata=tuple(md))
+                md.append((otrace.WIRE_KEY,
+                           f"{rsp.trace_id}:{rsp.span_id}"))
                 resp, call = stub.with_call(
-                    req, timeout=timeout,
-                    metadata=((otrace.WIRE_KEY,
-                               f"{rsp.trace_id}:{rsp.span_id}"),))
+                    req, timeout=call_timeout, metadata=tuple(md))
                 for k, v in call.trailing_metadata() or ():
                     if k == otrace.SPANS_KEY:
                         rsp.tracer.add_remote(otrace.decode_spans(v))
                 return resp
             except grpc.RpcError as e:
                 code = e.code()
+                # explicit DEADLINE_EXCEEDED handling: an in-flight
+                # timeout is ambiguous — re-firing a CommitOrAbort or
+                # AssignUids that DID land would corrupt txn/lease state —
+                # so it surfaces, typed, with NO rotation retry.
+                if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    raise DeadlineExceeded(
+                        f"zero:{self._STUBS[stub_name][0]} deadline "
+                        f"exceeded at {self.addr}") from e
                 # rotate only on signals that the call was NOT processed
-                # (dead zero / standby rejection). DEADLINE_EXCEEDED is
-                # ambiguous — re-firing a CommitOrAbort or AssignUids that
-                # DID land would corrupt txn/lease state, so it surfaces.
+                # (dead zero / standby rejection), with full-jitter
+                # backoff between attempts so a thundering herd of
+                # clients doesn't re-dogpile the surviving zero in step
                 if len(self.addrs) > 1 and code in (
                         grpc.StatusCode.UNAVAILABLE,
                         grpc.StatusCode.FAILED_PRECONDITION):
                     last = e
                     self._rotate()
-                    time.sleep(0.2)
+                    pause = backoff_s(attempt, base_s=0.05, cap_s=0.5,
+                                      rng=_random)
+                    rem = dl.remaining()
+                    if rem is not None and pause >= rem:
+                        raise      # sleeping would blow the budget
+                    time.sleep(pause)
                     continue
                 raise
         raise last

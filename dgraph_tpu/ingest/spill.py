@@ -133,6 +133,12 @@ class SpillSet:
             self.on_flush(self.stats)
 
     def new_run_path(self) -> str:
+        from dgraph_tpu.utils import faults
+
+        # disk fault seam: every spill-run write starts here, so a failing
+        # or slow scratch disk surfaces as a typed error / stall at the
+        # exact point a real ENOSPC/slow-NFS would
+        faults.fire("disk.spill")
         return os.path.join(self.tmp_dir, f"run{next(self._names):06d}.spl")
 
 

@@ -22,6 +22,9 @@ from ..query import rdf
 from ..query.engine import Executor
 from ..storage.csr_build import GraphSnapshot
 from ..storage.postings import Op
+from ..utils import deadline as dl
+from ..utils.deadline import DeadlineExceeded
+from ..utils.retry import CommitAmbiguous, RetryPolicy, transport_errors
 from ..utils.schema import SchemaState, parse_schema
 from .remote import NetworkDispatcher, RemoteWorker
 
@@ -70,6 +73,18 @@ class _CachedZero:
         return getattr(self._zero, name)
 
 
+class _FrozenZero:
+    """Degraded-mode tablet routing: the last tablet map this client saw,
+    frozen. Only the read fan-out consults it (NetworkDispatcher.tablets);
+    anything that would need the LIVE coordinator raises."""
+
+    def __init__(self, tablet_map: dict) -> None:
+        self._tablets = {a: int(g) for a, g in (tablet_map or {}).items()}
+
+    def tablets(self) -> dict[str, int]:
+        return self._tablets
+
+
 class ClusterClient:
     """Client of one Zero process + N group replica sets."""
 
@@ -78,18 +93,30 @@ class ClusterClient:
 
     def __init__(self, zero_addr: str,
                  groups: dict[int, list[str]],
-                 span_sample: float = 0.0, trace_rng=None) -> None:
+                 span_sample: float = 0.0, trace_rng=None,
+                 default_timeout_ms: float = 0.0,
+                 degraded_reads: bool = True,
+                 retry_rng=None) -> None:
         """groups: group id -> replica worker addresses (leader discovered
         via Status polling, re-discovered on failover). Each group is a
         HedgedReplicas set: reads hedge to a second replica after a grace
         period, a background echo loop feeds routing (worker/task.go:75,
-        conn/pool.go:153)."""
+        conn/pool.go:153).
+
+        default_timeout_ms > 0 gives every query/mutate without an
+        explicit timeout_ms an end-to-end deadline (utils/deadline) —
+        propagated over every RPC, consumed at every wait point, typed
+        DeadlineExceeded on overrun. degraded_reads keeps queries serving
+        from the last known Zero state (read-only, stale snapshot,
+        annotated via `last_degraded`) when Zero stops answering, instead
+        of erroring outright."""
         from .remote import HedgedReplicas
         from ..query.qcache import DispatchGate, TaskResultCache
         from ..utils import metrics as metrics_mod
 
+        self.metrics = metrics_mod.Registry()
         self.zero = _CachedZero(ZeroClient(zero_addr))
-        self.replicas = {g: HedgedReplicas(addrs)
+        self.replicas = {g: HedgedReplicas(addrs, metrics=self.metrics)
                          for g, addrs in groups.items()}
         self.groups = {g: hr.workers for g, hr in self.replicas.items()}
         self._leases = _LeaseAdapter(self.zero)
@@ -97,14 +124,26 @@ class ClusterClient:
         # client-side serving tier: replayed task shapes skip the wire,
         # concurrent identical tasks share one RPC, and the gate bounds
         # simultaneous fan-out RPCs per client
-        self.metrics = metrics_mod.Registry()
         self.task_cache = TaskResultCache(32 << 20, self.metrics)
         self.dispatch_gate = DispatchGate(8, self.metrics)
+        # request lifelines (ISSUE 7)
+        self.default_timeout_ms = float(default_timeout_ms)
+        self.degraded_reads = degraded_reads
+        self.last_degraded: dict | None = None   # set per degraded query
+        self._last_zstate: tuple[float, dict] | None = None
+        self._retry_rng = retry_rng      # injectable backoff jitter source
         # distributed tracing: a sampled query roots its trace here and
         # assembles the full cross-process tree (worker + zero spans ride
         # back over RPC trailing metadata) in tracer.sink
         self.tracer = otrace.Tracer(fraction=span_sample, proc="client",
                                     rng=trace_rng)
+
+    def _scope(self, timeout_ms: float | None):
+        """Deadline scope for one request: explicit timeout_ms beats the
+        client default; 0/None = unbudgeted."""
+        ms = self.default_timeout_ms if timeout_ms is None \
+            else float(timeout_ms)
+        return dl.scope(ms / 1000.0 if ms and ms > 0 else None)
 
     def _invalidate(self) -> None:
         for hr in self.replicas.values():
@@ -148,28 +187,39 @@ class ClusterClient:
     # -- writes --------------------------------------------------------------
 
     def mutate(self, set_nquads: str = "", del_nquads: str = "",
-               retries: int = 5) -> dict[str, int]:
+               retries: int = 5,
+               timeout_ms: float | None = None) -> dict[str, int]:
         """One txn over the wire: Zero NewTxn → per-group Mutate → Zero
         CommitOrAbort → per-group Decide. Leader failures retry after
-        re-discovery (the reference client's abort-retry loop)."""
+        re-discovery through the unified RetryPolicy (jittered exponential
+        backoff); ONLY transport-shaped failures and NoQuorum retry — a
+        programming error surfaces on the first throw, and the retrying
+        STOPS the moment the commit decision becomes ambiguous
+        (CommitAmbiguous: re-running the txn could apply it twice)."""
         nq_set = rdf.parse(set_nquads) if set_nquads else []
         nq_del = rdf.parse(del_nquads) if del_nquads else []
-        with self.tracer.root("mutate",
-                              attrs={"set": len(nq_set),
-                                     "delete": len(nq_del)}):
-            last: Exception | None = None
-            for _attempt in range(retries):
-                try:
-                    return self._mutate_once(nq_set, nq_del)
-                except TxnConflict:
-                    raise
-                except Exception as e:       # leader died / NoQuorum: retry
-                    last = e
-                    self._invalidate()   # re-discover leaders + tablet map
-                    time.sleep(0.1)
-            raise last if last else RuntimeError("mutate failed")
+        with self._scope(timeout_ms), \
+                self.tracer.root("mutate",
+                                 attrs={"set": len(nq_set),
+                                        "delete": len(nq_del)}):
+            policy = RetryPolicy(max_attempts=max(1, int(retries)),
+                                 base_s=0.05, cap_s=1.0,
+                                 metrics=self.metrics,
+                                 rng=self._retry_rng, name="mutate")
+            try:
+                return policy.run(
+                    lambda: self._mutate_once(nq_set, nq_del),
+                    retryable=transport_errors(),
+                    abort_on=(TxnConflict,),
+                    # re-discover leaders + tablet map before re-attempting
+                    on_retry=lambda _e: self._invalidate())
+            except DeadlineExceeded:
+                self.metrics.counter("dgraph_deadline_exceeded_total").inc()
+                raise
 
     def _mutate_once(self, nq_set, nq_del) -> dict[str, int]:
+        import grpc as _grpc
+
         start_ts = self.zero.new_txn()
         uid_map = mut.assign_uids(nq_set + nq_del, self._leases)
         edges = mut.to_edges(nq_set, uid_map, Op.SET) + \
@@ -185,10 +235,30 @@ class ClusterClient:
                 keys_by_group[g] = list(resp.keys)
                 conflicts += list(resp.conflict_keys)
                 preds |= set(resp.preds)
-            commit_ts = self.zero.commit(start_ts, conflicts, preds)
+            try:
+                commit_ts = self.zero.commit(start_ts, conflicts, preds)
+            except DeadlineExceeded as e:
+                # ZeroClient translates a wire DEADLINE_EXCEEDED into the
+                # typed error with the RpcError as __cause__; a PRE-SEND
+                # budget check raises it bare. Only the in-flight shape is
+                # ambiguous — the oracle may or may not have decided, so
+                # neither aborting nor retrying is safe.
+                if isinstance(e.__cause__, _grpc.RpcError):
+                    raise CommitAmbiguous(
+                        f"txn {start_ts}: commit outcome unknown "
+                        f"(in-flight timeout)") from e
+                raise       # nothing was sent: the abort path below is safe
+            except _grpc.RpcError as e:
+                if e.code() == _grpc.StatusCode.DEADLINE_EXCEEDED:
+                    raise CommitAmbiguous(
+                        f"txn {start_ts}: commit outcome unknown "
+                        f"(in-flight timeout)") from e
+                raise
         except TxnConflict:
             self._decide_all(start_ts, 0, keys_by_group)
             raise
+        except CommitAmbiguous:
+            raise                # no abort: the commit may have landed
         except BaseException:
             self._decide_all(start_ts, 0, keys_by_group)
             try:
@@ -205,35 +275,95 @@ class ClusterClient:
         for g, keys in sorted(keys_by_group.items()):
             try:
                 self.leader_of(g).decide(start_ts, commit_ts, keys)
-            except Exception:
+            except Exception as e:
                 if commit_ts:
-                    raise            # a lost commit decision must surface
+                    # the txn COMMITTED at the oracle but this group never
+                    # heard the decision: surface it typed and
+                    # non-retryable (a retried mutate would re-apply the
+                    # txn under fresh uids). Reads self-heal via the
+                    # hedger's lost-Decide fallback.
+                    raise CommitAmbiguous(
+                        f"txn {start_ts} committed at ts {commit_ts} but "
+                        f"the Decide fan-out to group {g} failed") from e
                 # lost aborts are safe: layers stay buffered until reaped
 
     # -- reads ---------------------------------------------------------------
 
-    def query(self, q: str, variables: dict | None = None) -> dict:
+    def query(self, q: str, variables: dict | None = None,
+              timeout_ms: float | None = None) -> dict:
         """DQL with every uid/value task dispatched over ServeTask — the
         client holds NO local tablet (all-remote NetworkDispatcher). A
         transport failure (e.g. cached leader died) invalidates the
-        leader/tablet caches and retries once against fresh discovery."""
+        leader/tablet caches and retries once against fresh discovery.
+
+        With a deadline armed (timeout_ms / default_timeout_ms) the whole
+        request — fan-out, hedges, watermark waits, gate acquisition — is
+        bounded by one budget; overrunning it raises the typed
+        DeadlineExceeded (a worker-side DEADLINE_EXCEEDED status is
+        translated to the same type), never a hang."""
         import grpc as _grpc
 
-        transport_errors = (_grpc.RpcError, ConnectionError, OSError,
-                            RuntimeError)   # RuntimeError: no live leader
+        # ONE transport-failure policy, shared with the mutate retry path
+        # (utils/retry.transport_errors: RpcError, ConnectionError,
+        # OSError, TimeoutError, NoQuorum, RuntimeError-as-routing-error)
+        transport = transport_errors()
         qtitle = q.strip().splitlines()[0][:120] if q.strip() else ""
-        with self.tracer.root("query", kind="client",
-                              attrs={"query": qtitle}):
-            for attempt in (0, 1):
-                try:
-                    return self._query_once(q, variables)
-                except transport_errors:
-                    # parse/semantic errors propagate directly — only
-                    # transport failures warrant cache invalidation + a
-                    # second fan-out
-                    if attempt:
+        self.last_degraded = None
+        with self._scope(timeout_ms), \
+                self.tracer.root("query", kind="client",
+                                 attrs={"query": qtitle}):
+            try:
+                for attempt in (0, 1):
+                    try:
+                        return self._query_once(q, variables)
+                    except DeadlineExceeded:
                         raise
-                    self._invalidate()
+                    except transport as e:
+                        # parse/semantic errors propagate directly — only
+                        # transport failures warrant cache invalidation +
+                        # a second fan-out; a wire DEADLINE_EXCEEDED is
+                        # the budget talking, not the transport: typed,
+                        # and never worth a second full fan-out
+                        if isinstance(e, _grpc.RpcError) and e.code() == \
+                                _grpc.StatusCode.DEADLINE_EXCEEDED:
+                            raise DeadlineExceeded(str(e)) from e
+                        if attempt:
+                            raise
+                        self._invalidate()
+            except DeadlineExceeded:
+                self.metrics.counter("dgraph_deadline_exceeded_total").inc()
+                raise
+
+    def _zero_view(self) -> tuple[dict, dict | None]:
+        """Zero's state for one read — live when possible, else (degraded
+        mode) the last state this client saw. Degraded reads are read-only
+        snapshot serving: results may be stale by `staleness_s` but every
+        floor/ts they use was once true, so they are never WRONG — and the
+        staleness is annotated (returned per-request, mirrored on
+        `last_degraded` for observability) rather than erroring outright
+        while the coordinator recovers quorum. Returns (zstate,
+        degraded-info-or-None)."""
+        import grpc as _grpc
+
+        try:
+            zstate = self.zero.state()
+            self._last_zstate = (time.monotonic(), zstate)
+            return zstate, None
+        except (_grpc.RpcError, ConnectionError, OSError) as e:
+            if isinstance(e, _grpc.RpcError) and e.code() == \
+                    _grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise DeadlineExceeded(str(e)) from e
+            if not self.degraded_reads or self._last_zstate is None:
+                raise
+            at, zstate = self._last_zstate
+            staleness = time.monotonic() - at
+            info = {"degraded": True,
+                    "staleness_s": round(staleness, 3),
+                    "reason": type(e).__name__}
+            self.metrics.counter("dgraph_degraded_reads_total").inc()
+            otrace.event("degraded_read",
+                         staleness_s=round(staleness, 3))
+            return zstate, info
 
     def _query_once(self, q: str, variables: dict | None) -> dict:
         parsed = dql.parse(q, variables)
@@ -244,12 +374,20 @@ class ClusterClient:
             from ..utils.schema import schema_json
 
             return {"schema": schema_json(schema, parsed.schema_request)}
-        zstate = self.zero.state()
+        zstate, degraded = self._zero_view()
         read_ts = int(zstate.get("maxTxnTs", 0))
         floors = {k: int(v)
                   for k, v in zstate.get("predCommit", {}).items()}
+        zero = self.zero
+        if degraded is not None:
+            # Zero is unreachable: route from the last known tablet map
+            # instead of asking a dead coordinator per task. The local
+            # `degraded` drives routing (last_degraded is a shared
+            # observability mirror that concurrent requests may reset)
+            self.last_degraded = degraded
+            zero = _FrozenZero(zstate.get("tabletMap", {}))
         dispatcher = NetworkDispatcher(
-            self.zero, local_group=-1,
+            zero, local_group=-1,
             local_snap_fn=lambda ts: GraphSnapshot(ts),
             remotes=dict(self.replicas),
             schema=schema, pred_floors=floors,

@@ -27,7 +27,10 @@ except ImportError:              # pragma: no cover
 
 from ..obs import otrace
 from ..protos import internal_pb2 as ipb
+from ..utils import deadline as dl
+from ..utils import faults
 from ..utils.ballot import tally as _tally
+from ..utils.retry import CircuitBreaker
 from ..query.task import TaskQuery, TaskResult, process_task
 from ..storage.csr_build import STRUCTURAL_RECORDS
 from ..storage.store import decode_record
@@ -263,20 +266,28 @@ class WorkerService:
         waits, cache hits, device kernels — part of the caller's trace;
         the collected spans return in trailing metadata. An aborted RPC
         (gate timeout) cannot carry trailing metadata: the spans drop but
-        the buffer drains either way (no leak on mid-fan-out failures)."""
+        the buffer drains either way (no leak on mid-fan-out failures).
+
+        Deadline continuation rides the same metadata channel: the
+        caller's remaining budget (utils/deadline WIRE_KEY) installs a
+        server-side deadline scope so every wait this handler performs —
+        the applied-watermark gate above all — is bounded by it."""
         wire = None
+        budget = None
         if context is not None:
-            for k, v in context.invocation_metadata() or ():
+            md = context.invocation_metadata() or ()
+            for k, v in md:
                 if k == otrace.WIRE_KEY:
                     wire = v
-                    break
+            budget = dl.from_metadata(md)
         if not wire:
-            return self._serve_task_inner(msg, context)
+            with dl.scope(budget):
+                return self._serve_task_inner(msg, context)
         sp = self.tracer.join(wire, "serve_task",
                               attrs={"attr": msg.attr,
                                      "addr": self.advertise_addr})
         try:
-            with sp:
+            with sp, dl.scope(budget):
                 return self._serve_task_inner(msg, context)
         finally:
             spans = self.tracer.take(sp.trace_id)
@@ -289,6 +300,7 @@ class WorkerService:
 
     def _serve_task_inner(self, msg: ipb.TaskRequest,
                           context) -> ipb.TaskResponse:
+        faults.fire("worker.serve_task", m=self.metrics)
         q, read_ts = decode_task(msg)
         if msg.min_applied:
             attr = q.attr[1:] if q.attr.startswith("~") else q.attr
@@ -300,16 +312,29 @@ class WorkerService:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                   f"replica busy catching up on {attr!r}")
                 try:
-                    deadline = time.monotonic() + self.APPLIED_WAIT
-                    while self.store.pred_commit_ts.get(attr, 0) \
-                            < msg.min_applied:
-                        if time.monotonic() >= deadline:
+                    # the wait is the per-predicate applied WaterMark
+                    # (utils/watermark.py): woken the instant the commit
+                    # applies instead of a 10ms poll loop, and bounded by
+                    # min(APPLIED_WAIT, the caller's remaining budget) so
+                    # a propagated deadline is honored server-side
+                    wait = dl.clamp(self.APPLIED_WAIT)
+                    caught_up = wait > 0 and \
+                        self.store.applied_mark(attr).wait_for_mark(
+                            int(msg.min_applied), timeout=wait)
+                    if not caught_up:
+                        rem = dl.remaining()
+                        if rem is not None and rem <= 0:
+                            self.metrics.counter(
+                                "dgraph_deadline_exceeded_total").inc()
                             context.abort(
-                                grpc.StatusCode.FAILED_PRECONDITION,
-                                f"replica behind on {attr!r}: applied "
-                                f"{self.store.pred_commit_ts.get(attr, 0)}"
-                                f" < {msg.min_applied}")
-                        time.sleep(0.01)
+                                grpc.StatusCode.DEADLINE_EXCEEDED,
+                                f"deadline exceeded waiting for {attr!r} "
+                                f"to apply {msg.min_applied}")
+                        context.abort(
+                            grpc.StatusCode.FAILED_PRECONDITION,
+                            f"replica behind on {attr!r}: applied "
+                            f"{self.store.pred_commit_ts.get(attr, 0)}"
+                            f" < {msg.min_applied}")
                 finally:
                     self._gate_slots.release()
         from ..query.qcache import task_token
@@ -333,6 +358,7 @@ class WorkerService:
         decided later by Decide."""
         from ..query import mutation as mut
 
+        faults.fire("worker.mutate", m=self.metrics)
         if self.term > 0 and not self.is_leader:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           f"not leader (term {self.term})")
@@ -1103,18 +1129,34 @@ class RemoteWorker:
 
     def process_task(self, q: TaskQuery, read_ts: int,
                      min_applied: int = 0) -> TaskResult:
+        """ServeTask with span AND deadline propagation: the caller's
+        remaining budget ships as invocation metadata (the server bounds
+        its own waits by it) and doubles as the gRPC per-call timeout, so
+        a blackholed peer costs exactly the remaining budget, never an
+        unbounded wait."""
+        faults.fire("rpc.send")
         msg = encode_task(q, read_ts, min_applied)
+        md = []
+        timeout = None
+        ddl = dl.to_metadata()
+        if ddl is not None:
+            dl.check(f"rpc:ServeTask {self.addr}")
+            md.append(ddl)
+            timeout = dl.clamp(None)
         sp = otrace.current()
         if sp is None:
-            return decode_result(self._serve(msg))
+            if not md:
+                return decode_result(self._serve(msg))
+            return decode_result(self._serve(msg, metadata=tuple(md),
+                                             timeout=timeout))
         # propagate the span context; the worker's spans ride back in
         # trailing metadata and graft into this trace's buffer
         with sp.tracer.start("rpc:ServeTask", parent=sp, kind="client",
                              attrs={"addr": self.addr,
                                     "attr": q.attr}) as rsp:
+            md.append((otrace.WIRE_KEY, f"{rsp.trace_id}:{rsp.span_id}"))
             resp, call = self._serve.with_call(
-                msg, metadata=((otrace.WIRE_KEY,
-                                f"{rsp.trace_id}:{rsp.span_id}"),))
+                msg, metadata=tuple(md), timeout=timeout)
             for k, v in call.trailing_metadata() or ():
                 if k == otrace.SPANS_KEY:
                     rsp.tracer.add_remote(otrace.decode_spans(v))
@@ -1123,12 +1165,23 @@ class RemoteWorker:
     def membership(self) -> ipb.MembershipResponse:
         return self._membership(ipb.MembershipRequest())
 
+    def _budgeted(self, stub, msg):
+        """Issue a write-path RPC under the caller's deadline: remaining
+        budget as the gRPC timeout + propagated metadata, so a blackholed
+        leader costs the budget, never an unbounded wait. Unbudgeted
+        callers keep the pre-existing no-timeout behavior."""
+        ddl = dl.to_metadata()
+        if ddl is None:
+            return stub(msg)
+        dl.check(f"rpc {self.addr}")
+        return stub(msg, metadata=(ddl,), timeout=dl.clamp(None))
+
     def mutate(self, start_ts: int, edges) -> ipb.MutateResponse:
-        return self._mutate(ipb.MutateRequest(
+        return self._budgeted(self._mutate, ipb.MutateRequest(
             start_ts=start_ts, edges=[encode_edge(e) for e in edges]))
 
     def decide(self, start_ts: int, commit_ts: int, keys) -> None:
-        self._decide(ipb.DecisionRequest(
+        self._budgeted(self._decide, ipb.DecisionRequest(
             start_ts=start_ts, commit_ts=commit_ts, keys=list(keys)))
 
     def close(self) -> None:
@@ -1149,13 +1202,30 @@ class HedgedReplicas:
 
     HEDGE_GRACE = 0.3        # seconds before the backup request fires
     HEALTH_INTERVAL = 2.0    # echo loop period
+    # breaker tuning: trip after this many consecutive transport failures,
+    # probe again after BREAKER_OPEN_S (half-open)
+    BREAKER_FAILS = 3
+    BREAKER_OPEN_S = 2.0
 
-    def __init__(self, addrs: list[str]) -> None:
+    def __init__(self, addrs: list[str], metrics=None) -> None:
+        from ..utils.metrics import Registry
+
         self.addrs = list(addrs)
         self.workers = [RemoteWorker(a) for a in addrs]
         self._ok = [True] * len(addrs)
         self._leader_idx = 0
         self._leader_confirmed = False
+        self.metrics = metrics if metrics is not None else Registry()
+        # per-replica circuit breakers fed by the same error/latency
+        # signals the hedger sees: an open breaker routes fan-out around a
+        # flapping replica instead of paying its timeout every request
+        self.breakers = [CircuitBreaker(fail_threshold=self.BREAKER_FAILS,
+                                        open_s=self.BREAKER_OPEN_S)
+                         for _ in addrs]
+        self._breaker_gauge = self.metrics.keyed("dgraph_breaker_state")
+        self._breaker_open = self.metrics.counter(
+            "dgraph_breaker_open_total")
+        self._hedges = self.metrics.counter("dgraph_hedge_fired_total")
         self._pool = futures.ThreadPoolExecutor(
             max_workers=max(2, 2 * len(addrs)))
         self._stop = threading.Event()
@@ -1165,6 +1235,30 @@ class HedgedReplicas:
             self._thread = threading.Thread(target=self._echo_loop,
                                             daemon=True)
             self._thread.start()
+
+    def _record(self, idx: int, ok: bool, latency_s: float | None = None,
+                e: Exception | None = None) -> None:
+        """Feed one replica outcome into its breaker. Application-level
+        refusals (FAILED_PRECONDITION: behind the floor / not leader) and
+        caller-budget exhaustion (DeadlineExceeded / wire
+        DEADLINE_EXCEEDED — the budget's fault, not the replica's) are
+        NOT transport faults and never trip the breaker; a genuinely slow
+        replica is caught by the latency soft-failure signal instead."""
+        if e is not None and (
+                self._is_behind(e)
+                or isinstance(e, dl.DeadlineExceeded)
+                or (isinstance(e, grpc.RpcError) and e.code() ==
+                    grpc.StatusCode.DEADLINE_EXCEEDED)):
+            return
+        br = self.breakers[idx]
+        was = br.state
+        br.record(ok, latency_s)
+        now = br.state
+        if now != was:
+            self._breaker_gauge.set(self.addrs[idx], now)
+            if now == CircuitBreaker.OPEN:
+                self._breaker_open.inc()
+                otrace.event("breaker_open", addr=self.addrs[idx])
 
     # -- health echo ---------------------------------------------------------
 
@@ -1177,8 +1271,12 @@ class HedgedReplicas:
                 if st.leader:
                     self._leader_idx = i
                     saw_leader = True
-            except Exception:
+                # the echo IS a breaker probe: a half-open replica whose
+                # Status answers closes without needing query traffic
+                self._record(i, True)
+            except Exception as e:
                 self._ok[i] = False
+                self._record(i, False, e=e)
         self._leader_confirmed = saw_leader
 
     def _echo_loop(self) -> None:
@@ -1213,16 +1311,32 @@ class HedgedReplicas:
 
     def _order(self) -> list[int]:
         """Primary first (leader if healthy, else first healthy), then the
-        healthy rest, then unhealthy as a last resort."""
+        healthy rest, then unhealthy as a last resort. Breaker routing is
+        POSITIONAL: an OPEN replica counts as unhealthy (fan-out routes
+        around it instead of paying its timeout), a HALF-OPEN one is
+        demoted behind every closed replica — it only sees the fallback
+        traffic that reaches it when healthier replicas fail, which is
+        the probe. Recovery without traffic comes from the Status echo
+        loop (_poll_once feeds the breakers). Ordering never consumes
+        allow() probe tokens — an order slot is not a dial."""
         n = len(self.workers)
-        healthy = [i for i in range(n) if self._ok[i]]
-        if not healthy:
-            healthy = list(range(n))
-        if self._leader_idx in healthy:
+        closed, half = [], []
+        for i in range(n):
+            if not self._ok[i]:
+                continue
+            st = self.breakers[i].state
+            if st == CircuitBreaker.OPEN:
+                continue
+            (half if st == CircuitBreaker.HALF_OPEN else closed).append(i)
+        if self._leader_idx in closed:
             order = [self._leader_idx] + \
-                [i for i in healthy if i != self._leader_idx]
+                [i for i in closed if i != self._leader_idx] + half
         else:
-            order = healthy
+            order = closed + half
+        if not order:
+            order = [i for i in range(n) if self._ok[i]]
+        if not order:
+            order = list(range(n))
         order += [i for i in range(n) if i not in order]
         return order
 
@@ -1231,20 +1345,33 @@ class HedgedReplicas:
         return (isinstance(e, grpc.RpcError)
                 and e.code() == grpc.StatusCode.FAILED_PRECONDITION)
 
+    def _call(self, idx: int, q, read_ts: int,
+              min_applied: int) -> TaskResult:
+        """One replica attempt, feeding its breaker with the outcome and
+        latency (the hedger's own signals)."""
+        t0 = time.monotonic()
+        try:
+            res = self.workers[idx].process_task(q, read_ts, min_applied)
+        except Exception as e:
+            self._record(idx, False, e=e)
+            raise
+        self._record(idx, True, time.monotonic() - t0)
+        return res
+
     def _leader_only(self, q, read_ts: int) -> TaskResult:
         try:
             rw = self.leader_worker()
+            idx = self.workers.index(rw)
         except RuntimeError:
-            rw = self.workers[self._order()[0]]
-        return rw.process_task(q, read_ts, 0)
+            idx = self._order()[0]
+        return self._call(idx, q, read_ts, 0)
 
     def process_task(self, q: TaskQuery, read_ts: int,
                      min_applied: int = 0) -> TaskResult:
         order = self._order()
         if len(order) == 1:
-            rw = self.workers[order[0]]
             try:
-                return rw.process_task(q, read_ts, min_applied)
+                return self._call(order[0], q, read_ts, min_applied)
             except Exception as e:
                 if min_applied > 0 and self._is_behind(e):
                     # the sole replica is behind the commit floor after
@@ -1252,7 +1379,7 @@ class HedgedReplicas:
                     # tablet, this is the lost-Decide shape the
                     # multi-replica path already falls back on — retry
                     # once without the floor and serve its best state
-                    return rw.process_task(q, read_ts, 0)
+                    return self._call(order[0], q, read_ts, 0)
                 raise
         if min_applied <= 0:
             # no commit floor known for this tablet (cold cluster / Zero
@@ -1260,13 +1387,28 @@ class HedgedReplicas:
             # hedge to followers — same routing as the pre-hedging client
             return self._leader_only(q, read_ts)
         errs: list[Exception] = []
+        rem = dl.remaining()
+        if rem is not None and rem <= self.HEDGE_GRACE:
+            # a hedge needs at least one grace period of budget; below
+            # that the backup request could never beat the deadline —
+            # fail over SEQUENTIALLY within what remains instead
+            dl.check("hedged read")
+            for idx in order:
+                try:
+                    return self._call(idx, q, read_ts, min_applied)
+                except Exception as e:
+                    errs.append(e)
+                    if dl.remaining() <= 0:
+                        break
+            if errs and all(self._is_behind(e) for e in errs):
+                return self._leader_only(q, read_ts)
+            raise errs[-1]
         res = self._hedged_pair(q, read_ts, min_applied, order, errs)
         if res is not None:
             return res
         for idx in order[2:]:    # remaining replicas, sequentially
             try:
-                return self.workers[idx].process_task(q, read_ts,
-                                                      min_applied)
+                return self._call(idx, q, read_ts, min_applied)
             except Exception as e:
                 errs.append(e)
         if errs and all(self._is_behind(e) for e in errs):
@@ -1280,20 +1422,32 @@ class HedgedReplicas:
 
     def _hedged_pair(self, q, read_ts, min_applied, order,
                      errs) -> TaskResult | None:
-        f1 = self._submit(self.workers[order[0]].process_task, q,
-                          read_ts, min_applied)
+        f1 = self._submit(self._call, order[0], q, read_ts, min_applied)
         try:
-            return f1.result(timeout=self.HEDGE_GRACE)
+            # grace clamps to the remaining budget so a hedged read never
+            # waits past its deadline before even firing the backup
+            return f1.result(timeout=dl.clamp(self.HEDGE_GRACE))
         except futures.TimeoutError:
             pending = {f1}       # slow primary: fire the backup request
+            self._hedges.inc()
+            otrace.event("hedge", addr=self.addrs[order[1]],
+                         attr=q.attr)
         except Exception as e:
             errs.append(e)
             pending = set()
-        pending.add(self._submit(self.workers[order[1]].process_task,
-                                 q, read_ts, min_applied))
+        pending.add(self._submit(self._call, order[1], q, read_ts,
+                                 min_applied))
         while pending:
             done, pending = futures.wait(
-                pending, return_when=futures.FIRST_COMPLETED)
+                pending, return_when=futures.FIRST_COMPLETED,
+                timeout=dl.clamp(None))
+            if not done:
+                # budget ran out mid-hedge: the in-flight RPCs carry
+                # their own clamped timeouts and will drain on their own
+                from ..utils.deadline import DeadlineExceeded
+
+                raise DeadlineExceeded("hedged read: deadline exceeded "
+                                       "waiting for replicas")
             for f in done:
                 try:
                     return f.result()

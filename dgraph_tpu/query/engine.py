@@ -138,10 +138,18 @@ class Executor:
     def _traced_dispatch(inner):
         """Span per task at the dispatch seam: cache tiers and the gate run
         INSIDE the span, so cache hit/miss and wait time are attributed to
-        the task that caused them. One contextvar read when unsampled."""
+        the task that caused them. One contextvar read when unsampled.
+
+        The per-task deadline check lives here too: a budgeted multi-hop
+        query gives up BETWEEN tasks the moment its budget runs out (typed
+        DeadlineExceeded) — even when every remaining task would be a
+        cache hit — instead of finishing work nobody is waiting for."""
         from dgraph_tpu.obs import otrace
+        from dgraph_tpu.utils import deadline as _dl
 
         def traced(q):
+            if _dl.current() is not None:      # unbudgeted: zero cost
+                _dl.check(f"task:{q.attr}")
             if otrace.current() is None:
                 return inner(q)
             attrs = {"attr": q.attr}

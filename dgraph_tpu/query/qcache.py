@@ -37,12 +37,16 @@ from __future__ import annotations
 import itertools
 import json
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
 
 from dgraph_tpu.obs import otrace
 from dgraph_tpu.query.task import TaskQuery, TaskResult
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils import faults
+from dgraph_tpu.utils.deadline import DeadlineExceeded, ResourceExhausted
 
 # ---------------------------------------------------------------------------
 # snapshot tokens
@@ -349,25 +353,99 @@ class DispatchGate:
     """Bounds simultaneous device dispatches. A query's host orchestration
     runs unbounded; only the device-step critical sections funnel through
     the gate, so N concurrent traversals pipeline (one on device, the rest
-    preparing/encoding) instead of thrashing dispatch."""
+    preparing/encoding) instead of thrashing dispatch.
 
-    def __init__(self, width: int = 4, metrics=None) -> None:
+    Robustness layer (ISSUE 7): when the caller carries a deadline
+    (utils/deadline contextvar), the gate becomes a deadline-aware bounded
+    queue — acquisition waits at most the remaining budget (typed
+    DeadlineExceeded instead of an unbounded semaphore block), and work is
+    SHED up front (typed ResourceExhausted) when the remaining budget
+    cannot cover the expected device step (EWMA of recent step wall times)
+    or when the waiter queue is already `max_queue` deep. Unbudgeted
+    callers keep the exact pre-existing blocking behavior — zero overhead
+    on the warm path."""
+
+    # EWMA smoothing for the expected-device-step estimate
+    _EWMA_ALPHA = 0.2
+
+    def __init__(self, width: int = 4, metrics=None,
+                 max_queue: int | None = None) -> None:
         from dgraph_tpu.utils.metrics import Registry
 
         self.width = max(1, int(width))
+        self.max_queue = self.width * 16 if max_queue is None \
+            else int(max_queue)
         self.metrics = metrics if metrics is not None else Registry()
         self._sem = threading.BoundedSemaphore(self.width)
         self._inflight = self.metrics.counter("dgraph_dispatch_inflight")
         self._waits = self.metrics.counter("dgraph_dispatch_waits_total")
+        self._shed = self.metrics.counter("dgraph_shed_total")
+        self._wlock = threading.Lock()     # guards the _waiting count
+        self._waiting = 0                  # queued acquirers
+        self._step_ewma = 0.0              # expected device-step seconds
+
+    @property
+    def expected_step_s(self) -> float:
+        return self._step_ewma
+
+    def _acquire(self) -> None:
+        """Budget-aware semaphore acquisition. Raises typed errors instead
+        of waiting past the caller's deadline."""
+        if self._sem.acquire(blocking=False):
+            return
+        self._waits.inc()
+        rem = dl.remaining()
+        if rem is None:
+            self._sem.acquire()
+            return
+        # shed before queueing: a request whose remaining budget cannot
+        # cover even one expected device step would only occupy a queue
+        # slot and time out — reject it while it is still cheap. (The
+        # dgraph_deadline_exceeded_total counter is owned by the REQUEST
+        # entry points — counting here too would double-book overruns.)
+        if rem <= 0:
+            raise DeadlineExceeded("dispatch gate: budget exhausted")
+        if self._step_ewma and rem < self._step_ewma:
+            self._shed.inc()
+            otrace.event("shed", where="dispatch_gate",
+                         remaining_ms=round(rem * 1000, 1),
+                         expected_step_ms=round(self._step_ewma * 1000, 1))
+            raise ResourceExhausted(
+                f"shed: remaining budget {rem * 1000:.0f}ms < expected "
+                f"device step {self._step_ewma * 1000:.0f}ms")
+        with self._wlock:
+            if self._waiting >= self.max_queue:
+                queued = self._waiting
+            else:
+                queued = None
+                self._waiting += 1
+        if queued is not None:
+            self._shed.inc()
+            otrace.event("shed", where="dispatch_gate", queue=queued)
+            raise ResourceExhausted(
+                f"shed: dispatch queue full ({queued} waiting)")
+        try:
+            ok = self._sem.acquire(timeout=rem)
+        finally:
+            with self._wlock:
+                self._waiting -= 1
+        if not ok:
+            otrace.event("deadline", where="dispatch_gate")
+            raise DeadlineExceeded(
+                f"dispatch gate: no slot within {rem * 1000:.0f}ms budget")
 
     def run(self, fn):
-        if not self._sem.acquire(blocking=False):
-            self._waits.inc()
-            self._sem.acquire()
+        faults.fire("device.dispatch", m=self.metrics)
+        self._acquire()
         self._inflight.inc()
+        t0 = time.perf_counter()
         try:
             return fn()
         finally:
+            dt = time.perf_counter() - t0
+            self._step_ewma = dt if not self._step_ewma else (
+                (1 - self._EWMA_ALPHA) * self._step_ewma
+                + self._EWMA_ALPHA * dt)
             self._inflight.dec()
             self._sem.release()
 

@@ -371,6 +371,11 @@ class Store:
         # read-through; here clean predicates reuse device arrays)
         self.pred_commit_ts: dict[str, int] = {}
         self.pred_replay_seq: dict[str, int] = {}   # below-watermark commits
+        # per-predicate applied WaterMarks mirroring pred_commit_ts: the
+        # replica-read gate (remote.serve_task min_applied) blocks on
+        # wait_for_mark(timeout=) instead of a sleep/poll loop, so a
+        # catching-up follower wakes the exact moment the commit applies
+        self._applied_marks: dict[str, "WaterMark"] = {}
         # per-predicate delta journal: attr -> {key bytes: last commit_ts}
         # for every key committed since _delta_floor_for(attr). This is what
         # makes commit-to-visible O(Δ): the snapshot assembler stamps cached
@@ -642,6 +647,11 @@ class Store:
         cur = self.pred_commit_ts.get(attr, 0)
         if commit_ts > cur:
             self.pred_commit_ts[attr] = commit_ts
+            mark = self._applied_marks.get(attr)
+            if mark is not None:
+                # lock order store._lock -> mark cv is safe: waiters take
+                # only the mark's cv, never the store lock
+                mark.set_done_until(commit_ts)
         elif commit_ts < cur:
             # a commit arriving BELOW the watermark (replication replay /
             # out-of-order apply): max-only watermarks can't see it, so
@@ -691,6 +701,21 @@ class Store:
         with self._lock:
             keys = sum(len(v) for v in self._delta_log.values())
             return {"attrs": len(self._delta_log), "keys": keys}
+
+    def applied_mark(self, attr: str):
+        """The predicate's applied watermark (done_until mirrors
+        pred_commit_ts[attr]); created lazily and advanced by every commit
+        bump. Callers block via wait_for_mark(ts, timeout=) — the
+        replica-read gate's wait primitive."""
+        from ..utils.watermark import WaterMark
+
+        with self._lock:
+            mark = self._applied_marks.get(attr)
+            if mark is None:
+                mark = WaterMark(name=f"applied:{attr}")
+                mark.set_done_until(self.pred_commit_ts.get(attr, 0))
+                self._applied_marks[attr] = mark
+            return mark
 
     def abort(self, start_ts: int, key_bytes: list[bytes]) -> None:
         self._wal_write({"t": "a", "s": start_ts, "k": list(key_bytes)})
@@ -802,6 +827,9 @@ class Store:
                 self.by_pred.setdefault((int(key.kind), key.attr), set()).add(kb)
                 if commit_ts > self.pred_commit_ts.get(key.attr, 0):
                     self.pred_commit_ts[key.attr] = commit_ts
+                    mark = self._applied_marks.get(key.attr)
+                    if mark is not None:
+                        mark.set_done_until(commit_ts)
                 # installs bypass the delta journal: stamping resumes after
                 # the next full fold re-bases these tablets
                 self._delta_floor[key.attr] = max(
@@ -819,6 +847,11 @@ class Store:
     def _wal_write(self, rec: dict, sync: bool = False) -> None:
         if self._wal is None and self.wal_sink is None:
             return    # in-memory, unreplicated: records have nowhere to go
+        from ..utils import faults
+
+        # disk fault seam: a failing/slow WAL write surfaces BEFORE the
+        # in-memory apply, the same ordering a real fsync failure has
+        faults.fire("disk.wal_write", m=getattr(self, "metrics", None))
         data = encode_record(rec)
         with self._lock:
             # ship under the same lock as the local append so followers see

@@ -213,8 +213,21 @@ class Registry:
                      "dgraph_xidmap_lookups_total",
                      "dgraph_xidmap_shard_loads_total",
                      "dgraph_xidmap_evictions_total",
-                     "dgraph_checkpoint_peak_transient_bytes"):
+                     "dgraph_checkpoint_peak_transient_bytes",
+                     # request lifelines (utils/deadline, utils/retry,
+                     # utils/faults; ISSUE 7): retries, overload sheds,
+                     # budget overruns, hedges, breaker trips, degraded
+                     # reads, injected faults
+                     "dgraph_retry_total",
+                     "dgraph_shed_total",
+                     "dgraph_deadline_exceeded_total",
+                     "dgraph_hedge_fired_total",
+                     "dgraph_breaker_open_total",
+                     "dgraph_degraded_reads_total",
+                     "dgraph_fault_injected_total"):
             self.counters[name] = Counter()
+        # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
+        self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s", "dgraph_compaction_s",
                      "dgraph_planner_est_error_log2"):

@@ -1,11 +1,23 @@
 """Failure detection and fault injection (SURVEY §5): transport faults on
 the replication ship path mark members dead, quorum math reacts, recovery
 works through the normal rejoin path. Reference analog: conn/pool.go
-Echo-based health checks + Raft CheckQuorum."""
+Echo-based health checks + Raft CheckQuorum.
+
+Round-12 additions (ISSUE 7): overload-shedding and degraded-mode paths of
+the request-lifeline layer — a saturated dispatch gate sheds typed
+ResourceExhausted, a node that loses its Zero serves read-only snapshot
+queries with a staleness annotation, and the named fault points at the
+store/serve seams inject through the live paths."""
+
+import threading
+import time
 
 import pytest
 
 from dgraph_tpu.coord.replication import NoQuorum, ReplicaGroup
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils import faults
+from dgraph_tpu.utils.deadline import DeadlineExceeded, ResourceExhausted
 
 
 def _mk(tmp_path, n=3):
@@ -70,3 +82,203 @@ def test_no_partial_append_on_rejected_ship(tmp_path):
     for m in g._followers():
         assert m.wal_len() == lens_before[m.id]
     g.close()
+
+
+# -- named fault points through the live store/query paths -------------------
+
+@pytest.fixture(autouse=True)
+def _clean_global_faults():
+    faults.GLOBAL.clear()
+    yield
+    faults.GLOBAL.clear()
+
+
+def test_wal_write_fault_point_fails_the_mutation(tmp_path):
+    """disk.wal_write fires BEFORE the in-memory apply (a real fsync
+    failure's ordering): the mutation errors and nothing becomes
+    visible."""
+    from dgraph_tpu.api.server import Node
+
+    node = Node(str(tmp_path / "w"))
+    node.alter(schema_text="v: int .")
+    node.mutate(set_nquads='<0x1> <v> "1"^^<xs:int> .', commit_now=True)
+    faults.GLOBAL.install("disk.wal_write", "error", count=1)
+    with pytest.raises(faults.FaultError):
+        node.mutate(set_nquads='<0x1> <v> "2"^^<xs:int> .', commit_now=True)
+    faults.GLOBAL.clear()
+    out, _ = node.query("{ q(func: uid(0x1)) { v } }")
+    assert out["q"][0]["v"] == 1
+    assert node.metrics.counter("dgraph_fault_injected_total").value >= 1
+    node.close()
+
+
+def test_device_dispatch_fault_point_is_typed(tmp_path):
+    from dgraph_tpu.api.server import Node
+
+    node = Node()
+    node.alter(schema_text="name: string @index(exact) .")
+    node.mutate(set_nquads='_:a <name> "x" .', commit_now=True)
+    faults.GLOBAL.install("device.dispatch", "error")
+    with pytest.raises(faults.FaultError):
+        node.query('{ q(func: eq(name, "x")) { name } }')
+    faults.GLOBAL.clear()
+    out, _ = node.query('{ q(func: eq(name, "x")) { name } }')
+    assert out == {"q": [{"name": "x"}]}
+    node.close()
+
+
+# -- overload shedding + degraded mode (wire cluster) ------------------------
+
+grpc = pytest.importorskip("grpc")
+
+
+def _wire_cluster(n_groups=2, **client_kw):
+    from dgraph_tpu.coord.zero import Zero
+    from dgraph_tpu.coord.zero_service import serve_zero
+    from dgraph_tpu.parallel.client import ClusterClient
+    from dgraph_tpu.parallel.remote import serve_worker
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
+
+    schema = ("name: string @index(exact) .\n"
+              "follows: [uid] @reverse .")
+    zero = Zero(n_groups)
+    zero.move_tablet("name", 0)
+    zero.move_tablet("follows", n_groups - 1)
+    zsrv, zport, _ = serve_zero(zero, "localhost:0")
+    stores, workers = [], []
+    for _g in range(n_groups):
+        s = Store()
+        for e in parse_schema(schema):
+            s.set_schema(e)
+        stores.append(s)
+        workers.append(serve_worker(s, "localhost:0"))
+    client = ClusterClient(
+        f"localhost:{zport}",
+        {g: [f"localhost:{workers[g][1]}"] for g in range(n_groups)},
+        **client_kw)
+    client.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                             '_:a <follows> _:b .')
+    return client, zsrv, workers, stores
+
+
+def test_degraded_mode_serves_stale_reads_when_zero_dies():
+    """Losing the Zero quorum degrades to read-only snapshot serving with
+    a staleness annotation — byte-identical output for unchanged data —
+    instead of erroring outright; writes still fail typed."""
+    client, zsrv, workers, _stores = _wire_cluster(default_timeout_ms=5000)
+    try:
+        q = '{ q(func: eq(name, "ann")) { name follows { name } } }'
+        live = client.query(q)
+        assert client.last_degraded is None
+        zsrv.stop(0)
+        time.sleep(0.1)
+        client.task_cache.clear()
+        degraded = client.query(q)
+        assert degraded == live                     # byte-identical
+        assert client.last_degraded["degraded"] is True
+        assert client.last_degraded["staleness_s"] >= 0
+        assert client.metrics.counter(
+            "dgraph_degraded_reads_total").value == 1
+        # writes cannot be served from a dead coordinator: typed error,
+        # bounded time, no hang
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as ei:
+            client.mutate(set_nquads='_:c <name> "cid" .', retries=2,
+                          timeout_ms=2000)
+        assert isinstance(ei.value, (grpc.RpcError, ConnectionError,
+                                     OSError, DeadlineExceeded))
+        assert time.monotonic() - t0 < 4.0
+    finally:
+        client.close()
+        for w, _p in workers:
+            w.stop(0)
+
+
+def test_degraded_mode_off_surfaces_the_error():
+    client, zsrv, workers, _stores = _wire_cluster(degraded_reads=False)
+    try:
+        q = '{ q(func: eq(name, "ann")) { name } }'
+        client.query(q)
+        zsrv.stop(0)
+        time.sleep(0.1)
+        client.task_cache.clear()
+        with pytest.raises((grpc.RpcError, ConnectionError, OSError)):
+            client.query(q)
+    finally:
+        client.close()
+        for w, _p in workers:
+            w.stop(0)
+
+
+def test_inflight_commit_timeout_is_commit_ambiguous():
+    """An in-flight CommitOrAbort timeout (typed DeadlineExceeded with
+    the wire RpcError as __cause__) must surface as CommitAmbiguous with
+    NO retry — re-running the txn could apply it twice."""
+    from dgraph_tpu.utils.retry import CommitAmbiguous
+
+    client, zsrv, workers, _stores = _wire_cluster()
+    calls = []
+
+    class _WireTimeout(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.DEADLINE_EXCEEDED
+
+    def bad_commit(start_ts, conflict_keys, preds):
+        calls.append(start_ts)
+        err = DeadlineExceeded("zero:CommitOrAbort deadline exceeded")
+        err.__cause__ = _WireTimeout()
+        raise err
+
+    try:
+        client.zero._zero.commit = bad_commit
+        with pytest.raises(CommitAmbiguous):
+            client.mutate(set_nquads='_:x <name> "x" .', retries=5)
+        assert len(calls) == 1, "ambiguous commit was retried"
+    finally:
+        client.close()
+        for w, _p in workers:
+            w.stop(0)
+        zsrv.stop(0)
+
+
+def test_gate_saturation_sheds_instead_of_hanging():
+    """A saturated client dispatch gate with an armed deadline sheds or
+    deadline-errors the overflow — every request resolves within its
+    budget, none hang (the chaos gate's local version)."""
+    client, zsrv, workers, _stores = _wire_cluster()
+    from dgraph_tpu.query.qcache import DispatchGate
+
+    client.dispatch_gate = DispatchGate(1, client.metrics, max_queue=0)
+    faults.GLOBAL.install("worker.serve_task", "delay", delay_s=0.4)
+    results = []
+
+    def one(i):
+        t0 = time.monotonic()
+        try:
+            client.task_cache.clear()    # force the wire each time
+            client.query('{ q(func: eq(name, "ann")) { name } }',
+                         timeout_ms=600)
+            results.append(("ok", time.monotonic() - t0))
+        except (DeadlineExceeded, ResourceExhausted) as e:
+            results.append((type(e).__name__, time.monotonic() - t0))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not any(t.is_alive() for t in threads), "a request hung"
+        assert len(results) == 6
+        # every outcome typed; at least one request was rejected up front
+        kinds = {k for k, _ in results}
+        assert kinds <= {"ok", "DeadlineExceeded", "ResourceExhausted"}
+        assert kinds & {"DeadlineExceeded", "ResourceExhausted"}, results
+        assert all(dt < 2.0 for _, dt in results), results
+    finally:
+        faults.GLOBAL.clear()
+        client.close()
+        for w, _p in workers:
+            w.stop(0)
+        zsrv.stop(0)

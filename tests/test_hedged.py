@@ -145,6 +145,9 @@ def test_min_applied_gate_unblocks_when_caught_up():
     def catch_up():
         time.sleep(0.15)
         store.pred_commit_ts["name"] = 50
+        # the replica-read gate blocks on the applied WaterMark now, not a
+        # poll loop — advance it the way a real commit's _bump_pred_ts does
+        store.applied_mark("name").set_done_until(50)
 
     try:
         threading.Thread(target=catch_up, daemon=True).start()
@@ -205,6 +208,182 @@ def test_wedged_floor_falls_back_to_leader():
         res = hr.process_task(TaskQuery("name", func=("eq", ["p4"])), 5,
                               min_applied=999)   # nobody ever applied this
         assert list(res.dest_uids) == [4]
+    finally:
+        hr.close()
+        for s in servers:
+            s.stop(0)
+
+
+def test_hedge_never_fires_below_grace_budget():
+    """ISSUE 7 satellite: with remaining budget < HEDGE_GRACE a hedge
+    could never beat the deadline — the backup request must NOT fire
+    (sequential failover within the budget instead)."""
+    from dgraph_tpu.utils import deadline as dl
+    from dgraph_tpu.utils.deadline import DeadlineExceeded
+
+    svcs, servers, addrs = _mk_pair(NQ)
+    real = svcs[0].serve_task
+
+    def slow(msg, ctx):
+        time.sleep(1.0)
+        return real(msg, ctx)
+
+    backup_calls = []
+    real1 = svcs[1].serve_task
+    for s in servers:
+        s.stop(0)
+    svcs[0].serve_task = slow
+    svcs[1].serve_task = lambda m, c: backup_calls.append(1) or real1(m, c)
+    servers, addrs = [], []
+    for svc in svcs:
+        server, addr = _serve(svc)
+        servers.append(server)
+        addrs.append(addr)
+
+    hr = HedgedReplicas(addrs)
+    hr.HEDGE_GRACE = 0.3
+    try:
+        t0 = time.monotonic()
+        with dl.scope(0.15):          # budget < grace
+            with pytest.raises((DeadlineExceeded, grpc.RpcError)):
+                hr.process_task(TaskQuery("name", func=("eq", ["p3"])), 5,
+                                min_applied=2)
+        dt = time.monotonic() - t0
+        assert dt < 0.8, f"wait was not deadline-bounded ({dt:.2f}s)"
+        assert not backup_calls, "hedge fired below the grace budget"
+        assert hr.metrics.counter("dgraph_hedge_fired_total").value == 0
+    finally:
+        hr.close()
+        for s in servers:
+            s.stop(0)
+
+
+def test_hedge_counts_metric_when_it_fires():
+    svcs, servers, addrs = _mk_pair(NQ)
+    real = svcs[0].serve_task
+
+    def slow(msg, ctx):
+        time.sleep(1.0)
+        return real(msg, ctx)
+
+    for s in servers:
+        s.stop(0)
+    svcs[0].serve_task = slow
+    servers, addrs = [], []
+    for svc in svcs:
+        server, addr = _serve(svc)
+        servers.append(server)
+        addrs.append(addr)
+    hr = HedgedReplicas(addrs)
+    hr.HEDGE_GRACE = 0.1
+    try:
+        res = hr.process_task(TaskQuery("name", func=("eq", ["p3"])), 5,
+                              min_applied=2)
+        assert list(res.dest_uids) == [3]
+        assert hr.metrics.counter("dgraph_hedge_fired_total").value == 1
+    finally:
+        hr.close()
+        for s in servers:
+            s.stop(0)
+
+
+def test_breaker_open_replica_is_skipped():
+    """ISSUE 7 satellite: a replica whose circuit breaker is OPEN is
+    routed around — fan-out does not pay its timeout per request — and
+    half-open probes re-admit it once it recovers."""
+    from dgraph_tpu.utils.retry import CircuitBreaker
+
+    svcs, servers, addrs = _mk_pair(NQ)
+    calls = [0, 0]
+    reals = [svc.serve_task for svc in svcs]
+
+    def count(i):
+        def h(m, c):
+            calls[i] += 1
+            return reals[i](m, c)
+        return h
+
+    for s in servers:
+        s.stop(0)
+    for i, svc in enumerate(svcs):
+        svc.serve_task = count(i)
+    servers, addrs = [], []
+    for svc in svcs:
+        server, addr = _serve(svc)
+        servers.append(server)
+        addrs.append(addr)
+    hr = HedgedReplicas(addrs)
+    hr.HEDGE_GRACE = 0.05
+    try:
+        # trip replica 0's breaker the way real traffic would: transport
+        # failures recorded against it
+        for _ in range(hr.BREAKER_FAILS):
+            hr._record(0, False, e=ConnectionError("down"))
+        assert hr.breakers[0].state == CircuitBreaker.OPEN
+        assert hr.metrics.counter("dgraph_breaker_open_total").value == 1
+        assert hr.metrics.keyed("dgraph_breaker_state").get(addrs[0]) == 2
+        assert hr._order()[0] == 1      # open breaker demoted from primary
+        before = calls[0]
+        res = hr.process_task(TaskQuery("name", func=("eq", ["p5"])), 5,
+                              min_applied=2)
+        assert list(res.dest_uids) == [5]
+        assert calls[0] == before, "breaker-open replica was still dialed"
+        # recovery: after open_s the replica goes half-open (demoted
+        # behind closed replicas in routing), and the Status echo loop is
+        # the no-traffic probe that closes it
+        hr.breakers[0]._opened_at -= (hr.BREAKER_OPEN_S + 1)
+        assert hr.breakers[0].state == CircuitBreaker.HALF_OPEN
+        assert hr._order()[0] == 1      # half-open: still not primary
+        hr._poll_once()                 # echo succeeds -> breaker closes
+        assert hr.breakers[0].state == CircuitBreaker.CLOSED
+        assert hr._order()[0] == 0      # back to primary (it is idx 0)
+        res = hr.process_task(TaskQuery("name", func=("eq", ["p6"])), 5,
+                              min_applied=2)
+        assert list(res.dest_uids) == [6]
+    finally:
+        hr.close()
+        for s in servers:
+            s.stop(0)
+
+
+def test_deadline_errors_do_not_trip_breaker():
+    """Caller-budget exhaustion is the budget's fault, not the
+    replica's: neither the typed DeadlineExceeded nor a wire
+    DEADLINE_EXCEEDED may open a healthy replica's breaker."""
+    from dgraph_tpu.utils.deadline import DeadlineExceeded
+    from dgraph_tpu.utils.retry import CircuitBreaker
+
+    hr = HedgedReplicas(["localhost:9"])
+    try:
+        for _ in range(hr.BREAKER_FAILS + 2):
+            hr._record(0, False, e=DeadlineExceeded("budget gone"))
+        assert hr.breakers[0].state == CircuitBreaker.CLOSED
+        for _ in range(hr.BREAKER_FAILS):
+            hr._record(0, False, e=ConnectionError("real fault"))
+        assert hr.breakers[0].state == CircuitBreaker.OPEN
+    finally:
+        hr.close()
+
+
+def test_behind_replica_does_not_trip_breaker():
+    """FAILED_PRECONDITION (replica behind the floor / not leader) is an
+    application-level refusal, not a transport fault — it must never open
+    the breaker and cut the replica out of routing."""
+    from dgraph_tpu.utils.retry import CircuitBreaker
+
+    svcs, servers, addrs = _mk_pair(NQ)
+    for svc in svcs:
+        svc.APPLIED_WAIT = 0.05
+    hr = HedgedReplicas(addrs)
+    hr.HEDGE_GRACE = 0.05
+    try:
+        svcs[0].is_leader = True
+        hr._poll_once()
+        for _ in range(hr.BREAKER_FAILS + 1):
+            res = hr.process_task(TaskQuery("name", func=("eq", ["p2"])),
+                                  5, min_applied=999)   # wedged floor
+            assert list(res.dest_uids) == [2]           # leader fallback
+        assert all(b.state == CircuitBreaker.CLOSED for b in hr.breakers)
     finally:
         hr.close()
         for s in servers:

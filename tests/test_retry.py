@@ -1,0 +1,404 @@
+"""Request-lifeline primitives (ISSUE 7): deadlines, the unified
+RetryPolicy, circuit breakers, and the seeded fault-injection registry.
+
+Reference analog: context deadlines + x/x.go retry loops + conn/pool
+health state; the chaos-side registry is our stand-in for the reference's
+systest process kills."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils import faults
+from dgraph_tpu.utils.deadline import (Deadline, DeadlineExceeded,
+                                       ResourceExhausted)
+from dgraph_tpu.utils.retry import (CircuitBreaker, CommitAmbiguous,
+                                    RetryPolicy, backoff_s)
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_deadline_remaining_and_check():
+    d = Deadline(0.2)
+    assert 0 < d.remaining() <= 0.2
+    d.check()                     # not expired: no raise
+    d.expires = time.monotonic() - 0.01
+    assert d.expired()
+    with pytest.raises(DeadlineExceeded):
+        d.check("unit")
+
+
+def test_deadline_clamp():
+    d = Deadline(1.0)
+    assert d.clamp(0.1) == pytest.approx(0.1, abs=0.01)
+    assert d.clamp(None) == pytest.approx(1.0, abs=0.05)
+    d.expires = time.monotonic() - 1
+    assert d.clamp(5.0) == 0.0
+
+
+def test_scope_installs_and_restores():
+    assert dl.current() is None
+    with dl.scope(0.5):
+        assert dl.current() is not None
+        assert dl.remaining() > 0
+    assert dl.current() is None
+    assert dl.remaining() is None
+    # None budget = no-op scope
+    with dl.scope(None):
+        assert dl.current() is None
+
+
+def test_nested_scope_never_extends():
+    """A callee's default budget cannot outlive its caller's deadline."""
+    with dl.scope(0.05):
+        outer = dl.current()
+        with dl.scope(10.0):
+            assert dl.current() is outer    # tighter bound wins
+        with dl.scope(0.01):
+            assert dl.current() is not outer
+
+
+def test_metadata_round_trip():
+    with dl.scope(0.5):
+        md = dl.to_metadata()
+        assert md[0] == dl.WIRE_KEY
+        got = dl.from_metadata([md])
+        assert got is not None
+        assert 0 < got.remaining() <= 0.5
+    assert dl.to_metadata() is None
+    assert dl.from_metadata([("other", "1")]) is None
+    assert dl.from_metadata([(dl.WIRE_KEY, "junk")]) is None
+
+
+def test_module_clamp_and_check():
+    assert dl.clamp(3.0) == 3.0          # unbudgeted: identity
+    dl.check()                           # unbudgeted: no-op
+    with dl.scope(0.2):
+        assert dl.clamp(3.0) <= 0.2
+        assert dl.clamp(0.01) <= 0.01
+
+
+# -- retry policy ------------------------------------------------------------
+
+def test_backoff_full_jitter_bounds():
+    rng = random.Random(3)
+    for attempt in range(6):
+        for _ in range(50):
+            s = backoff_s(attempt, base_s=0.05, cap_s=0.4, rng=rng)
+            assert 0 <= s <= min(0.4, 0.05 * 2 ** attempt)
+
+
+def test_retry_retries_transport_then_succeeds():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("flaky")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, base_s=0.001, cap_s=0.002,
+                    rng=random.Random(1))
+    assert p.run(fn) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausts_and_raises_last():
+    p = RetryPolicy(max_attempts=3, base_s=0.001, cap_s=0.002,
+                    rng=random.Random(1))
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        p.run(fn)
+    assert len(calls) == 3
+
+
+def test_retry_programming_error_not_retried():
+    """Only transport shapes retry — a bug surfaces on the first throw."""
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("bug")
+
+    p = RetryPolicy(max_attempts=5, base_s=0.001)
+    with pytest.raises(KeyError):
+        p.run(fn)
+    assert len(calls) == 1
+
+
+def test_retry_abort_on_and_ambiguous_never_retried():
+    for exc in (CommitAmbiguous("?"), DeadlineExceeded("late")):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise exc
+
+        p = RetryPolicy(max_attempts=5, base_s=0.001)
+        with pytest.raises(type(exc)):
+            p.run(fn)
+        assert len(calls) == 1, type(exc).__name__
+
+
+def test_retry_respects_deadline():
+    """A retry whose backoff sleep would blow the deadline surfaces the
+    cause instead of sleeping past it."""
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    p = RetryPolicy(max_attempts=50, base_s=0.05, cap_s=0.05,
+                    rng=random.Random(2))
+    t0 = time.monotonic()
+    with dl.scope(0.08):
+        with pytest.raises(ConnectionError):
+            p.run(fn)
+    assert time.monotonic() - t0 < 0.5
+    assert len(calls) < 50
+
+
+def test_retry_on_retry_hook():
+    seen = []
+
+    def fn():
+        if len(seen) < 1:
+            raise ConnectionError("x")
+        return 1
+
+    p = RetryPolicy(max_attempts=3, base_s=0.001)
+    assert p.run(fn, on_retry=lambda e: seen.append(type(e).__name__)) == 1
+    assert seen == ["ConnectionError"]
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def _clocked_breaker(**kw):
+    clk = [0.0]
+    br = CircuitBreaker(clock=lambda: clk[0], **kw)
+    return br, clk
+
+
+def test_breaker_trips_after_consecutive_failures():
+    br, _ = _clocked_breaker(fail_threshold=3, open_s=5.0)
+    assert br.state == CircuitBreaker.CLOSED
+    br.record(False)
+    br.record(False)
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record(False)
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+
+
+def test_breaker_success_resets_streak():
+    br, _ = _clocked_breaker(fail_threshold=2, open_s=5.0)
+    br.record(False)
+    br.record(True)
+    br.record(False)
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_half_open_probe_then_close_or_reopen():
+    br, clk = _clocked_breaker(fail_threshold=1, open_s=2.0)
+    br.record(False)
+    assert br.state == CircuitBreaker.OPEN
+    clk[0] = 2.5
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()           # the single probe
+    assert not br.allow()       # second request still rejected
+    br.record(False)            # probe failed: re-open
+    assert br.state == CircuitBreaker.OPEN
+    clk[0] = 5.0
+    assert br.allow()
+    br.record(True)             # probe succeeded: close
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() and br.allow()
+
+
+def test_breaker_stale_probe_token_expires():
+    """A granted half-open probe whose request never reports back must
+    not wedge the breaker: the token expires after open_s."""
+    br, clk = _clocked_breaker(fail_threshold=1, open_s=2.0)
+    br.record(False)
+    clk[0] = 2.5
+    assert br.allow()            # probe granted, caller then vanishes
+    assert not br.allow()
+    clk[0] = 5.0                 # token expired: a fresh probe is admitted
+    assert br.allow()
+
+
+def test_breaker_latency_counts_as_soft_failure():
+    br, _ = _clocked_breaker(fail_threshold=2, open_s=5.0,
+                             latency_threshold_s=0.1)
+    br.record(True, latency_s=0.5)
+    br.record(True, latency_s=0.5)
+    assert br.state == CircuitBreaker.OPEN
+
+
+# -- fault registry ----------------------------------------------------------
+
+def test_fault_registry_error_mode_is_transport_shaped():
+    r = faults.FaultRegistry(seed=1)
+    r.install("p", "error")
+    with pytest.raises(ConnectionError):
+        r.fire("p")
+
+
+def test_fault_registry_deterministic_schedule():
+    """The same seed replays the same fire/skip sequence."""
+
+    def schedule(seed):
+        r = faults.FaultRegistry(seed=seed)
+        r.install("p", "error", p=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                r.fire("p")
+                out.append(0)
+            except faults.FaultError:
+                out.append(1)
+        return out
+
+    a, b = schedule(42), schedule(42)
+    assert a == b
+    assert 0 < sum(a) < 64            # actually probabilistic
+    assert schedule(43) != a          # and seed-dependent
+
+
+def test_fault_registry_count_budget_and_clear():
+    r = faults.FaultRegistry()
+    r.install("p", "error", count=2)
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            r.fire("p")
+    r.fire("p")                       # budget exhausted: no-op
+    assert r.snapshot()["points"]["p"]["fired"] == 2
+    r.clear("p")
+    r.fire("p")
+    r.install("a", "error")
+    r.install("b", "error")
+    r.clear()
+    r.fire("a")
+    r.fire("b")
+
+
+def test_fault_registry_delay_and_drop():
+    r = faults.FaultRegistry()
+    r.install("slow", "delay", delay_s=0.05)
+    t0 = time.monotonic()
+    r.fire("slow")                    # sleeps, returns
+    assert time.monotonic() - t0 >= 0.05
+    r.install("hole", "drop", delay_s=0.02)
+    t0 = time.monotonic()
+    with pytest.raises(faults.FaultError):
+        r.fire("hole")
+    assert time.monotonic() - t0 >= 0.02
+
+
+def test_fault_registry_spec_parse():
+    r = faults.FaultRegistry(seed=9)
+    r.configure("a:error:0.25, b:delay:1.0:0.2:3 ,c:drop")
+    snap = r.snapshot()["points"]
+    assert snap["a"] == {"mode": "error", "p": 0.25, "delay_s": 0.0,
+                         "remaining": None, "fired": 0}
+    assert snap["b"]["mode"] == "delay" and snap["b"]["delay_s"] == 0.2 \
+        and snap["b"]["remaining"] == 3
+    assert snap["c"]["mode"] == "drop"
+    with pytest.raises(ValueError):
+        r.configure("justaname")
+    with pytest.raises(ValueError):
+        r.install("x", "explode")
+
+
+def test_fault_registry_unknown_point_never_fires():
+    r = faults.FaultRegistry()
+    r.install("somewhere.else", "error")
+    r.fire("worker.serve_task")       # installed name differs: no-op
+
+
+def test_fault_fire_counts_metric():
+    from dgraph_tpu.utils.metrics import Registry
+
+    m = Registry()
+    r = faults.FaultRegistry()
+    r.install("p", "error")
+    with pytest.raises(faults.FaultError):
+        r.fire("p", m=m)
+    assert m.counter("dgraph_fault_injected_total").value == 1
+
+
+# -- gate shedding (deadline-aware bounded queue) ---------------------------
+
+def test_gate_unbudgeted_behavior_unchanged():
+    from dgraph_tpu.query.qcache import DispatchGate
+
+    g = DispatchGate(2)
+    assert g.run(lambda: 7) == 7
+    assert g.expected_step_s > 0      # EWMA primed
+
+
+def test_gate_budget_exhausted_raises_typed():
+    from dgraph_tpu.query.qcache import DispatchGate
+
+    g = DispatchGate(1)
+    ev = threading.Event()
+    t = threading.Thread(target=lambda: g.run(lambda: ev.wait(2.0)))
+    t.start()
+    time.sleep(0.05)
+    try:
+        t0 = time.monotonic()
+        with dl.scope(0.1):
+            with pytest.raises(DeadlineExceeded):
+                g.run(lambda: 1)
+        assert time.monotonic() - t0 < 1.0   # bounded, not the full wait
+        # overrun ACCOUNTING is owned by the request entry points (Node/
+        # ClusterClient) — the gate itself only raises, never counts
+        assert g.metrics.counter("dgraph_deadline_exceeded_total").value == 0
+    finally:
+        ev.set()
+        t.join()
+
+
+def test_gate_sheds_when_budget_below_expected_step():
+    from dgraph_tpu.query.qcache import DispatchGate
+
+    g = DispatchGate(1)
+    g._step_ewma = 5.0                # expected device step >> budget
+    ev = threading.Event()
+    t = threading.Thread(target=lambda: g.run(lambda: ev.wait(2.0)))
+    t.start()
+    time.sleep(0.05)
+    try:
+        with dl.scope(0.2):
+            with pytest.raises(ResourceExhausted):
+                g.run(lambda: 1)
+        assert g.metrics.counter("dgraph_shed_total").value == 1
+    finally:
+        ev.set()
+        t.join()
+
+
+def test_gate_queue_bound_sheds():
+    from dgraph_tpu.query.qcache import DispatchGate
+
+    g = DispatchGate(1, max_queue=0)
+    ev = threading.Event()
+    t = threading.Thread(target=lambda: g.run(lambda: ev.wait(2.0)))
+    t.start()
+    time.sleep(0.05)
+    try:
+        with dl.scope(5.0):
+            with pytest.raises(ResourceExhausted):
+                g.run(lambda: 1)
+    finally:
+        ev.set()
+        t.join()
