@@ -38,6 +38,10 @@ loops; the reference's own inner loops are scalar Go over bp128 blocks).
   * `ingest` — the out-of-core round: bulk-load edges/s in-RAM vs the
     spill tier (byte-identical output asserted) and the streaming
     checkpoint's peak transient (spool-bounded, independent of keys).
+  * `vector` — the vector-index round: fold/build time, brute-force vs
+    IVF probe QPS, IVF recall@10 (gated >= 0.95 on a clustered corpus),
+    hybrid ANN->graph latency; brute-force asserted identical to a host
+    float64 exact scan. Writes VECTOR_r08.json.
 
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "band", "query_path", "query_configs", "throughput", "freshness",
@@ -861,6 +865,122 @@ def bench_mesh():
     return out
 
 
+VECTOR_ARTIFACT = "VECTOR_r08.json"
+
+
+def bench_vector(n=6000, dim=32, n_queries=40, k=10):
+    """Vector-index battery (ISSUE 8): index build time, brute-force vs
+    IVF probe QPS, IVF recall@10 (gated >= 0.95), and hybrid ANN->graph
+    latency — brute-force results asserted identical to a host float64
+    exact scan. Writes the trajectory artifact VECTOR_r08.json."""
+    import os
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.ops import vector as vops
+    from dgraph_tpu.storage import vecindex as vx
+    from dgraph_tpu.utils.types import vector_str
+
+    # clustered corpus (the workload IVF exists for: real embedding
+    # spaces cluster, and the coarse lists align with the clusters)
+    rng = np.random.default_rng(17)
+    centers = rng.normal(size=(64, dim))
+    assign = rng.integers(0, 64, size=n)
+    vecs = (centers[assign] +
+            0.15 * rng.normal(size=(n, dim))).astype(np.float32)
+    # snapped to the index's storage precision: search() quantizes the
+    # query to float32 before its float64 re-rank, so the host oracle
+    # must rank from the same quantized vector or near-ties at the k-th
+    # boundary legitimately disagree
+    queries = (centers[rng.integers(0, 64, size=n_queries)] +
+               0.15 * rng.normal(size=(n_queries, dim))).astype(np.float32)
+
+    from dgraph_tpu.utils.schema import VectorSpec
+
+    spec = VectorSpec(dim=dim, metric="l2")
+    subs = np.arange(1, n + 1, dtype=np.int64)
+    t0 = time.perf_counter()
+    ivf = vx._build_ivf(vecs, "l2")
+    vi = vx.VectorIndex("emb", spec, subs, vecs, ivf)
+    vi.device()                       # include the HBM upload in build
+    build_s = time.perf_counter() - t0
+
+    out = {"rows": n, "dim": dim, "metric": "l2",
+           "build_s": round(build_s, 3),
+           "ivf_lists": int(ivf.n_lists)}
+
+    # brute-force == host float64 exact scan, byte-identical (acceptance)
+    vecs64 = vecs.astype(np.float64)
+    identical = True
+    hits = 0
+    for q in queries:
+        d = vops.host_distances(vecs64, q, "l2")
+        want = subs[np.lexsort((subs, d))[: k]]
+        got, _ = vx.search(vi, q, k, exact=True)
+        identical = identical and np.array_equal(got, want)
+        approx, _ = vx.search(vi, q, k, exact=False)
+        hits += len(set(want.tolist()) & set(approx.tolist()))
+    out["brute_identical_to_host_scan"] = bool(identical)
+    out["recall_at_10"] = round(hits / (k * n_queries), 4)
+
+    def qps(exact):
+        vx.search(vi, queries[0], k, exact=exact)          # warm
+        lat = []
+        for q in queries:
+            t0 = time.perf_counter()
+            vx.search(vi, q, k, exact=exact)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        b = _band(lat)
+        return {"p50_ms": b["median"],
+                "qps": round(1e3 / max(b["median"], 1e-9), 1)}
+
+    out["brute"] = qps(True)
+    out["ivf"] = qps(False)
+
+    # hybrid ANN -> graph expansion through the full query path (the
+    # fused device pipeline when the planner picks it). The fused program
+    # is brute-force and device-class only: size the tablet past the
+    # host-scan cutover and force exactness the documented way (IVF
+    # threshold above the tablet — docs/ops.md), or the engine correctly
+    # takes the stepped host/IVF path and the gate below is vacuous.
+    sub = min(n, max(2048, 2 * vx.HOST_SCAN_MAX // dim))
+    node = Node(vector_ivf_min_rows=sub + 1)
+    node.alter(schema_text=f"emb: float32vector "
+                           f"@index(vector(dim: {dim}, metric: l2)) .\n"
+                           f"friend: [uid] .\n")
+    quads = []
+    for i in range(1, sub + 1):
+        quads.append(f'<0x{i:x}> <emb> "{vector_str(vecs[i - 1])}"'
+                     f'^^<xs:float32vector> .')
+        for j in range(4):
+            t = (i * 13 + j) % sub + 1
+            if t != i:
+                quads.append(f'<0x{i:x}> <friend> <0x{t:x}> .')
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+    node.task_cache = node.result_cache = None
+    lat = []
+    for q in queries:
+        t0 = time.perf_counter()
+        o, _ = node.query(f'{{ q(func: similar_to(emb, '
+                          f'"{vector_str(q)}", {k})) '
+                          f'{{ uid friend {{ uid }} }} }}')
+        lat.append((time.perf_counter() - t0) * 1e3)
+        assert len(o["q"]) == k
+    out["hybrid_ann_expand_ms"] = _band(lat)
+    out["fused_pipelines"] = int(node.metrics.counter(
+        "dgraph_vector_fused_pipelines_total").value)
+    node.close()
+
+    out["ok"] = bool(identical and out["recall_at_10"] >= 0.95)
+    # the trajectory artifact records the full-scale corpus only: reduced
+    # runs (smoke_vector.sh) must not clobber it with smoke-scale numbers
+    if (n, dim, n_queries, k) == (6000, 32, 40, 10):
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               VECTOR_ARTIFACT), "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return out
+
+
 def bench_query_configs():
     """BASELINE configs 2-5: DQL text in -> JSON out on the film graph."""
     from dgraph_tpu.models.film import film_node
@@ -989,6 +1109,10 @@ def main():
         chaos = bench_chaos()
     except Exception as e:  # lifeline battery must not sink it either
         chaos = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        vector = bench_vector()
+    except Exception as e:  # vector battery must not sink it either
+        vector = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -1006,6 +1130,7 @@ def main():
         "ingest": ingest,
         "mesh": mesh,
         "chaos": chaos,
+        "vector": vector,
     }))
 
 

@@ -1,0 +1,86 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a short CPU-only vector-index check (ISSUE 8).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 runs the vector battery (bench.py bench_vector) at reduced scale
+# and asserts
+#   * brute-force similar_to byte-identical to a host float64 exact scan,
+#   * IVF recall@10 >= 0.95 on the clustered corpus,
+#   * every hybrid ANN->graph query ran as ONE fused device pipeline,
+# then serves one similar_to query over HTTP and parses /metrics with the
+# obs.prom format checker (dgraph_vector_* series pre-registered).
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== vector smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import threading
+import urllib.request
+
+from bench import bench_vector
+
+r = bench_vector(n=4500, dim=16, n_queries=20)
+print(f"  build {r['build_s']}s, {r['ivf_lists']} IVF lists; "
+      f"brute {r['brute']['qps']} qps vs ivf {r['ivf']['qps']} qps; "
+      f"recall@10 {r['recall_at_10']}; "
+      f"hybrid p50 {r['hybrid_ann_expand_ms']['median']}ms")
+assert r["brute_identical_to_host_scan"], \
+    "brute-force diverged from the host float64 exact scan"
+assert r["recall_at_10"] >= 0.95, f"IVF recall@10 {r['recall_at_10']}"
+assert r["fused_pipelines"] == 20, \
+    f"hybrid queries not fused: {r['fused_pipelines']}/20"
+
+# -- embedded node: similar_to over HTTP + /metrics parse ------------------
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.obs import prom
+
+node = Node()
+node.alter(schema_text="emb: float32vector @index(vector(dim: 4)) .")
+node.mutate(set_nquads="\n".join(
+    f'<0x{i:x}> <emb> "[{i}, 0, {i % 3}, 1]"^^<xs:float32vector> .'
+    for i in range(1, 9)), commit_now=True)
+srv = make_server(node, "127.0.0.1", 0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{srv.server_address[1]}"
+req = urllib.request.Request(
+    base + "/query",
+    data=b'{ q(func: similar_to(emb, "[2, 0, 1, 1]", 3)) '
+         b'{ uid d : val(vector_distance) } }',
+    method="POST")
+out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+assert len(out["data"]["q"]) == 3, out
+series = prom.parse(urllib.request.urlopen(base + "/metrics",
+                                           timeout=5).read().decode())
+assert series["dgraph_vector_searches_total"][0][1] >= 1
+for name in ("dgraph_vector_ivf_probes_total",
+             "dgraph_vector_fused_pipelines_total",
+             "dgraph_vector_mesh_dispatches_total"):
+    assert name in series, f"{name} not exposed"
+print(f"  /metrics: {len(series)} series parsed clean, "
+      f"dgraph_vector_* exposed")
+srv.shutdown()
+node.close()
+print("OK: exact gate, recall gate, fused gate, /metrics parse")
+PY
+echo "== smoke passed =="

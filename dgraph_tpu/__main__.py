@@ -41,7 +41,10 @@ def cmd_serve(args) -> int:
                 slow_query_log=args.slow_query_log,
                 mesh_devices=(args.mesh_devices or (-1 if args.mesh else 0)),
                 mesh_min_edges=args.mesh_min_edges or None,
-                default_timeout_ms=args.default_timeout_ms)
+                default_timeout_ms=args.default_timeout_ms,
+                vector_nprobe=args.vector_nprobe,
+                vector_centroids=args.vector_centroids,
+                vector_ivf_min_rows=args.vector_ivf_min_rows)
     if args.faults or args.faults_seed is not None:
         from dgraph_tpu.utils import faults as faults_mod
 
@@ -375,6 +378,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--mesh_min_edges", type=int, default=0,
                     help="tablets below this edge count stay replicated on "
                          "the classic path (0 = default 65536)")
+    sp.add_argument("--vector_nprobe", type=int, default=0,
+                    help="IVF coarse lists scanned per similar_to probe "
+                         "(0 = default 8; higher = recall, lower = speed)")
+    sp.add_argument("--vector_centroids", type=int, default=-1,
+                    help="IVF centroid count built at snapshot fold "
+                         "(-1 = auto ~sqrt(rows), clamped to [8, 1024])")
+    sp.add_argument("--vector_ivf_min_rows", type=int, default=0,
+                    help="embedding tablets below this row count stay "
+                         "brute-force exact (0 = default 4096)")
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")

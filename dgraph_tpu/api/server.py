@@ -92,7 +92,10 @@ class Node:
                  slow_query_log: str | None = None,
                  mesh_devices: int = 0,
                  mesh_min_edges: int | None = None,
-                 default_timeout_ms: float = 0.0) -> None:
+                 default_timeout_ms: float = 0.0,
+                 vector_nprobe: int = 0,
+                 vector_centroids: int = -1,
+                 vector_ivf_min_rows: int = 0) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -177,6 +180,17 @@ class Node:
         # visible device, N = first N devices. The classic per-task path
         # (and the gRPC wire path on a cluster) remains the fallback for
         # shapes the fused programs do not cover.
+        # vector-index IVF knobs (--vector_nprobe / --vector_centroids /
+        # --vector_ivf_min_rows): per-node — they ride this node's Store
+        # into the fold (storage/vecindex.py), so embedding a second Node
+        # in the same process never inherits them
+        if vector_nprobe or vector_centroids >= 0 or vector_ivf_min_rows:
+            from dgraph_tpu.storage.vecindex import VectorKnobs
+
+            self.store.vector_knobs = VectorKnobs(
+                nprobe=vector_nprobe,
+                centroids=vector_centroids,
+                ivf_min_rows=vector_ivf_min_rows)
         self.mesh_exec = None
         if mesh_devices:
             from dgraph_tpu.parallel.mesh_exec import MeshExecutor
@@ -427,6 +441,7 @@ class Node:
                 base = self.snapshot(read_ts)
                 snap = GraphSnapshot(read_ts)
                 snap.preds = dict(base.preds)
+                snap.metrics = getattr(base, "metrics", None)
                 if ctx.overlay is not None and ctx.overlay[0] == ctx.version:
                     snap.preds.update(ctx.overlay[1])
                 else:
@@ -628,10 +643,13 @@ class Node:
                     nq_del = ups.expand(rdf.parse(m.get("delete", "")),
                                         vars_map)
                     if m.get("set_json") is not None:
-                        nq_set += mut.nquads_from_json(m["set_json"], Op.SET)
+                        nq_set += mut.nquads_from_json(
+                            m["set_json"], Op.SET,
+                            schema=self.store.schema)
                     if m.get("delete_json") is not None:
-                        nq_del += mut.nquads_from_json(m["delete_json"],
-                                                       Op.DEL)
+                        nq_del += mut.nquads_from_json(
+                            m["delete_json"], Op.DEL,
+                            schema=self.store.schema)
                     if not nq_set and not nq_del:
                         continue   # cond met but every quad's var was empty
                     res = self.mutate_quads(nq_set, nq_del, commit_now=False,
@@ -663,9 +681,11 @@ class Node:
         nquads_set = rdf.parse(set_nquads) if set_nquads else []
         nquads_del = rdf.parse(del_nquads) if del_nquads else []
         if set_json is not None:
-            nquads_set += mut.nquads_from_json(set_json, Op.SET)
+            nquads_set += mut.nquads_from_json(set_json, Op.SET,
+                                               schema=self.store.schema)
         if delete_json is not None:
-            nquads_del += mut.nquads_from_json(delete_json, Op.DEL)
+            nquads_del += mut.nquads_from_json(delete_json, Op.DEL,
+                                               schema=self.store.schema)
         return self.mutate_quads(nquads_set, nquads_del,
                                  commit_now=commit_now, start_ts=start_ts,
                                  timeout_ms=timeout_ms)

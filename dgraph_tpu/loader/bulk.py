@@ -55,6 +55,17 @@ from dgraph_tpu.utils.schema import parse_schema
 from dgraph_tpu.utils.types import TypeID, Val, convert
 
 
+def _check_vector_dim(entry, v, attr: str, s: int) -> None:
+    """float32vector literal vs the schema's @index(vector(dim: D)) —
+    reject the load with a typed error instead of folding a ragged row
+    (NaN components were already rejected at parse, types.parse_vector)."""
+    if v.tid == TypeID.VECTOR and entry.vector is not None and \
+            len(v.value) != entry.vector.dim:
+        raise BulkError(
+            f"predicate <{attr}>, subject 0x{s:x}: vector dimension "
+            f"{len(v.value)} != schema dim {entry.vector.dim}")
+
+
 class BulkError(ValueError):
     pass
 
@@ -338,6 +349,7 @@ def bulk_load(rdf_paths: str | list[str], schema_text: str, out_dir: str, *,
                             raise BulkError(
                                 f"predicate <{attr}>, subject 0x{s:x}: "
                                 f"{e}") from e
+                    _check_vector_dim(entry, v, attr, s)
                     slot = value_fingerprint(v) if entry.is_list \
                         else lang_uid(lang)
                     slots.append(slot)
@@ -608,6 +620,7 @@ def _bulk_load_spill_inner(paths: list[str], schema_text: str, out_dir: str,
                             raise BulkError(
                                 f"predicate <{attr}>, subject 0x{s:x}: "
                                 f"{e}") from e
+                    _check_vector_dim(entry, v, attr, s)
                     slot = value_fingerprint(v) if entry.is_list \
                         else lang_uid(lang)
                     slots.append(slot)

@@ -27,6 +27,7 @@ _TYPE_TAG = {
     TypeID.GEO: "geo:geojson",
     TypeID.PASSWORD: "pwd:hashed",     # raw hash — re-imports without re-hash
     TypeID.BINARY: "xs:base64Binary",
+    TypeID.VECTOR: "xs:float32vector",
 }
 
 
@@ -49,6 +50,10 @@ def _val_literal(v: Val, lang: str) -> str:
         import json
 
         text = json.dumps(v.value, separators=(",", ":"))
+    elif v.tid == TypeID.VECTOR:
+        from dgraph_tpu.utils.types import vector_str
+
+        text = vector_str(v.value)
     else:
         text = str(v.value)
     if lang:

@@ -1,0 +1,185 @@
+"""Dense-vector top-k kernels: the similarity probe of @index(vector).
+
+The vector index's probe is the hardware's single best operation — a
+segmented matmul + reduce (ROADMAP item 4): score = M @ q over the
+predicate's row-aligned [R, D] HBM-resident embedding matrix, followed by
+a running top-k merge. The kernels here follow the repo's device
+conventions (ops/csr.py): static capacity classes (row space and k are
+padded to pow2) so jit retraces are bounded, sentinel padding instead of
+dynamic shapes, and one fused program per logical step.
+
+Numerical contract (storage/vecindex.py owns the orchestration):
+
+  * the DEVICE stage ranks by float32 *negated distance* — it only has to
+    produce a candidate SUPERSET (k' >= k, with margin);
+  * the HOST re-scores candidates in float64 and picks the final k by
+    (distance, uid) — one exact, deterministic ranking rule shared by the
+    host-scan, device, IVF, mesh-sharded, and fused-ANN paths, so every
+    path returns byte-identical results.
+
+Distances: cosine -> 1 - cos(x, q); l2 -> squared L2; dot -> -x.q.
+Smaller is better everywhere; the device carries the negation so
+lax.top_k's descending order applies.
+
+`ann_expand` is the hybrid-pipeline kernel: top-k candidates -> uid
+mapping -> CSR frontier expansion in ONE jitted program, so an ANN root
+feeding a graph hop never round-trips through the host between stages
+(the span tree shows a single device_kernel, tests/test_vector.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dgraph_tpu.ops.csr import ExpandResult, expand
+from dgraph_tpu.ops.uidset import sentinel
+
+METRICS = ("cosine", "l2", "dot")
+
+# default row-block size of the tiled scan (pow2; bumped to k when k is
+# larger so the per-block top-k stays well-formed)
+BLOCK_ROWS = 1 << 12
+
+
+def row_capacity(n: int) -> int:
+    """Pow2 row-capacity class for an n-row matrix (>= 8)."""
+    return 1 << max(int(np.ceil(np.log2(max(n, 1) + 1))), 3)
+
+
+def k_capacity(k: int, n_cap: int) -> int:
+    """Pow2 candidate-capacity class for a final top-k of k: enough margin
+    that the float64 re-rank's winners are inside the float32 candidate
+    set for anything but adversarially tied corpora."""
+    want = max(2 * k, k + 16)
+    return min(1 << int(np.ceil(np.log2(max(want, 1)))), n_cap)
+
+
+def host_distances(vecs64: np.ndarray, q64: np.ndarray, metric: str) -> np.ndarray:
+    """Exact float64 distances of every row — the reference ranking every
+    other path must reproduce (and the brute-force acceptance gate)."""
+    s = vecs64 @ q64
+    if metric == "cosine":
+        nx = np.linalg.norm(vecs64, axis=1)
+        nq = np.linalg.norm(q64)
+        return 1.0 - s / np.maximum(nx * nq, 1e-300)
+    if metric == "l2":
+        nx2 = np.einsum("ij,ij->i", vecs64, vecs64)
+        return nx2 - 2.0 * s + float(q64 @ q64)
+    return -s                               # dot
+
+
+def _block_neg_dist(blk, nrm, qv, qn, qn2, metric: str):
+    """Negated distance of one row block (float32, MXU matmul)."""
+    s = jnp.dot(blk, qv, preferred_element_type=jnp.float32)
+    if metric == "cosine":
+        return s / jnp.maximum(nrm * qn, 1e-30) - 1.0
+    if metric == "l2":
+        return -(nrm * nrm - 2.0 * s + qn2)
+    return s                                # dot
+
+
+def _topk_body(matrix, norms, valid, qv, k: int, metric: str, block: int):
+    """Tiled scan: per block, score + mask + local top-k, merged into the
+    running (neg_dist, row) top-k carry. Ties prefer earlier rows (rows are
+    uid-sorted, so equal scores break toward the smaller uid — the same
+    tie rule the host float64 ranking uses)."""
+    R, D = matrix.shape
+    qn2 = jnp.sum(qv * qv)
+    qn = jnp.sqrt(qn2)
+    nblocks = R // block
+
+    def body(i, carry):
+        bs, br = carry
+        lo = i * block
+        blk = lax.dynamic_slice(matrix, (lo, 0), (block, D))
+        nrm = lax.dynamic_slice(norms, (lo,), (block,))
+        vb = lax.dynamic_slice(valid, (lo,), (block,))
+        nd = _block_neg_dist(blk, nrm, qv, qn, qn2, metric)
+        nd = jnp.where(vb, nd, -jnp.inf)
+        cs, ci = lax.top_k(nd, k)
+        ms, mi = lax.top_k(jnp.concatenate([bs, cs]), k)
+        rows = jnp.concatenate([br, (lo + ci).astype(jnp.int32)])
+        return ms, jnp.take(rows, mi)
+
+    init = (jnp.full((k,), -jnp.inf, jnp.float32),
+            jnp.full((k,), R, jnp.int32))
+    return lax.fori_loop(0, nblocks, body, init)
+
+
+def _valid_mask(R: int, nrows, dead_rows):
+    """Row-validity vector: real rows minus the overlay's dead rows
+    (dead_rows is sentinel-padded with R -> dropped by the scatter)."""
+    valid = jnp.arange(R, dtype=jnp.int32) < nrows
+    return valid.at[dead_rows].set(False, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "block"))
+def topk_candidates(matrix, norms, qv, nrows, dead_rows, *,
+                    k: int, metric: str, block: int):
+    """Float32 candidate stage: (neg_dist f32[k], rows i32[k]); padding /
+    masked rows surface as (-inf, R)."""
+    valid = _valid_mask(matrix.shape[0], nrows, dead_rows)
+    return _topk_body(matrix, norms, valid, qv, k, metric, block)
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def ivf_topk(matrix, norms, qv, cand_rows, *, k: int, metric: str):
+    """IVF fine stage: score ONLY the gathered candidate rows (cand_rows
+    sentinel-padded with R) — the gather + matmul + top-k of the selected
+    nprobe lists as one program."""
+    R, _D = matrix.shape
+    ok = cand_rows < R
+    rc = jnp.clip(cand_rows, 0, R - 1).astype(jnp.int32)
+    blk = jnp.take(matrix, rc, axis=0)
+    nrm = jnp.take(norms, rc)
+    qn2 = jnp.sum(qv * qv)
+    qn = jnp.sqrt(qn2)
+    nd = _block_neg_dist(blk, nrm, qv, qn, qn2, metric)
+    nd = jnp.where(ok, nd, -jnp.inf)
+    kk = min(k, int(cand_rows.shape[0]))
+    cs, ci = lax.top_k(nd, kk)
+    rows = jnp.where(cs > -jnp.inf, jnp.take(rc, ci), R)
+    if kk < k:
+        cs = jnp.concatenate([cs, jnp.full((k - kk,), -jnp.inf, jnp.float32)])
+        rows = jnp.concatenate([rows, jnp.full((k - kk,), R, jnp.int32)])
+    return cs, rows
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "block", "ecap"))
+def ann_expand(matrix, norms, qv, nrows, dead_rows, vec_subjects,
+               csr_subjects, indptr, indices, *,
+               k: int, metric: str, block: int, ecap: int):
+    """Fused ANN -> graph hop: top-k candidate rows, map rows -> uids ->
+    CSR rows, expand the candidate frontier — ONE device dispatch, no host
+    round trip between the ANN stage and the traversal stage.
+
+    Returns (neg_dist f32[k], cand_uids i32[k] sentinel-padded,
+    ExpandResult over the k candidate slots). The host slices the
+    expansion rows of the float64-selected final k."""
+    R = matrix.shape[0]
+    valid = _valid_mask(R, nrows, dead_rows)
+    nd, rows = _topk_body(matrix, norms, valid, qv, k, metric, block)
+    snt = sentinel(csr_subjects.dtype) if csr_subjects.shape[0] else \
+        sentinel(jnp.int32)
+    ok = nd > -jnp.inf
+    uids = jnp.where(ok, jnp.take(vec_subjects,
+                                  jnp.clip(rows, 0, R - 1)), snt)
+    if csr_subjects.shape[0]:
+        pos = jnp.clip(jnp.searchsorted(csr_subjects, uids), 0,
+                       csr_subjects.shape[0] - 1).astype(jnp.int32)
+        hit = ok & (jnp.take(csr_subjects, pos) == uids)
+        crows = jnp.where(hit, pos, snt)
+    else:
+        crows = jnp.full((k,), snt, dtype=jnp.int32)
+    res = expand(indptr, indices, crows, ecap)
+    return nd, uids, res
+
+
+__all__ = ["METRICS", "BLOCK_ROWS", "ExpandResult", "row_capacity",
+           "k_capacity", "host_distances", "topk_candidates", "ivf_topk",
+           "ann_expand"]

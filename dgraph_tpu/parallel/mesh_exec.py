@@ -164,6 +164,7 @@ class MeshExecutor:
         if hit is not None and hit[0] is snap:
             return hit[1]
         out = GraphSnapshot(snap.read_ts)
+        out.metrics = getattr(snap, "metrics", None)
         sharded = replicated = 0
         for attr, pd in snap.preds.items():
             placed = self._place_pred(pd)
@@ -189,11 +190,28 @@ class MeshExecutor:
             return hit[1]
         csr = self._place_csr(pd.csr)
         rev = self._place_csr(pd.rev_csr)
-        placed = pd if (csr is pd.csr and rev is pd.rev_csr) \
-            else replace(pd, csr=csr, rev_csr=rev)
+        vec = self._place_vec(pd.vecindex)
+        placed = pd if (csr is pd.csr and rev is pd.rev_csr
+                        and vec is pd.vecindex) \
+            else replace(pd, csr=csr, rev_csr=rev, vecindex=vec)
         self._placed_pd[id(pd)] = (pd, placed)
         while len(self._placed_pd) > self._PLACE_CACHE:
             self._placed_pd.popitem(last=False)
+        return placed
+
+    def _place_vec(self, vi):
+        """Mesh placement of a vector index: large embedding matrices scan
+        row-sharded across the mesh with a replicated top-k merge
+        (vector_topk); small ones and delta overlays stay on the classic
+        single-device/host path until compaction folds a fresh base."""
+        if vi is None or vi.is_overlay or \
+                vi.n * vi.dim < self.SHARD_MIN_EDGES:
+            return vi
+        import copy
+
+        placed = copy.copy(vi)
+        placed._mesh = self
+        placed._mesh_dev = None
         return placed
 
     def _place_csr(self, csr):
@@ -411,6 +429,96 @@ class MeshExecutor:
             keep = fresh[s, l0: l0 + (g1 - g0)]
             out.append(indices[g0:g1][keep].astype(np.int64))
         return out
+
+    # -- sharded vector top-k: row-scan fan-out, replicated merge ------------
+
+    def _vec_program(self, rows_per: int, dim: int, kk: int, metric: str):
+        key = ("vec", rows_per, dim, kk, metric)
+        prog = self._step_progs.get(key)
+        if prog is not None:
+            return prog
+        self._c_compiles.inc()
+        mesh = self.mesh
+
+        def run(mat, nrm, valid, qv):
+            from dgraph_tpu.ops.vector import _block_neg_dist
+
+            m, n, v = mat[0], nrm[0], valid[0]
+            qn2 = jnp.sum(qv * qv)
+            qn = jnp.sqrt(qn2)
+            nd = _block_neg_dist(m, n, qv, qn, qn2, metric)
+            nd = jnp.where(v, nd, -jnp.inf)
+            cs, ci = lax.top_k(nd, kk)
+            rows = (lax.axis_index("shard") * rows_per + ci).astype(
+                jnp.int32)
+            # the replicated top-k merge: each shard's local winners
+            # all-gather over ICI; the host takes the union as the
+            # candidate superset (global top-kk ⊆ union by construction)
+            gs = lax.all_gather(cs, "shard")
+            gr = lax.all_gather(rows, "shard")
+            return gs.reshape(-1), gr.reshape(-1)
+
+        prog = jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=(P("shard"), P("shard"), P("shard"), P()),
+            out_specs=(P(), P()), check_rep=False))
+        self._step_progs[key] = prog
+        return prog
+
+    def _vec_sharded(self, vi):
+        dev = getattr(vi, "_mesh_dev", None)
+        if dev is not None:
+            return dev
+        from jax.sharding import NamedSharding
+
+        nd = self.n_devices
+        from dgraph_tpu.ops.vector import row_capacity
+
+        # ceil-division shard rows (dist.shard_rows_per convention): a
+        # non-pow2 device count must still tile the pow2 row capacity
+        rows_per = -(-max(row_capacity(vi.n), nd) // nd)
+        R = rows_per * nd
+        mat = np.zeros((nd, rows_per, vi.dim), dtype=np.float32)
+        mat.reshape(R, vi.dim)[: vi.n] = vi.vecs
+        nrm = np.ones((nd, rows_per), dtype=np.float32)
+        nrm.reshape(R)[: vi.n] = np.linalg.norm(vi.vecs, axis=1)
+        sh = NamedSharding(self.mesh, P("shard"))
+        dev = (jax.device_put(mat, sh), jax.device_put(nrm, sh),
+               R, rows_per)
+        vi._mesh_dev = dev
+        return dev
+
+    def vector_topk(self, vi, q: np.ndarray, kprime: int,
+                    dead_rows: np.ndarray) -> np.ndarray:
+        """Float32 candidate rows of one similarity probe, row-sharded
+        across the mesh (storage/vecindex.search's device stage; the
+        float64 re-rank stays on the host, so mesh results are
+        byte-identical to the single-device path)."""
+        from jax.sharding import NamedSharding
+
+        mat, nrm, R, rows_per = self._vec_sharded(vi)
+        valid = np.zeros(R, dtype=bool)
+        valid[: vi.n] = True
+        if len(dead_rows):
+            valid[dead_rows] = False
+        vdev = jax.device_put(
+            valid.reshape(self.n_devices, rows_per),
+            NamedSharding(self.mesh, P("shard")))
+        kk = min(kprime, rows_per)
+        prog = self._vec_program(rows_per, vi.dim, kk, vi.metric)
+        with otrace.span("device_kernel", kernel="mesh.vector_topk",
+                         rows=int(vi.n), k=kk,
+                         devices=self.n_devices) as sp:
+            with self.mesh:
+                scores, rows = prog(mat, nrm, vdev,
+                                    jnp.asarray(q.astype(np.float32)))
+            scores_h, rows_h = jax.device_get((scores, rows))
+            self._c_dispatch.inc()
+            self.metrics.counter(
+                "dgraph_vector_mesh_dispatches_total").inc()
+            if sp:
+                sp.set(cands=int((scores_h > -np.inf).sum()))
+        return rows_h[scores_h > -np.inf]
 
     # -- stepped traversal: device-staged frontier (shortest / k-shortest) --
 

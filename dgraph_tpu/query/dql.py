@@ -969,7 +969,8 @@ def _is_func_ahead(toks: list[Tok], i: int) -> bool:
 
 _FUNC_NAMES = {"eq", "le", "lt", "ge", "gt", "anyofterms", "allofterms", "anyoftext",
                "alloftext", "regexp", "near", "within", "contains", "intersects",
-               "uid", "uid_in", "has", "checkpwd", "val", "not", "and", "or"}
+               "uid", "uid_in", "has", "checkpwd", "val", "not", "and", "or",
+               "similar_to"}
 
 
 def _collect_math_vars(m: MathTree, out: list[str]) -> None:

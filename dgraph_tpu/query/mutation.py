@@ -128,6 +128,8 @@ def _validate_and_convert(store: Store, e: DirectedEdge) -> DirectedEdge:
         return e
     want = entry.type_id
     if want in (TypeID.DEFAULT, TypeID.UID) or e.value.tid == want:
+        if e.value.tid == TypeID.VECTOR:
+            _check_vector(entry, e.value)
         return e
     try:
         v = convert(e.value, want)
@@ -135,8 +137,21 @@ def _validate_and_convert(store: Store, e: DirectedEdge) -> DirectedEdge:
         raise MutationError(
             f"cannot convert value {e.value.value!r} for predicate "
             f"{e.attr!r} to schema type {want.name.lower()}: {ex}") from None
+    if v.tid == TypeID.VECTOR:
+        _check_vector(entry, v)
     return DirectedEdge(e.subject, e.attr, value=v, op=e.op, lang=e.lang,
                         facets=e.facets)
+
+
+def _check_vector(entry, v: Val) -> None:
+    """Typed client error for a vector literal that violates the schema's
+    @index(vector(dim: D)) declaration. NaN/Inf components are rejected at
+    parse time (types.parse_vector) — a poisoned row would corrupt every
+    similarity score it touches."""
+    if entry.vector is not None and len(v.value) != entry.vector.dim:
+        raise MutationError(
+            f"vector for predicate {entry.predicate!r} has dimension "
+            f"{len(v.value)}, schema declares dim {entry.vector.dim}")
 
 
 def split_edges_by_group(edges, n_groups: int, owner_fn) -> dict[int, list]:
@@ -221,7 +236,8 @@ def _scalar_val(v: Any) -> Val:
     raise MutationError(f"unsupported JSON value {v!r}")
 
 
-def nquads_from_json(obj: Any, op: Op = Op.SET) -> list[rdf.NQuad]:
+def nquads_from_json(obj: Any, op: Op = Op.SET,
+                     schema=None) -> list[rdf.NQuad]:
     """JSON object(s) → NQuads (reference edgraph/nquads_from_json.go).
 
     - "uid" field names the node ("0x1", or "_:b" blanks); absent → a fresh
@@ -230,6 +246,10 @@ def nquads_from_json(obj: Any, op: Op = Op.SET) -> list[rdf.NQuad]:
     - "pred|facet" keys attach facets to the sibling "pred" edge.
     - in delete mode a null value means "delete all values of pred"
       (S P * star), and {"uid": u} alone means delete the whole node (S * *).
+    - with `schema` (a SchemaState), a JSON number array under a
+      float32vector predicate becomes ONE vector literal instead of
+      per-element scalar quads (NaN components and empty arrays reject
+      with a typed error; dim is checked downstream against the schema).
     """
     out: list[rdf.NQuad] = []
     counter = [0]
@@ -237,12 +257,28 @@ def nquads_from_json(obj: Any, op: Op = Op.SET) -> list[rdf.NQuad]:
     for item in items:
         if not isinstance(item, dict):
             raise MutationError("JSON mutation must be an object or list of objects")
-        _json_node(item, op, counter, out)
+        _json_node(item, op, counter, out, schema)
     return out
 
 
+def _is_vector_pred(schema, pred: str) -> bool:
+    if schema is None:
+        return False
+    e = schema.get(pred)
+    return e is not None and e.type_id == TypeID.VECTOR
+
+
+def _vector_val(v) -> Val:
+    from dgraph_tpu.utils.types import parse_vector
+
+    try:
+        return Val(TypeID.VECTOR, parse_vector(v))
+    except ValueError as ex:
+        raise MutationError(f"bad vector value: {ex}") from None
+
+
 def _json_node(obj: dict, op: Op, counter: list[int],
-               out: list[rdf.NQuad]) -> str:
+               out: list[rdf.NQuad], schema=None) -> str:
     """Emit one object's NQuads; returns its uid / blank-node name."""
     uid = obj.get("uid")
     if uid is None or uid == "":
@@ -274,15 +310,20 @@ def _json_node(obj: dict, op: Op, counter: list[int],
             continue
         facets = facet_map.get(pred, [])
         if isinstance(v, dict) and not _is_geo(v):
-            child = _json_node(v, op, counter, out)
+            child = _json_node(v, op, counter, out, schema)
             out.append(rdf.NQuad(subject=uid, predicate=pred,
                                  object_id=child, facets=facets))
         elif isinstance(v, list) and v and all(
                 isinstance(x, dict) and not _is_geo(x) for x in v):
             for x in v:
-                child = _json_node(x, op, counter, out)
+                child = _json_node(x, op, counter, out, schema)
                 out.append(rdf.NQuad(subject=uid, predicate=pred,
                                      object_id=child, facets=facets))
+        elif isinstance(v, list) and _is_vector_pred(schema, pred):
+            # float32vector predicate: the JSON array IS one embedding
+            out.append(rdf.NQuad(subject=uid, predicate=pred,
+                                 object_value=_vector_val(v), lang=lang,
+                                 facets=facets))
         elif isinstance(v, list):
             for x in v:
                 out.append(rdf.NQuad(subject=uid, predicate=pred,

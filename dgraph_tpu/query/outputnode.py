@@ -39,6 +39,8 @@ def _val_json(v: Val) -> Any:
         import base64
 
         return base64.b64encode(v.value).decode("ascii")
+    if v.tid == TypeID.VECTOR:
+        return [float(x) for x in v.value]
     return v.value
 
 

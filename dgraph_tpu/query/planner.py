@@ -63,13 +63,14 @@ DEVICE_MIN_EDGES = 1 << 20
 _INDEX_FUNCS = frozenset({"eq", "le", "lt", "ge", "gt", "anyofterms",
                           "allofterms", "anyoftext", "alloftext",
                           "regexp", "near", "within", "contains",
-                          "intersects"})
+                          "intersects", "similar_to"})
 # functions safe to PROMOTE to the root position: frontier-independent
 # index probes (uid/val/count shapes read executor state; has is a scan —
-# never an upgrade)
+# never an upgrade). similar_to qualifies: its filter form evaluates as
+# global-top-k ∩ frontier, which is pointwise in the frontier.
 _ROOT_SWAPPABLE = frozenset({"eq", "le", "lt", "ge", "gt", "anyofterms",
                              "allofterms", "anyoftext", "alloftext",
-                             "regexp"})
+                             "regexp", "similar_to"})
 
 
 @dataclass
@@ -235,6 +236,17 @@ def _est_func(fn: dql.Function, snap, schema, metrics,
     if name in ("near", "within", "contains", "intersects"):
         return max(st.index_postings.get("geo", 0) // 4, 1), \
             "index probe", False
+    if name == "similar_to":
+        # top-k probe over the vector index: at most k results (exactly k
+        # when the tablet has >= k embeddings). A vector predicate with no
+        # index rows at this snapshot estimates 0 — and when stats are
+        # absent entirely the plan simply costs it 0, never raises: the
+        # executor (not the planner) owns similar_to's typed errors.
+        k = next((int(a) for a in fn.args
+                  if isinstance(a, int) and not isinstance(a, bool)), 0)
+        if st.vector_rows <= 0:
+            return 0, "index probe", False
+        return max(min(k or 1, st.vector_rows), 1), "index probe", False
     if name in ("uid_in", "checkpwd"):
         return max(frontier_est // 2, 1), "frontier probe", True
     return st.has_card, "tablet scan", True

@@ -47,6 +47,7 @@ _XSD_TYPES = {
     "geo:geojson": TypeID.GEO,
     "xs:password": TypeID.PASSWORD, "pwd:password": TypeID.PASSWORD,
     "xs:base64Binary": TypeID.BINARY,
+    "xs:float32vector": TypeID.VECTOR,
 }
 # full http://www.w3.org/2001/XMLSchema# forms
 for _k, _v in list(_XSD_TYPES.items()):

@@ -50,6 +50,7 @@ class PredCSR:
     indptr: jnp.ndarray     # int32[N+1]
     indices: jnp.ndarray    # int32[E] sorted within each row
     _host: tuple | None = None   # lazy (subjects, indptr, indices) mirrors
+    _max_degree: int | None = None   # lazy per-snapshot constant
 
     @property
     def num_subjects(self) -> int:
@@ -67,6 +68,15 @@ class PredCSR:
             self._host = (np.asarray(self.subjects), np.asarray(self.indptr),
                           np.asarray(self.indices))
         return self._host
+
+    def max_degree(self) -> int:
+        """Largest row length — cached: capacity sizing (the fused ANN
+        pipeline's ecap) runs per query and must not rescan indptr."""
+        if self._max_degree is None:
+            ptr = self.host_arrays()[1]
+            self._max_degree = int(np.max(ptr[1:] - ptr[:-1])) \
+                if len(ptr) > 1 else 0
+        return self._max_degree
 
 
 @dataclass
@@ -110,6 +120,9 @@ class PredData:
     lang_values: dict[int, dict[str, Val]] = field(default_factory=dict)
     facets: dict[tuple[int, int], tuple] = field(default_factory=dict)  # (subj,obj/slot)->facets
     indexes: dict[str, TokenIndex] = field(default_factory=dict)
+    # @index(vector) predicates: row-aligned embedding matrix + IVF
+    # (storage/vecindex.VectorIndex, or VecOverlay when delta-stamped)
+    vecindex: object | None = None
 
     def has_subjects(self) -> np.ndarray:
         """uids for has(attr): subjects with any edge or value (host
@@ -194,6 +207,8 @@ class GraphSnapshot:
                 total += pd.num_values.nbytes
             for ti in pd.indexes.values():
                 total += ti.indptr.nbytes + ti.uids.nbytes
+            if pd.vecindex is not None:
+                total += pd.vecindex.nbytes()
         return total
 
 
@@ -466,6 +481,15 @@ def build_pred(store: Store, attr: str, read_ts: int,
         pd.rev_csr = _fold_uid_tablet(store, rkbs, read_ts, own, None,
                                       kind=int(K.KeyKind.REVERSE))
 
+    # vector index: fold the predicate's embeddings into the row-aligned
+    # device matrix (+ IVF coarse quantizer past the size threshold)
+    if entry is not None and entry.vector is not None:
+        from dgraph_tpu.storage import vecindex as vecmod
+
+        pd.vecindex = vecmod.build_vecindex(
+            attr, entry.vector, pd.host_values,
+            knobs=getattr(store, "vector_knobs", None))
+
     # token indexes, split per tokenizer by the 1-byte term prefix
     if entry is not None and entry.indexed:
         from dgraph_tpu.utils import tok as tokmod
@@ -698,6 +722,10 @@ class SnapshotAssembler:
             # exactly the task-cache invalidations per-predicate tokens avoid
             self.metrics.counter(
                 "dgraph_cache_invalidations_avoided_total").inc(reused)
+        # query-time instrumentation that lives below the Node (vector
+        # searches in query/task.py) reads the owning registry off the
+        # snapshot — per-node correct, no module globals
+        snap.metrics = self.metrics
         self._stamp(snap)
         return snap
 

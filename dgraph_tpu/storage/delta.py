@@ -398,6 +398,7 @@ def stamp_pred(store, attr: str, base_pd, read_ts: int,
     pd.lang_values = base_pd.lang_values
     pd.facets = base_pd.facets
     pd.indexes = base_pd.indexes
+    pd.vecindex = base_pd.vecindex
 
     if data_k:
         _stamp_data(store, pd, base_pd, entry, tid, data_k, read_ts)
@@ -474,6 +475,18 @@ def _stamp_data(store, pd, base_pd, entry, tid, data_k, read_ts) -> None:
 
     if value_side:
         _patch_value_arrays(pd, base_pd, touched, val_entries)
+        if entry is not None and entry.vector is not None:
+            # vector-index overlay: replacement embedding rows for exactly
+            # the touched subjects (base matrix keeps device identity —
+            # commit-to-visible costs O(Δ), never a re-fold/re-upload)
+            from dgraph_tpu.storage import vecindex as vecmod
+
+            base_vi = base_pd.vecindex
+            if base_vi is not None and base_vi.is_overlay:
+                raise ValueError("stacked overlay")
+            pd.vecindex = vecmod.stamp_vecindex(
+                base_vi, entry.predicate, entry.vector, touched,
+                pd.host_values)
 
 
 def _patch_value_arrays(pd, base_pd, touched: np.ndarray,
@@ -545,4 +558,7 @@ def overlay_nbytes(pd) -> int:
     for csr in (pd.csr, pd.rev_csr):
         if isinstance(csr, OverlayCSR):
             n += csr.delta.nbytes()
+    vi = getattr(pd, "vecindex", None)
+    if vi is not None and getattr(vi, "is_overlay", False):
+        n += vi.nbytes()
     return n

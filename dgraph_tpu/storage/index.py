@@ -184,8 +184,9 @@ def _commit_synthetic(store: Store, attr: str, kind: K.KeyKind,
 def needs_reindex(old: SchemaEntry | None, new: SchemaEntry) -> bool:
     """Schema change requires an index rebuild (worker/mutation.go:199)."""
     if old is None:
-        return bool(new.tokenizers or new.reverse or new.count)
+        return bool(new.tokenizers or new.reverse or new.count or new.vector)
     return (set(old.tokenizers) != set(new.tokenizers)
             or old.reverse != new.reverse
             or old.count != new.count
-            or old.type_id != new.type_id)
+            or old.type_id != new.type_id
+            or old.vector != new.vector)

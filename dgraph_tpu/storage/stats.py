@@ -140,6 +140,11 @@ class PredStats:
     lang_values: int = 0
     index_terms: dict[str, int] = field(default_factory=dict)
     index_postings: dict[str, int] = field(default_factory=dict)
+    # @index(vector) predicates: embedding row count + dimensionality
+    # (deliberately OUTSIDE index_terms/index_postings — the vector index
+    # is not a TokenIndex and must never trip the term-sketch paths)
+    vector_rows: int = 0
+    vector_dim: int = 0
 
     @property
     def has_card(self) -> int:
@@ -167,6 +172,9 @@ class PredStats:
             "index_terms": dict(self.index_terms),
             "index_postings": dict(self.index_postings),
             "via_delta": self.fwd.via_delta,
+            **({"vector": {"rows": self.vector_rows,
+                           "dim": self.vector_dim}}
+               if self.vector_rows else {}),
         }
 
 
@@ -195,6 +203,8 @@ def pred_stats(pd, metrics=None) -> PredStats:
             name: int(np.asarray(ti.host_arrays()[0])[-1])
             if len(ti.terms) else 0
             for name, ti in pd.indexes.items()},
+        vector_rows=0 if pd.vecindex is None else int(pd.vecindex.n),
+        vector_dim=0 if pd.vecindex is None else int(pd.vecindex.dim),
     )
     pd.__dict__[_STATS_ATTR] = st
     return st

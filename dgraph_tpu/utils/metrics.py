@@ -224,7 +224,13 @@ class Registry:
                      "dgraph_hedge_fired_total",
                      "dgraph_breaker_open_total",
                      "dgraph_degraded_reads_total",
-                     "dgraph_fault_injected_total"):
+                     "dgraph_fault_injected_total",
+                     # vector similarity index (storage/vecindex.py,
+                     # ops/vector.py; ISSUE 8)
+                     "dgraph_vector_searches_total",
+                     "dgraph_vector_ivf_probes_total",
+                     "dgraph_vector_fused_pipelines_total",
+                     "dgraph_vector_mesh_dispatches_total"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()

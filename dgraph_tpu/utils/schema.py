@@ -20,6 +20,22 @@ from dgraph_tpu.utils import tok
 from dgraph_tpu.utils.types import TypeID
 
 
+VECTOR_METRICS = ("cosine", "l2", "dot")
+
+
+@dataclass(frozen=True)
+class VectorSpec:
+    """@index(vector(dim: D[, metric: cosine|l2|dot])) — the TPU-native
+    index type (ROADMAP item 4): a dense-embedding similarity index whose
+    probe is a segmented matmul + top-k (storage/vecindex.py)."""
+
+    dim: int
+    metric: str = "cosine"
+
+    def __str__(self) -> str:
+        return f"vector(dim: {self.dim}, metric: {self.metric})"
+
+
 @dataclass
 class SchemaEntry:
     predicate: str
@@ -30,6 +46,7 @@ class SchemaEntry:
     count: bool = False                                  # @count
     upsert: bool = False                                 # @upsert
     lang: bool = False                                   # @lang
+    vector: VectorSpec | None = None                     # @index(vector(...))
 
     @property
     def indexed(self) -> bool:
@@ -39,6 +56,8 @@ class SchemaEntry:
         parts = []
         if self.tokenizers:
             parts.append("@index(" + ", ".join(self.tokenizers) + ")")
+        if self.vector is not None:
+            parts.append(f"@index({self.vector})")
         if self.reverse:
             parts.append("@reverse")
         if self.count:
@@ -63,6 +82,41 @@ _LINE_RE = re.compile(
     r"^\s*(?P<pred>[^\s:]+)\s*:\s*(?P<list>\[)?\s*(?P<type>\w+)\s*\]?\s*(?P<dirs>[^.]*)\.\s*$"
 )
 _DIR_RE = re.compile(r"@(?P<name>\w+)(?:\((?P<args>[^)]*)\))?")
+# the vector index form nests parens (@index(vector(dim: 8))), which the
+# flat _DIR_RE cannot express — extracted separately before the flat scan
+_VEC_RE = re.compile(r"@index\(\s*vector\s*\((?P<args>[^)]*)\)\s*\)")
+
+
+def _parse_vector_spec(args: str, e: "SchemaEntry") -> VectorSpec:
+    if e.type_id != TypeID.VECTOR:
+        raise ValueError(
+            f"@index(vector) needs float32vector type ({e.predicate})")
+    if e.is_list:
+        raise ValueError(
+            f"@index(vector) on [float32vector] is unsupported ({e.predicate})")
+    dim, metric = 0, "cosine"
+    for part in args.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition(":")
+        k, v = k.strip(), v.strip()
+        if k == "dim":
+            try:
+                dim = int(v)
+            except ValueError:
+                raise ValueError(
+                    f"vector index dim must be an int ({e.predicate})") from None
+        elif k == "metric":
+            metric = v.strip("\"'").lower()
+        else:
+            raise ValueError(f"unknown vector index arg {k!r} ({e.predicate})")
+    if dim < 1:
+        raise ValueError(f"vector index needs dim >= 1 ({e.predicate})")
+    if metric not in VECTOR_METRICS:
+        raise ValueError(
+            f"vector metric must be one of {VECTOR_METRICS} ({e.predicate})")
+    return VectorSpec(dim=dim, metric=metric)
 
 
 def parse_schema(text: str) -> list[SchemaEntry]:
@@ -79,7 +133,12 @@ def parse_schema(text: str) -> list[SchemaEntry]:
         e = SchemaEntry(m.group("pred"))
         e.type_id = TypeID.from_name(m.group("type"))
         e.is_list = m.group("list") is not None
-        for d in _DIR_RE.finditer(m.group("dirs") or ""):
+        dirs = m.group("dirs") or ""
+        vm = _VEC_RE.search(dirs)
+        if vm is not None:
+            e.vector = _parse_vector_spec(vm.group("args"), e)
+            dirs = dirs[: vm.start()] + dirs[vm.end():]
+        for d in _DIR_RE.finditer(dirs):
             name, args = d.group("name"), d.group("args")
             if name == "index":
                 toks = [a.strip() for a in (args or "").split(",") if a.strip()]
@@ -177,6 +236,10 @@ class SchemaState:
         e = self.get(pred)
         return list(e.tokenizers) if e else []
 
+    def vector_spec(self, pred: str) -> VectorSpec | None:
+        e = self.get(pred)
+        return e.vector if e else None
+
     def to_text(self) -> str:
         return "\n".join(str(e) for e in self.entries())
 
@@ -194,6 +257,9 @@ def schema_json(state: "SchemaState", preds: list[str] | None = None) -> list[di
         if e.indexed:
             d["index"] = True
             d["tokenizer"] = list(e.tokenizers)
+        if e.vector is not None:
+            d["index"] = True
+            d["vector"] = {"dim": e.vector.dim, "metric": e.vector.metric}
         for flag in ("reverse", "count", "upsert", "lang"):
             if getattr(e, flag, False):
                 d[flag] = True

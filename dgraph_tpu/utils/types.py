@@ -31,6 +31,7 @@ class TypeID(IntEnum):
     GEO = 7
     UID = 8
     PASSWORD = 9
+    VECTOR = 10          # float32vector: dense embedding (tuple of floats)
 
     @classmethod
     def from_name(cls, name: str) -> "TypeID":
@@ -51,6 +52,7 @@ _NAME_TO_TYPE = {
     "geo": TypeID.GEO,
     "uid": TypeID.UID,
     "password": TypeID.PASSWORD,
+    "float32vector": TypeID.VECTOR,
 }
 
 TYPE_NAMES = {v: k for k, v in _NAME_TO_TYPE.items()}
@@ -96,6 +98,47 @@ def _check_int64(v: int) -> int:
     return v
 
 
+def parse_vector(raw) -> tuple[float, ...]:
+    """Parse a float32vector literal: a `"[0.1, 0.2, ...]"` string or a
+    JSON array of numbers. Values are snapped to float32 (the storage and
+    device precision) so WAL/snapshot round-trips are bit-exact; NaN/Inf
+    components reject the value — a NaN row would poison every similarity
+    score it touches."""
+    import math
+
+    if isinstance(raw, str):
+        s = raw.strip()
+        if not (s.startswith("[") and s.endswith("]")):
+            raise ValueError(f"vector literal must be [v1, v2, ...]: {raw!r}")
+        body = s[1:-1].strip()
+        parts = [p for p in body.split(",") if p.strip()] if body else []
+        try:
+            xs = [float(p) for p in parts]
+        except ValueError:
+            raise ValueError(f"bad vector component in {raw!r}") from None
+    elif isinstance(raw, (list, tuple)):
+        xs = []
+        for x in raw:
+            if isinstance(x, bool) or not isinstance(x, (int, float)):
+                raise ValueError(f"vector component {x!r} is not a number")
+            xs.append(float(x))
+    else:
+        raise ValueError(f"cannot parse vector from {type(raw).__name__}")
+    if not xs:
+        raise ValueError("empty vector")
+    if any(not math.isfinite(x) for x in xs):
+        raise ValueError("vector contains NaN/Inf components")
+    import numpy as _np
+
+    return tuple(float(x) for x in _np.asarray(xs, dtype=_np.float32))
+
+
+def vector_str(v: tuple[float, ...]) -> str:
+    """Canonical string form of a vector value (repr round-trips float32
+    exactly through parse_vector)."""
+    return "[" + ", ".join(repr(float(x)) for x in v) + "]"
+
+
 def convert(src: Val, to: TypeID) -> Val:
     """Convert a value between scalar types; raises ValueError when undefined.
 
@@ -131,6 +174,8 @@ def convert(src: Val, to: TypeID) -> Val:
                 from dgraph_tpu.utils import geo as geomod
 
                 return Val(to, geomod.parse_geojson(s))
+            if to == TypeID.VECTOR:
+                return Val(to, parse_vector(s))
         elif src.tid == TypeID.INT:
             if to == TypeID.FLOAT:
                 return Val(to, float(v))
@@ -171,6 +216,9 @@ def convert(src: Val, to: TypeID) -> Val:
                 from dgraph_tpu.utils import geo as geomod
 
                 return Val(to, geomod.to_geojson(v))
+        elif src.tid == TypeID.VECTOR:
+            if to in (TypeID.STRING, TypeID.DEFAULT):
+                return Val(to, vector_str(v))
     except (ValueError, TypeError, OverflowError) as e:
         raise ValueError(f"cannot convert {src!r} to {TYPE_NAMES[to]}: {e}") from None
     raise ValueError(f"no conversion from {TYPE_NAMES[src.tid]} to {TYPE_NAMES[to]}")
@@ -279,6 +327,9 @@ def marshal(v: Val) -> bytes:
         return geomod.to_geojson(v.value).encode("utf-8")
     if tid == TypeID.UID:
         return struct.pack("<Q", int(v.value))
+    if tid == TypeID.VECTOR:
+        xs = v.value
+        return struct.pack(f"<{len(xs)}f", *xs)
     raise ValueError(f"cannot marshal {v!r}")
 
 
@@ -301,4 +352,7 @@ def unmarshal(tid: TypeID, b: bytes) -> Val:
         return Val(tid, geomod.parse_geojson(b.decode("utf-8")))
     if tid == TypeID.UID:
         return Val(tid, struct.unpack("<Q", b)[0])
+    if tid == TypeID.VECTOR:
+        n = len(b) // 4
+        return Val(tid, tuple(float(x) for x in struct.unpack(f"<{n}f", b)))
     raise ValueError(f"cannot unmarshal type {tid}")

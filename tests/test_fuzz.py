@@ -18,7 +18,8 @@ def test_dql_parser_never_crashes():
              'math', '+', '<p>', '~', 'count', 'first:', '3', ',', ':', '@',
              '.', 'le', '[', ']', 'upsert', 'mutation', 'set', '@if', 'len',
              'shortest', 'from:', 'to:', 'expand', '_all_', '*', '/re/',
-             '$var', 'schema', 'pred:']
+             '$var', 'schema', 'pred:', 'similar_to', 'emb', '"[0.1, 0.2]"',
+             '0.5', '-1.5', 'vector_distance', 'orderasc:']
     for _ in range(N):
         s = " ".join(rng.choice(frags)
                      for _ in range(rng.randint(1, 24)))
@@ -48,7 +49,9 @@ def test_schema_parser_never_crashes():
     rng = random.Random(13)
     frags = ['name', ':', 'string', 'int', 'uid', '[', ']', '@index', '(',
              ')', 'term', 'exact', ',', '@reverse', '@count', '@lang',
-             '@upsert', '.', '<p>', 'geo', 'password', 'bogus']
+             '@upsert', '.', '<p>', 'geo', 'password', 'bogus',
+             'float32vector', 'vector', 'dim:', 'metric:', 'cosine', 'l2',
+             'dot', '8', '-3']
     for _ in range(N):
         s = " ".join(rng.choice(frags)
                      for _ in range(rng.randint(1, 12)))
@@ -148,6 +151,72 @@ def test_wal_codec_roundtrip_fuzz():
         crec = decode_record(encode_record(
             {"t": "c", "s": 5, "ts": rng.randint(1, 2**40), "k": keys}))
         assert crec["k"] == keys
+
+
+def test_similar_to_execution_fuzz():
+    """Random similar_to forms against a live vector index (ISSUE 8):
+    root and @filter member, string/list/variable vectors, both arg
+    orders, malformed literals, wrong dims, k edge cases, and composition
+    with the existing directive surface — every case must answer or raise
+    a TYPED error, never an internal crash."""
+    import random
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.query.dql import ParseError
+    from dgraph_tpu.query.engine import QueryError
+    from dgraph_tpu.query.task import TaskError
+
+    n = Node()
+    n.alter(schema_text="""
+        emb: float32vector @index(vector(dim: 4, metric: l2)) .
+        name: string @index(exact) .
+        friend: [uid] @reverse .
+    """)
+    rng = random.Random(8)
+    quads = []
+    for i in range(1, 25):
+        vec = ", ".join(f"{rng.uniform(-2, 2):.3f}" for _ in range(4))
+        quads += [f'<0x{i:x}> <emb> "[{vec}]"^^<xs:float32vector> .',
+                  f'<0x{i:x}> <name> "p{i}" .',
+                  f'<0x{i:x}> <friend> <0x{i % 24 + 1:x}> .']
+    n.mutate(set_nquads="\n".join(quads), commit_now=True)
+
+    # weighted draws: mostly well-formed (the floor below proves the valid
+    # surface actually runs), with a malicious tail for the crash hunt
+    good_vecs = ['"[1, 0, -1, 0.5]"', '"[0.1,0.2,0.3,0.4]"',
+                 '[1.0, 0, 2, 3]', '$v', '"[1e9, -1e9, 0, 0]"']
+    bad_vecs = ['"[1, 2]"', '"[]"', '"[1, nan, 2, 3]"', '"x"', '""']
+    good_ks = ['3', '1', '25']
+    bad_ks = ['0', '-2', '"3"', 'k']
+    attrs = ['emb'] * 3 + ['name', 'friend', 'missing']
+    tails = ['{ uid }', '{ uid d : val(vector_distance) }',
+             '{ name friend { name } }',
+             '{ uid friend { name } }']
+    posts = ['', ', first: 2', ', orderasc: val(vector_distance)',
+             ', orderdesc: name']
+    filts = ['', '@filter(has(name))',
+             '@filter(similar_to(emb, "[0, 1, 0, 1]", 4))']
+    ran = 0
+    for _ in range(200):
+        a = rng.choice(attrs)
+        v = rng.choice(good_vecs if rng.random() < 0.7 else bad_vecs)
+        k = rng.choice(good_ks if rng.random() < 0.7 else bad_ks)
+        args = f'{a}, {v}, {k}' if rng.random() < 0.5 else f'{a}, {k}, {v}'
+        if rng.random() < 0.75:
+            q = (f'{{ q(func: similar_to({args}){rng.choice(posts)}) '
+                 f'{rng.choice(filts)} {rng.choice(tails)} }}')
+        else:
+            q = (f'{{ q(func: has(name)) '
+                 f'@filter(similar_to({args})) {rng.choice(tails)} }}')
+        vars_ = {"$v": "[0.5, 0.5, 0.5, 0.5]"} if "$v" in q else None
+        try:
+            out, _ = n.query(q, variables=vars_)
+            assert isinstance(out, dict)
+            ran += 1
+        except (ParseError, TaskError, QueryError):
+            pass     # typed rejection is fine; internal crashes are not
+    assert ran > 40, ran
+    n.close()
 
 
 def test_engine_execution_fuzz():
