@@ -29,6 +29,9 @@ def cmd_serve(args) -> int:
                 task_cache_mb=args.task_cache_mb,
                 result_cache_mb=args.result_cache_mb,
                 dispatch_width=args.dispatch_width,
+                batching=not args.no_batch,
+                batch_window_ms=args.batch_window_ms,
+                batch_max=args.batch_max,
                 overlay=not args.no_overlay,
                 overlay_max_keys=args.overlay_max_keys,
                 overlay_max_age_s=args.overlay_max_age_s,
@@ -175,7 +178,10 @@ def cmd_worker(args) -> int:
                 store.set_schema(e)
     server, port = serve_worker(store, f"{args.host}:{args.port}",
                                 elections=True,
-                                advertise_host=args.advertise_host)
+                                advertise_host=args.advertise_host,
+                                batching=not args.no_batch,
+                                batch_window_ms=args.batch_window_ms,
+                                batch_max=args.batch_max)
     if args.zero:
         import threading
 
@@ -347,6 +353,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="task-result cache budget in MB (0 disables)")
     sp.add_argument("--result_cache_mb", type=int, default=32,
                     help="query-result cache budget in MB (0 disables)")
+    sp.add_argument("--batch_window_ms", type=float, default=2.0,
+                    help="batched-dispatch collect window in ms; a batch "
+                         "fires immediately when the device is idle")
+    sp.add_argument("--batch_max", type=int, default=16,
+                    help="max tasks packed into one batched device kernel")
+    sp.add_argument("--no_batch", action="store_true",
+                    help="disable batched multi-query device execution "
+                         "(exact per-task dispatch)")
     sp.add_argument("--dispatch_width", type=int, default=4,
                     help="max simultaneous device dispatches")
     sp.add_argument("--no_overlay", action="store_true",
@@ -464,6 +478,14 @@ def build_parser() -> argparse.ArgumentParser:
     wp.add_argument("--membership_interval", type=float, default=30,
                     help="seconds between membership re-registrations with "
                          "zero (0 = register once)")
+    wp.add_argument("--batch_window_ms", type=float, default=2.0,
+                    help="batched-dispatch collect window in ms; a batch "
+                         "fires immediately when the device is idle")
+    wp.add_argument("--batch_max", type=int, default=16,
+                    help="max tasks packed into one batched device kernel")
+    wp.add_argument("--no_batch", action="store_true",
+                    help="disable batched multi-query device execution "
+                         "(exact per-task dispatch)")
     wp.set_defaults(fn=cmd_worker)
 
     zp = sub.add_parser("zero", help="run the cluster coordinator process")

@@ -98,6 +98,9 @@ async function show(r, t0){
     const depth = Object.values(ov.depth||{}).reduce((a,b)=>a+b,0);
     if (ov.stamps) lat += ' · Δ' + depth + ' (' + ov.stamps + ' stamps, ' +
         (ov.compactions||0) + ' rollups)';
+    const ba = m.batching || {};
+    if (ba.formed) lat += ' · batch ' +
+        (ba.occupancy.mean||0).toFixed(1) + 'x/' + ba.formed;
   }catch(e){}
   document.getElementById('lat').textContent = lat;
   try{document.getElementById('out').textContent =
@@ -169,6 +172,22 @@ def _serving_metrics(node: Node) -> dict:
             "width": node.dispatch_gate.width,
             "in_flight": c("dgraph_dispatch_inflight"),
             "waits": c("dgraph_dispatch_waits_total"),
+        },
+        # batched multi-query device execution (ISSUE 9): formed batches,
+        # occupancy distribution, window waits, deadline bypasses, and the
+        # per-reason solo-fallback breakdown (query/batch.py)
+        "batching": {
+            "enabled": node.batcher is not None,
+            "window_ms": (node.batcher.window_s * 1000.0
+                          if node.batcher is not None else 0.0),
+            "max_batch": (node.batcher.max_batch
+                          if node.batcher is not None else 0),
+            "formed": c("dgraph_batch_formed_total"),
+            "batched_tasks": c("dgraph_batch_tasks_total"),
+            "occupancy": m.histogram("dgraph_batch_occupancy").snapshot(),
+            "window_waits": c("dgraph_batch_window_waits_total"),
+            "deadline_bypass": c("dgraph_batch_deadline_bypass_total"),
+            "incompatible": m.keyed("dgraph_batch_incompatible").snapshot(),
         },
         # delta-overlay maintenance tier: O(Δ) commit-to-visible stamping,
         # background compaction, parallel cold folds, and the task/result

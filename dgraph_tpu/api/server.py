@@ -95,7 +95,10 @@ class Node:
                  default_timeout_ms: float = 0.0,
                  vector_nprobe: int = 0,
                  vector_centroids: int = -1,
-                 vector_ivf_min_rows: int = 0) -> None:
+                 vector_ivf_min_rows: int = 0,
+                 batching: bool = True,
+                 batch_window_ms: float = 2.0,
+                 batch_max: int = 16) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -128,6 +131,19 @@ class Node:
                              if result_cache_mb > 0 else None)
         self.dispatch_gate = qcache.DispatchGate(dispatch_width,
                                                  self.metrics)
+        # device-dispatch batcher (ISSUE 9, query/batch.py): concurrent
+        # compatible device-class tasks — same predicate CSR object (which
+        # pins the snapshot), same kernel class — pack into ONE batched
+        # kernel launch, amortizing the fixed dispatch+sync that otherwise
+        # serializes through the gate. --no_batch / batching=False
+        # restores exact per-task dispatch.
+        self.batcher = None
+        if batching and batch_max > 1:
+            from dgraph_tpu.query.batch import DeviceBatcher
+
+            self.batcher = DeviceBatcher(self.dispatch_gate, self.metrics,
+                                         window_ms=batch_window_ms,
+                                         max_batch=batch_max)
         # cost-based planner (query/planner.py) over the live cardinality
         # stats (storage/stats.py). Order decisions only — disabling it
         # (--no_planner) restores exact parse-order execution.
@@ -578,7 +594,8 @@ class Node:
                            cache=self.task_cache, gate=self.dispatch_gate,
                            edge_limit=edge_limit, plan=plan,
                            explain=recorder,
-                           mesh=self.mesh_exec).execute(req)
+                           mesh=self.mesh_exec,
+                           batcher=self.batcher).execute(req)
             tr.printf("executed")
             if rkey is not None:
                 self.result_cache.put(rkey, out)
@@ -631,7 +648,8 @@ class Node:
                     ex = Executor(snap, self.store.schema,
                                   cache=self.task_cache,
                                   gate=self.dispatch_gate,
-                                  mesh=self.mesh_exec)
+                                  mesh=self.mesh_exec,
+                                  batcher=self.batcher)
                     out = ex.execute(self._parse(q, variables))
                     vars_map = ex.vars
                 uid_map: dict = {}

@@ -766,25 +766,12 @@ def shortest_bfs(g: PullGraph, src: int, dst: int, max_hops: int):
     return path[::-1]
 
 
-@partial(jax.jit, static_argnames=("depth", "chunks", "chunks_d",
-                                   "allow_loop"))
-def recurse_fused(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
-                  in_subjects, seeds_mask, *, depth: int, chunks: int,
-                  chunks_d: int, allow_loop: bool):
-    """All `depth` levels in ONE dispatch (lax.scan): no host round-trip —
-    and no relay sync — between levels. Single-predicate shape, so levels
-    >= 2 stay entirely in DST-RANK space (a recurse frontier is the
-    previous level's fresh destinations): no full-uid scatter, no src-rank
-    remap gather, and the bitmap pack runs over the compressed rank space
-    (the same dual-space trick as the BFS kernel's mask_hop).
-
-    Returns stacked per-level (dest_words [D,Cd*8,128] BIT-PACKED
-    DST-RANK masks — the host fetches these every query and the relay
-    moves ~6-8 MB/s, so packed-and-rank-compressed is the cheapest wire
-    form; traversed [D]; fresh [D,E_pad] bools that STAY on device until
-    a lazy uidMatrix materialization packs+fetches them). Only for the
-    single-uid-child no-filter recurse shape (the common + benchmarked
-    one); anything needing host logic between levels uses recurse_step."""
+def _recurse_fused_levels(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
+                          in_subjects, seeds_mask, *, depth: int, chunks: int,
+                          chunks_d: int, allow_loop: bool):
+    """Traced body shared by recurse_fused (one seed mask) and
+    recurse_fused_multi (a stacked batch of seed masks): all `depth`
+    levels as one lax.scan over the SAME per-level kernel."""
     nd = in_subjects.shape[0]
 
     def body(carry, i):
@@ -807,3 +794,51 @@ def recurse_fused(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
     (_m, _s), (masks_p, trav, fresh) = lax.scan(
         body, (fresh0, seen0), jnp.arange(depth), length=depth)
     return masks_p, trav, fresh
+
+
+@partial(jax.jit, static_argnames=("depth", "chunks", "chunks_d",
+                                   "allow_loop"))
+def recurse_fused(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
+                  in_subjects, seeds_mask, *, depth: int, chunks: int,
+                  chunks_d: int, allow_loop: bool):
+    """All `depth` levels in ONE dispatch (lax.scan): no host round-trip —
+    and no relay sync — between levels. Single-predicate shape, so levels
+    >= 2 stay entirely in DST-RANK space (a recurse frontier is the
+    previous level's fresh destinations): no full-uid scatter, no src-rank
+    remap gather, and the bitmap pack runs over the compressed rank space
+    (the same dual-space trick as the BFS kernel's mask_hop).
+
+    Returns stacked per-level (dest_words [D,Cd*8,128] BIT-PACKED
+    DST-RANK masks — the host fetches these every query and the relay
+    moves ~6-8 MB/s, so packed-and-rank-compressed is the cheapest wire
+    form; traversed [D]; fresh [D,E_pad] bools that STAY on device until
+    a lazy uidMatrix materialization packs+fetches them). Only for the
+    single-uid-child no-filter recurse shape (the common + benchmarked
+    one); anything needing host logic between levels uses recurse_step."""
+    return _recurse_fused_levels(
+        in_src_pad, in_src_pad_d, in_iptr_rank, subjects, in_subjects,
+        seeds_mask, depth=depth, chunks=chunks, chunks_d=chunks_d,
+        allow_loop=allow_loop)
+
+
+@partial(jax.jit, static_argnames=("depth", "chunks", "chunks_d",
+                                   "allow_loop"))
+def recurse_fused_multi(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
+                        in_subjects, seeds_masks, *, depth: int, chunks: int,
+                        chunks_d: int, allow_loop: bool):
+    """Multi-source batched recurse: seeds_masks [B, num_nodes] stacks B
+    concurrent queries' seed masks and the whole batch runs as ONE device
+    dispatch — the one-extra-dimension extension of recurse_fused the
+    batched-dispatch tier launches (query/batch.py). lax.map over the
+    exact recurse_fused body, so slice b of the stacked outputs is
+    bit-identical to a solo recurse_fused call with seeds_masks[b] (the
+    per-level ops are integer/boolean — no float reassociation). Each
+    query keeps its own seen-edge vector: batching never entangles
+    traversals. Returns (masks_p [B, depth, ...], traversed [B, depth],
+    fresh [B, depth, E_pad])."""
+    return lax.map(
+        lambda sm: _recurse_fused_levels(
+            in_src_pad, in_src_pad_d, in_iptr_rank, subjects, in_subjects,
+            sm, depth=depth, chunks=chunks, chunks_d=chunks_d,
+            allow_loop=allow_loop),
+        seeds_masks)

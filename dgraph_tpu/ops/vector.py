@@ -127,6 +127,22 @@ def topk_candidates(matrix, norms, qv, nrows, dead_rows, *,
     return _topk_body(matrix, norms, valid, qv, k, metric, block)
 
 
+@partial(jax.jit, static_argnames=("k", "metric", "block"))
+def topk_candidates_batch(matrix, norms, Q, nrows, dead_rows, *,
+                          k: int, metric: str, block: int):
+    """Stacked-query candidate stage: Q [B, D] query matrix -> per-query
+    (neg_dist f32[B, k], rows i32[B, k]). The same tiled scan as
+    topk_candidates, vmapped over the query dimension — B concurrent
+    queries pay the fixed dispatch+sync ONCE (the batched-dispatch tier,
+    query/batch.py), and the blockwise matmul runs [block, D] @ [D, B]
+    instead of B matvecs. Per-query candidates obey the same contract as
+    the solo kernel: a float32 superset the host re-ranks in float64, so
+    batched results are byte-identical to solo execution."""
+    valid = _valid_mask(matrix.shape[0], nrows, dead_rows)
+    return jax.vmap(
+        lambda qv: _topk_body(matrix, norms, valid, qv, k, metric, block))(Q)
+
+
 @partial(jax.jit, static_argnames=("k", "metric"))
 def ivf_topk(matrix, norms, qv, cand_rows, *, k: int, metric: str):
     """IVF fine stage: score ONLY the gathered candidate rows (cand_rows
@@ -181,5 +197,5 @@ def ann_expand(matrix, norms, qv, nrows, dead_rows, vec_subjects,
 
 
 __all__ = ["METRICS", "BLOCK_ROWS", "ExpandResult", "row_capacity",
-           "k_capacity", "host_distances", "topk_candidates", "ivf_topk",
-           "ann_expand"]
+           "k_capacity", "host_distances", "topk_candidates",
+           "topk_candidates_batch", "ivf_topk", "ann_expand"]

@@ -182,7 +182,8 @@ class WorkerService:
 
     SHIP_BUFFER = 4096       # catch-up window (records) for lagging peers
 
-    def __init__(self, store) -> None:
+    def __init__(self, store, batching: bool = True,
+                 batch_window_ms: float = 2.0, batch_max: int = 16) -> None:
         import collections
         import os
         import threading
@@ -207,6 +208,21 @@ class WorkerService:
         # per predicate — the assembler replaces (never mutates) a
         # PredData on any visible commit/overlay-stamp/replay/drop.
         self.task_cache = TaskResultCache(32 << 20, self.metrics)
+        # device-dispatch batcher (ISSUE 9): the wire path is where the
+        # fixed per-dispatch relay sync dominates (PERF.md configs 4-5),
+        # so concurrent fanned-in ServeTask calls that classify as the
+        # same device-class kernel pack into ONE launch exactly like the
+        # embedded node's. No DispatchGate on the worker: the batcher runs
+        # the kernel directly and idle-fires off its own in-flight count.
+        # Same knob surface as the embedded Node (worker CLI
+        # --no_batch/--batch_window_ms/--batch_max).
+        self.batcher = None
+        if batching and batch_max > 1:
+            from ..query.batch import DeviceBatcher
+
+            self.batcher = DeviceBatcher(gate=None, metrics=self.metrics,
+                                         window_ms=batch_window_ms,
+                                         max_batch=batch_max)
         # replica-read gate concurrency cap (see serve_task convoy guard)
         self._gate_slots = threading.BoundedSemaphore(2)
         self._move_keys_cache = None
@@ -340,9 +356,12 @@ class WorkerService:
         from ..query.qcache import task_token
 
         snap = self._snapshot(read_ts)
-        res = self.task_cache.dispatch(
-            task_token(snap, q), q,
-            lambda tq: process_task(snap, tq, self.store.schema))
+        solo = lambda tq, klass=None: process_task(     # noqa: E731
+            snap, tq, self.store.schema)
+        run = solo if self.batcher is None else (
+            lambda tq: self.batcher.dispatch(
+                snap, self.store.schema, tq, solo))
+        res = self.task_cache.dispatch(task_token(snap, q), q, run)
         return encode_result(res)
 
     def membership(self, _msg: ipb.MembershipRequest,
@@ -977,14 +996,18 @@ class WorkerService:
 
 def serve_worker(store, addr: str = "localhost:0",
                  max_workers: int = 8, advertise_host: str | None = None,
-                 elections: bool = False):
+                 elections: bool = False, batching: bool = True,
+                 batch_window_ms: float = 2.0, batch_max: int = 16):
     """Start a Worker gRPC server for one group's store; returns
     (server, bound_port). advertise_host overrides the callback host
     followers use for FetchState — required when binding a wildcard
     (0.0.0.0), which is unroutable from a peer. elections=True starts the
     wire-ballot failure detector (self-healing leader election without the
-    control plane)."""
-    svc = WorkerService(store)
+    control plane). batching/batch_window_ms/batch_max mirror the embedded
+    Node's batched-dispatch knobs for the worker's own device path."""
+    svc = WorkerService(store, batching=batching,
+                        batch_window_ms=batch_window_ms,
+                        batch_max=batch_max)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((svc.handler(),))

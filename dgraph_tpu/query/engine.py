@@ -91,7 +91,7 @@ class Executor:
                  dispatch=None, cache=None, gate=None,
                  edge_limit: int | None = None,
                  plan=None, explain: dict | None = None,
-                 mesh=None):
+                 mesh=None, batcher=None):
         self.snap = snap
         self.schema = schema
         # mesh deployment mode (parallel/mesh_exec.MeshExecutor): pure
@@ -121,8 +121,29 @@ class Executor:
         raw = dispatch or (
             lambda q: process_task(self.snap, q, self.schema))
         if gate is not None:
+            from dgraph_tpu.query.batch import kernel_klass
+
             inner = raw
-            raw = lambda q: gate.run(lambda: inner(q))
+            # klass hint: the batcher refines the coarse kernel_klass with
+            # its classification (host-path fallbacks feed the gate's
+            # "host" EWMA class, not the device-class estimates)
+            raw = lambda q, klass=None: gate.run(
+                lambda: inner(q),
+                klass=klass if klass is not None else kernel_klass(q))
+        # device-dispatch batcher (ISSUE 9, query/batch.py): between the
+        # singleflight tier (which dedupes IDENTICAL tasks — only flight
+        # leaders reach this seam) and the gate, DISTINCT compatible
+        # device-class tasks from concurrent queries pack into ONE batched
+        # kernel. Local snapshots only: the wire dispatcher's tasks batch
+        # on the OWNING worker (parallel/remote.py serve_task), where the
+        # device actually runs.
+        self.batcher = batcher if dispatch is None else None
+        if self.batcher is not None:
+            ungated = raw
+            solo = raw if gate is not None else (
+                lambda q, klass=None: ungated(q))
+            raw = lambda q: self.batcher.dispatch(self.snap, self.schema,
+                                                  q, solo)
         if cache is not None:
             from dgraph_tpu.query.qcache import task_token
 
@@ -170,10 +191,26 @@ class Executor:
         return self.edge_limit if self.edge_limit is not None \
             else MAX_QUERY_EDGES
 
-    def gated(self, fn):
+    def gated(self, fn, klass: str | None = None):
         """Run a device-step closure through the dispatch gate when one is
-        installed (recurse/shortest kernel steps that bypass _dispatch)."""
-        return self.gate.run(fn) if self.gate is not None else fn()
+        installed (recurse/shortest kernel steps that bypass _dispatch).
+        klass feeds the gate's per-kernel-class EWMA so shed decisions use
+        the right step estimate (a recurse scan and a host-cutover expand
+        differ by ~100x)."""
+        return self.gate.run(fn, klass=klass) if self.gate is not None \
+            else fn()
+
+    def batched_recurse(self, g, seeds_mask, depth: int, allow_loop: bool,
+                        solo):
+        """Fused-recurse seam of the dispatch batcher: compatible
+        concurrent traversals (same PullGraph object — which pins tablet
+        and snapshot — same depth and loop rule) stack their seed masks
+        into ONE multi-source dispatch (ops/pallas_bfs.recurse_fused_multi)
+        instead of serializing through the gate one fused scan each."""
+        if self.batcher is not None:
+            return self.batcher.dispatch_recurse(g, seeds_mask, depth,
+                                                 allow_loop, solo)
+        return self.gated(solo, klass="recurse")
 
     # ------------------------------------------------------------------ API
 
@@ -513,7 +550,8 @@ class Executor:
             nd, uids, res = self.gated(lambda: vops.ann_expand(
                 mat, norms, jnp.asarray(vec), jnp.int32(vi.n), dr,
                 subs_dev, csr.subjects, csr.indptr, csr.indices,
-                k=kprime, metric=vi.metric, block=block, ecap=ecap))
+                k=kprime, metric=vi.metric, block=block, ecap=ecap),
+                klass="vector")
             nd_h = np.asarray(nd)
             uids_h = np.asarray(uids).astype(np.int64)
             counts_h = np.asarray(res.counts)[:kprime]
@@ -643,7 +681,8 @@ class Executor:
         csrs = [self._mesh_chain_csr(c) for c in chain]
         try:
             levels = self.gated(
-                lambda: self.mesh.run_chain(csrs, frontier))
+                lambda: self.mesh.run_chain(csrs, frontier),
+                klass="mesh")
         except MeshCapacityError:
             self.mesh.metrics.counter(
                 "dgraph_mesh_fallbacks_total").inc()

@@ -383,12 +383,32 @@ class DispatchGate:
         self._wlock = threading.Lock()     # guards the _waiting count
         self._waiting = 0                  # queued acquirers
         self._step_ewma = 0.0              # expected device-step seconds
+        # per-kernel-class EWMAs (ISSUE 9): one global estimate spans ~1ms
+        # host-cutover expands and ~100ms mesh/vector steps, making shed
+        # decisions wrong for both tails — callers that know their kernel
+        # class (the same classification query/batch.py uses) pass it to
+        # run() and shed checks consult the class estimate first
+        self._class_ewma: dict[str, float] = {}
 
     @property
     def expected_step_s(self) -> float:
         return self._step_ewma
 
-    def _acquire(self) -> None:
+    def expected_step(self, klass: str | None = None) -> float:
+        """Expected device-step seconds for one kernel class; the global
+        EWMA is the fallback until the class has its own samples."""
+        if klass is not None:
+            v = self._class_ewma.get(klass)
+            if v:
+                return v
+        return self._step_ewma
+
+    def busy(self) -> bool:
+        """True when any dispatch is running or queued — the batcher's
+        fire-immediately-when-idle check."""
+        return self._inflight.value > 0 or self._waiting > 0
+
+    def _acquire(self, klass: str | None = None) -> None:
         """Budget-aware semaphore acquisition. Raises typed errors instead
         of waiting past the caller's deadline."""
         if self._sem.acquire(blocking=False):
@@ -405,14 +425,15 @@ class DispatchGate:
         # entry points — counting here too would double-book overruns.)
         if rem <= 0:
             raise DeadlineExceeded("dispatch gate: budget exhausted")
-        if self._step_ewma and rem < self._step_ewma:
+        est = self.expected_step(klass)
+        if est and rem < est:
             self._shed.inc()
-            otrace.event("shed", where="dispatch_gate",
+            otrace.event("shed", where="dispatch_gate", klass=klass or "",
                          remaining_ms=round(rem * 1000, 1),
-                         expected_step_ms=round(self._step_ewma * 1000, 1))
+                         expected_step_ms=round(est * 1000, 1))
             raise ResourceExhausted(
                 f"shed: remaining budget {rem * 1000:.0f}ms < expected "
-                f"device step {self._step_ewma * 1000:.0f}ms")
+                f"{klass or 'device'} step {est * 1000:.0f}ms")
         with self._wlock:
             if self._waiting >= self.max_queue:
                 queued = self._waiting
@@ -434,18 +455,27 @@ class DispatchGate:
             raise DeadlineExceeded(
                 f"dispatch gate: no slot within {rem * 1000:.0f}ms budget")
 
-    def run(self, fn):
+    def run(self, fn, klass: str | None = None):
         faults.fire("device.dispatch", m=self.metrics)
-        self._acquire()
+        self._acquire(klass)
         self._inflight.inc()
         t0 = time.perf_counter()
         try:
+            # device.step fires while HOLDING the slot: a slow device
+            # program (or the distributed configs' fixed relay sync),
+            # serialized by the gate exactly like real device occupancy —
+            # device.dispatch above models pre-gate submission latency
+            faults.fire("device.step", m=self.metrics)
             return fn()
         finally:
             dt = time.perf_counter() - t0
             self._step_ewma = dt if not self._step_ewma else (
                 (1 - self._EWMA_ALPHA) * self._step_ewma
                 + self._EWMA_ALPHA * dt)
+            if klass is not None:
+                cur = self._class_ewma.get(klass, 0.0)
+                self._class_ewma[klass] = dt if not cur else (
+                    (1 - self._EWMA_ALPHA) * cur + self._EWMA_ALPHA * dt)
             self._inflight.dec()
             self._sem.release()
 

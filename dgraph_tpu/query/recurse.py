@@ -285,7 +285,8 @@ def recurse(ex, sg: SubGraph) -> None:
                     lambda: pb.recurse_step(
                         g.in_src_pad, g.in_iptr_rank, g.subjects,
                         g.in_subjects, fmask, st["seen"], chunks=g.chunks,
-                        num_nodes=g.num_nodes, allow_loop=spec.allow_loop))
+                        num_nodes=g.num_nodes, allow_loop=spec.allow_loop),
+                    klass="recurse")
                 st["seen"] = seen2
                 dest_words_h, trav_h = jax.device_get((dest_words, trav))
                 edges += int(trav_h)
@@ -350,7 +351,7 @@ def _mesh_recurse_path(ex, sg: SubGraph, cgq, csr, depth: int,
     by tests/test_mesh_exec.py)."""
     seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
     levels = ex.gated(lambda: mesh.run_recurse(csr, seeds, depth,
-                                               allow_loop))
+                                               allow_loop), klass="mesh")
     attach = sg.children = []
     cum = 0
     for frontier, matrix, counts, dest, traversed in levels:
@@ -376,11 +377,17 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
 
     g = pb.pull_graph_for(csr)
     seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
-    masks_p, trav, fresh = ex.gated(lambda: pb.recurse_fused(
-        g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
-        g.in_subjects, _seeds_mask(seeds, g.num_nodes),
-        depth=depth, chunks=g.chunks, chunks_d=g.chunks_d,
-        allow_loop=allow_loop))
+    seeds_mask = _seeds_mask(seeds, g.num_nodes)
+    # batched-dispatch seam (query/batch.py): compatible concurrent
+    # traversals stack their seed masks into one multi-source dispatch;
+    # without a batcher this is exactly the old gated solo call
+    masks_p, trav, fresh = ex.batched_recurse(
+        g, seeds_mask, depth, allow_loop,
+        lambda: pb.recurse_fused(
+            g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
+            g.in_subjects, seeds_mask,
+            depth=depth, chunks=g.chunks, chunks_d=g.chunks_d,
+            allow_loop=allow_loop))
     # ONE relay round-trip for the whole traversal, bit-packed in DST-RANK
     # space (fresh flags stay on device until a lazy uidMatrix
     # materialization needs them); host maps ranks -> uids

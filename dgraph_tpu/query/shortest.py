@@ -227,7 +227,7 @@ def _mesh_adjacency(ex, sg: SubGraph, attr: str, csr, src: int):
         frontier = trav.frontier
         if len(frontier) == 0:
             break
-        matrix, _next, traversed = ex.gated(trav.step)
+        matrix, _next, traversed = ex.gated(trav.step, klass="shortest")
         edges += traversed
         if edges > ex.edge_budget():
             raise QueryError("shortest path exceeded edge budget (ErrTooBig)")

@@ -237,11 +237,18 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
             np.cumsum(counts, out=offs[1:])
             matrix = [targets[offs[i]: offs[i + 1]]
                       for i in range(len(uids))]
+    return apply_first(matrix, first), total
+
+
+def apply_first(matrix: list[np.ndarray], first: int) -> list[np.ndarray]:
+    """Per-uid result truncation (intern.Query.first) — shared by the solo
+    expand path and the batched demux (query/batch.py), so both truncate
+    identically."""
     if first > 0:
-        matrix = [m[:first] for m in matrix]
-    elif first < 0:
-        matrix = [m[first:] for m in matrix]
-    return matrix, total
+        return [m[:first] for m in matrix]
+    if first < 0:
+        return [m[first:] for m in matrix]
+    return matrix
 
 
 def _merge_matrix(matrix: list[np.ndarray]) -> np.ndarray:
@@ -405,34 +412,7 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
         matrix, traversed = _expand_csr(csr, frontier, q.first, q.cutover) \
             if csr is not None else (
             [np.zeros(0, np.int64) for _ in frontier], 0)
-        res.uid_matrix = matrix
-        res.counts = [len(m) for m in matrix]
-        res.traversed_edges = traversed
-        if q.facet_keys:
-            res.facet_matrix = [
-                [pd.facets.get((int(s), int(o)), ()) for o in m]
-                for s, m in zip(frontier, matrix)]
-        # filter-function applied over the frontier itself (uid_in / has)
-        if fname == "uid_in":
-            # uid_in(pred, u1, u2, ...) keeps subjects with ANY listed
-            # object (decimal and 0x-hex uid forms accepted)
-            want = {int(str(a), 0) for a in args}
-            keep = np.asarray([bool(want.intersection(m)) for m in matrix],
-                              dtype=bool)
-            res.dest_uids = frontier[keep]
-        elif fname == "has":
-            # has(attr) over a frontier: subjects with >= 1 edge (or a value,
-            # for mixed untyped predicates)
-            keep = np.asarray([len(m) > 0 for m in matrix], dtype=bool)
-            if pd.value_subjects_host is not None:
-                vsub = pd.value_subjects_host
-                posv = np.clip(np.searchsorted(vsub, frontier), 0,
-                               max(len(vsub) - 1, 0))
-                keep |= (len(vsub) > 0) & (vsub[posv] == frontier)
-            res.dest_uids = frontier[keep]
-        else:
-            res.dest_uids = _merge_matrix(matrix)
-        return res
+        return finish_uid_expand(pd, q, frontier, matrix, traversed)
 
     # ---- frontier + value predicate: fetch values / compare filter --------
     # vectorized presence over the device-aligned value table: one
@@ -550,6 +530,47 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
     else:
         res.dest_uids = frontier[
             np.asarray([len(v) > 0 for v in res.value_matrix], dtype=bool)]
+    return res
+
+
+def finish_uid_expand(pd: PredData, q: TaskQuery, frontier: np.ndarray,
+                      matrix: list[np.ndarray], traversed: int) -> TaskResult:
+    """Host tail of a uid-predicate frontier task — everything after the
+    adjacency gather (facets, uid_in/has filter functions, dest merge).
+    Shared by process_task's solo path and the batched-dispatch demux
+    (query/batch.py), so a batched task's result is byte-identical to solo
+    execution by construction. q must already be reverse-resolved (attr
+    stripped of "~", q.reverse set) exactly as process_task rewrites it."""
+    res = TaskResult()
+    fname = q.func[0].lower() if q.func else None
+    args = q.func[1] if q.func else []
+    res.uid_matrix = matrix
+    res.counts = [len(m) for m in matrix]
+    res.traversed_edges = traversed
+    if q.facet_keys:
+        res.facet_matrix = [
+            [pd.facets.get((int(s), int(o)), ()) for o in m]
+            for s, m in zip(frontier, matrix)]
+    # filter-function applied over the frontier itself (uid_in / has)
+    if fname == "uid_in":
+        # uid_in(pred, u1, u2, ...) keeps subjects with ANY listed
+        # object (decimal and 0x-hex uid forms accepted)
+        want = {int(str(a), 0) for a in args}
+        keep = np.asarray([bool(want.intersection(m)) for m in matrix],
+                          dtype=bool)
+        res.dest_uids = frontier[keep]
+    elif fname == "has":
+        # has(attr) over a frontier: subjects with >= 1 edge (or a value,
+        # for mixed untyped predicates)
+        keep = np.asarray([len(m) > 0 for m in matrix], dtype=bool)
+        if pd.value_subjects_host is not None:
+            vsub = pd.value_subjects_host
+            posv = np.clip(np.searchsorted(vsub, frontier), 0,
+                           max(len(vsub) - 1, 0))
+            keep |= (len(vsub) > 0) & (vsub[posv] == frontier)
+        res.dest_uids = frontier[keep]
+    else:
+        res.dest_uids = _merge_matrix(matrix)
     return res
 
 
@@ -671,8 +692,15 @@ def _similar_root(snap: GraphSnapshot, pd: PredData, schema,
         return
     uids, dists = vecmod.search(vi, vec, k,
                                 metrics=getattr(snap, "metrics", None))
-    # dest_uids is a SORTED uid set (engine set algebra); distances ride
-    # value_matrix in the same order
+    set_similar_result(res, uids, dists)
+
+
+def set_similar_result(res: TaskResult, uids: np.ndarray,
+                       dists: np.ndarray) -> None:
+    """Shape ranked (uid, distance) pairs into a TaskResult — shared by
+    the solo similar_to root and the batched vector demux (query/batch.py).
+    dest_uids is a SORTED uid set (engine set algebra); distances ride
+    value_matrix in the same order."""
     order = np.argsort(uids, kind="stable")
     res.dest_uids = uids[order]
     res.value_matrix = [[Val(TypeID.FLOAT, float(d))]

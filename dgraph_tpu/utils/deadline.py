@@ -160,6 +160,32 @@ def scope(budget: "Deadline | float | int | None"):
                   else Deadline(float(budget)))
 
 
+class _AdoptScope:
+    __slots__ = ("dl", "_tok")
+
+    def __init__(self, dl: Deadline | None) -> None:
+        self.dl = dl
+
+    def __enter__(self) -> Deadline | None:
+        self._tok = _current.set(self.dl)
+        return self.dl
+
+    def __exit__(self, *_exc):
+        _current.reset(self._tok)
+        return False
+
+
+def adopt(dl: "Deadline | None"):
+    """Install EXACTLY `dl` for the dynamic extent — None clears the
+    ambient deadline; unlike scope(), an enclosing tighter deadline does
+    NOT win. For an agent executing pooled work on behalf of SEVERAL
+    callers (the batched-dispatch leader, query/batch.py): the pool's
+    budget is the most permissive member's, not whichever member happened
+    to lead, so one tight-budget leader cannot shed work that other
+    members had ample time for."""
+    return _AdoptScope(dl)
+
+
 # -- wire propagation (gRPC invocation metadata) ----------------------------
 
 def to_metadata() -> tuple | None:

@@ -1,0 +1,468 @@
+"""Batched multi-query device execution (ISSUE 9, query/batch.py).
+
+Covers: byte-identity of batched vs solo execution across the golden
+corpus under forced batching, the dedup-vs-batch split with the
+singleflight tier, deadline-constrained window bypass, de-multiplex under
+a mid-batch per-task failure, metrics/span surfaces, and the gate's
+per-kernel-class EWMA shed decisions.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.query import recurse as recmod
+from dgraph_tpu.query import task as taskmod
+from dgraph_tpu.query.batch import DeviceBatcher, classify, kernel_klass
+from dgraph_tpu.query.qcache import DispatchGate
+from dgraph_tpu.query.task import TaskQuery
+from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils.deadline import DeadlineExceeded, ResourceExhausted
+
+
+@pytest.fixture
+def device_expand(monkeypatch):
+    """Tiny test graphs never cross the real 64k host/device cutover —
+    force every expand into the device class so it classifies batchable."""
+    monkeypatch.setattr(taskmod, "HOST_EXPAND_MAX", 0)
+
+
+def _graph_node(**kw) -> Node:
+    kw.setdefault("planner", False)     # keep the static cutover in charge
+    kw.setdefault("task_cache_mb", 0)
+    kw.setdefault("result_cache_mb", 0)
+    node = Node(**kw)
+    node.alter(schema_text="name: string @index(exact) .\n"
+                           "follows: [uid] .")
+    quads = []
+    for i in range(1, 160):
+        quads.append(f'<0x{i:x}> <name> "p{i}" .')
+        for j in range(1, 6):
+            quads.append(f'<0x{i:x}> <follows> <0x{(i * j) % 159 + 1:x}> .')
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+    return node
+
+
+def _force_batcher(node, max_batch=8, window_ms=1500) -> DeviceBatcher:
+    """Deterministic batching: no idle fire + a window long enough that a
+    barrier-released wave always lands in one batch (the batch fires early
+    the moment it fills to max_batch)."""
+    node.batcher = DeviceBatcher(node.dispatch_gate, node.metrics,
+                                 window_ms=window_ms, max_batch=max_batch,
+                                 idle_fire=False)
+    return node.batcher
+
+
+def _concurrent(node, queries, timeout=60):
+    outs = [None] * len(queries)
+    errs = [None] * len(queries)
+    barrier = threading.Barrier(len(queries))
+
+    def run(i):
+        barrier.wait(timeout=30)
+        try:
+            outs[i] = node.query(queries[i])[0]
+        except BaseException as e:     # noqa: BLE001 — surfaced to assert
+            errs[i] = e
+
+    ts = [threading.Thread(target=run, args=(i,))
+          for i in range(len(queries))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    return outs, errs
+
+
+# ---------------------------------------------------------------------------
+# byte-identity
+# ---------------------------------------------------------------------------
+
+def test_concurrent_distinct_queries_batch_byte_identical(device_expand):
+    node = _graph_node()
+    queries = [f'{{ q(func: uid(0x{3 * i + 1:x}, 0x{3 * i + 2:x}, '
+               f'0x{3 * i + 3:x})) {{ follows {{ uid }} }} }}'
+               for i in range(8)]
+    node.batcher = None
+    solo = [node.query(q)[0] for q in queries]
+    _force_batcher(node, max_batch=8)
+    outs, errs = _concurrent(node, queries)
+    assert not any(errs), errs
+    assert outs == solo
+    m = node.metrics
+    assert m.counter("dgraph_batch_tasks_total").value == 8
+    occ = m.histogram("dgraph_batch_occupancy").snapshot()
+    assert occ["max"] > 1, occ
+    node.close()
+
+
+def test_golden_corpus_byte_identical_under_forced_batching(device_expand):
+    """The full golden battery, replayed in concurrent waves with batching
+    forced (long window, no idle fire): every output must equal the solo
+    run byte for byte — filters, facets, reverse edges, pagination, lang,
+    cascade, recurse, shortest, groupby, vars, geo all demux correctly."""
+    import test_golden as tg
+
+    node = Node(planner=False, task_cache_mb=0, result_cache_mb=0)
+    node.alter(schema_text=tg.SCHEMA)
+    node.mutate(set_nquads=tg._dataset(), commit_now=True)
+    queries = [q for _name, q in tg.QUERIES]
+    node.batcher = None
+    solo = [node.query(q)[0] for q in queries]
+    _force_batcher(node, max_batch=8, window_ms=150)
+    outs = []
+    for lo in range(0, len(queries), 8):          # concurrent waves
+        wave = queries[lo: lo + 8]
+        got, errs = _concurrent(node, wave)
+        assert not any(errs), errs
+        outs.extend(got)
+    assert outs == solo
+    assert node.metrics.counter("dgraph_batch_formed_total").value > 0
+    node.close()
+
+
+def test_recurse_fused_batches_byte_identical(device_expand, monkeypatch):
+    monkeypatch.setattr(recmod, "KERNEL_MIN_EDGES", 0)
+    node = _graph_node()
+    queries = [f'{{ q(func: uid(0x{i + 1:x})) @recurse(depth: 3) '
+               '{ follows } }' for i in range(4)]
+    node.batcher = None
+    solo = [node.query(q)[0] for q in queries]
+    _force_batcher(node, max_batch=4)
+    outs, errs = _concurrent(node, queries)
+    assert not any(errs), errs
+    assert outs == solo
+    occ = node.metrics.histogram("dgraph_batch_occupancy").snapshot()
+    assert occ["max"] == 4, occ     # one multi-source dispatch took all 4
+    node.close()
+
+
+def test_vector_topk_batches_byte_identical(monkeypatch):
+    from dgraph_tpu.storage import vecindex as vecmod
+
+    monkeypatch.setattr(vecmod, "HOST_SCAN_MAX", 1)  # device-class scans
+    node = Node(planner=False, task_cache_mb=0, result_cache_mb=0)
+    node.alter(schema_text="emb: float32vector @index(vector(dim: 8)) .")
+    rng = np.random.default_rng(7)
+    quads = []
+    for i in range(1, 80):
+        v = rng.normal(size=8).round(3).tolist()
+        quads.append(f'<0x{i:x}> <emb> "{v}"^^<xs:float32vector> .')
+    node.mutate(set_nquads="\n".join(quads), commit_now=True)
+    queries = []
+    for _ in range(4):
+        v = rng.normal(size=8).round(3).tolist()
+        queries.append('{ q(func: similar_to(emb, "%s", 5)) { uid } }' % v)
+    node.batcher = None
+    solo = [node.query(q)[0] for q in queries]
+    _force_batcher(node, max_batch=4)
+    outs, errs = _concurrent(node, queries)
+    assert not any(errs), errs
+    assert outs == solo
+    occ = node.metrics.histogram("dgraph_batch_occupancy").snapshot()
+    assert occ["max"] == 4, occ
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# composition with singleflight
+# ---------------------------------------------------------------------------
+
+def test_singleflight_dedupes_identical_batcher_packs_distinct(device_expand):
+    """Two IDENTICAL queries coalesce in the task cache's singleflight
+    (one underlying dispatch); a third DISTINCT one packs with the flight
+    leader into a 2-task batch — dedup and batching compose, they don't
+    compete."""
+    node = _graph_node(task_cache_mb=16)    # singleflight tier ON
+    same = '{ q(func: uid(0x1, 0x2)) { follows { uid } } }'
+    diff = '{ q(func: uid(0x5, 0x6)) { follows { uid } } }'
+    node.batcher = None
+    want_same = node.query(same)[0]
+    want_diff = node.query(diff)[0]
+    node.task_cache.clear()
+    _force_batcher(node, max_batch=2)
+    outs, errs = _concurrent(node, [same, same, diff])
+    assert not any(errs), errs
+    assert outs == [want_same, want_same, want_diff]
+    m = node.metrics
+    assert m.counter("dgraph_task_cache_inflight_waits_total").value >= 1
+    # exactly one batch of the two DISTINCT tasks — the coalesced follower
+    # never reached the batcher
+    assert m.counter("dgraph_batch_tasks_total").value == 2
+    occ = m.histogram("dgraph_batch_occupancy").snapshot()
+    assert occ["max"] == 2, occ
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_constrained_task_bypasses_window(device_expand):
+    """A task whose remaining budget cannot cover window + expected step
+    dispatches solo immediately instead of waiting out the window."""
+    node = _graph_node()
+    batcher = DeviceBatcher(node.dispatch_gate, node.metrics,
+                            window_ms=500, max_batch=8, idle_fire=False)
+    snap = node.snapshot()
+    q = TaskQuery("follows", frontier=np.asarray([1, 2], dtype=np.int64))
+    ran = []
+
+    def solo(tq, klass=None):
+        ran.append(tq)
+        return taskmod.process_task(snap, tq, node.store.schema)
+
+    t0 = time.monotonic()
+    with dl.scope(0.05):
+        res = batcher.dispatch(snap, node.store.schema, q, solo)
+    assert time.monotonic() - t0 < 0.4          # never waited the window
+    assert ran, "bypass must run the solo path"
+    assert len(res.uid_matrix) == 2
+    assert node.metrics.counter(
+        "dgraph_batch_deadline_bypass_total").value == 1
+    node.close()
+
+
+def test_batch_runs_under_most_permissive_member_deadline():
+    """A multi-entry batch acts for SEVERAL callers: the kernel must run
+    under the most permissive member's budget (unbudgeted if any member
+    is), not whichever member happened to lead — a tight-budget leader's
+    context must not shed work the other members had ample time for."""
+    from dgraph_tpu.utils.metrics import Registry
+
+    seen = []
+
+    def runner(entries):
+        seen.append(dl.remaining())
+        for e in entries:
+            e.result = "ok"
+
+    def pair(budget_a, budget_b):
+        b = DeviceBatcher(None, Registry(), window_ms=2000, max_batch=2,
+                          idle_fire=False)
+        outs = {}
+        barrier = threading.Barrier(2)
+
+        def run(name, budget):
+            barrier.wait(timeout=10)
+            with dl.scope(budget):
+                outs[name] = b._submit(("k",), "expand", None, runner,
+                                       solo=lambda: "solo")
+
+        ts = [threading.Thread(target=run, args=("a", budget_a)),
+              threading.Thread(target=run, args=("b", budget_b))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert outs == {"a": "ok", "b": "ok"}
+
+    pair(5.0, None)                 # one unbudgeted member: batch unbudgeted
+    assert seen.pop() is None
+    pair(0.5, 30.0)                 # else: the max remaining across members
+    assert seen.pop() > 10.0
+
+
+def test_host_fallbacks_feed_host_ewma_class_not_expand():
+    """Host-path/value-pred solo fallbacks must record into the gate's
+    "host" EWMA class: sub-ms host gathers polluting the device "expand"
+    estimate is the two-tail misestimation the per-class split fixes."""
+    node = _graph_node()            # default cutover: all host-class
+    _force_batcher(node, max_batch=4, window_ms=10)
+    node.query('{ q(func: uid(0x1, 0x2)) { name follows { uid } } }')
+    g = node.dispatch_gate
+    assert "host" in g._class_ewma, g._class_ewma
+    assert "expand" not in g._class_ewma, g._class_ewma
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# mid-batch per-task failure
+# ---------------------------------------------------------------------------
+
+def test_poisoned_task_fails_typed_rest_of_batch_succeeds(device_expand):
+    """One member's host tail raises (bad uid_in literal); the other
+    members' results are unaffected and identical to solo execution."""
+    node = _graph_node()
+    batcher = DeviceBatcher(node.dispatch_gate, node.metrics,
+                            window_ms=2000, max_batch=2, idle_fire=False)
+    snap = node.snapshot()
+    schema = node.store.schema
+    good = TaskQuery("follows", frontier=np.asarray([1, 2], dtype=np.int64))
+    bad = TaskQuery("follows", frontier=np.asarray([3, 4], dtype=np.int64),
+                    func=("uid_in", ["not-a-uid"]))
+    with pytest.raises(ValueError):            # the error solo would raise
+        taskmod.process_task(snap, bad, schema)
+    want = taskmod.process_task(snap, good, schema)
+
+    results, errors = {}, {}
+    barrier = threading.Barrier(2)
+
+    def run(name, q):
+        barrier.wait(timeout=10)
+        try:
+            results[name] = batcher.dispatch(
+                snap, schema, q,
+                lambda tq, klass=None: taskmod.process_task(
+                    snap, tq, schema))
+        except BaseException as e:             # noqa: BLE001
+            errors[name] = e
+
+    ts = [threading.Thread(target=run, args=("good", good)),
+          threading.Thread(target=run, args=("bad", bad))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert isinstance(errors.get("bad"), ValueError)
+    got = results["good"]
+    assert [m.tolist() for m in got.uid_matrix] == \
+        [m.tolist() for m in want.uid_matrix]
+    assert got.dest_uids.tolist() == want.dest_uids.tolist()
+    occ = node.metrics.histogram("dgraph_batch_occupancy").snapshot()
+    assert occ["max"] == 2, occ                # they DID share one batch
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_batched_kernel_span_carries_batch_size(device_expand):
+    node = _graph_node(span_sample=1.0)
+    queries = [f'{{ q(func: uid(0x{2 * i + 1:x}, 0x{2 * i + 2:x})) '
+               '{ follows { uid } } }' for i in range(3)]
+    _force_batcher(node, max_batch=3)
+    _outs, errs = _concurrent(node, queries)
+    assert not any(errs), errs
+    spans = [s for r in node.tracer.sink.index()
+             for s in node.tracer.sink.get(r["trace_id"])["spans"]]
+    kernels = [s for s in spans if s["name"] == "device_kernel"
+               and s["attrs"].get("kernel") == "batch.expand"]
+    assert kernels, "no batched device_kernel span"
+    assert any(k["attrs"].get("batch", 0) >= 2 for k in kernels), \
+        [k["attrs"] for k in kernels]
+    # every member's own trace records it was batched, with the size
+    joins = [e for s in spans
+             for e in s.get("events", ())
+             if e["name"] == "batched" and e["attrs"].get("size", 0) >= 2]
+    assert joins, "no batched events on member traces"
+    node.close()
+
+
+def test_batch_metrics_on_prometheus_surface(device_expand):
+    from dgraph_tpu.obs import prom
+
+    node = _graph_node()
+    _force_batcher(node, max_batch=2)
+    _outs, errs = _concurrent(
+        node, ['{ q(func: uid(0x1, 0x2)) { follows { uid } } }',
+               '{ q(func: uid(0x3, 0x4)) { follows { uid } } }'])
+    assert not any(errs), errs
+    parsed = prom.parse(prom.render(node.metrics))
+    for name in ("dgraph_batch_formed_total", "dgraph_batch_tasks_total",
+                 "dgraph_batch_window_waits_total"):
+        assert name in parsed, f"{name} missing from /metrics"
+    node.close()
+
+
+def test_classification_reasons_counted():
+    node = _graph_node()      # default cutover: everything is host-class
+    _force_batcher(node, max_batch=4, window_ms=10)
+    node.query('{ q(func: uid(0x1, 0x2)) { name follows { uid } } }')
+    reasons = node.metrics.keyed("dgraph_batch_incompatible").snapshot()
+    assert reasons.get("host_path", 0) >= 1, reasons    # small expand
+    assert reasons.get("value_pred", 0) >= 1, reasons   # name fetch
+    assert node.metrics.counter("dgraph_batch_formed_total").value == 0
+    node.close()
+
+
+# ---------------------------------------------------------------------------
+# gate: per-kernel-class EWMA
+# ---------------------------------------------------------------------------
+
+def test_gate_keeps_per_class_step_estimates():
+    g = DispatchGate(2)
+    g.run(lambda: time.sleep(0.05), klass="vector")
+    g.run(lambda: None, klass="expand")
+    assert g.expected_step("vector") >= 0.05
+    assert g.expected_step("expand") < g.expected_step("vector")
+    # unseen classes fall back to the global EWMA
+    assert g.expected_step("mesh") == g.expected_step_s
+    assert g.expected_step() == g.expected_step_s
+
+
+def test_gate_shed_uses_class_estimate_not_global():
+    """One global EWMA spans ~1ms expands and ~100ms vector steps: with
+    the global poisoned high, a cheap-class acquire must NOT shed — the
+    shed decision reads the caller's class estimate."""
+    g = DispatchGate(1)
+    g._step_ewma = 5.0                 # poisoned global: sheds everything
+    g._class_ewma["vector"] = 5.0
+    g._class_ewma["expand"] = 0.001
+    ev = threading.Event()
+    t = threading.Thread(target=lambda: g.run(lambda: ev.wait(2.0)))
+    t.start()
+    time.sleep(0.05)
+    try:
+        with dl.scope(0.2):
+            with pytest.raises(ResourceExhausted):
+                g.run(lambda: 1, klass="vector")     # 5s est > 0.2s budget
+        with dl.scope(0.2):
+            # expand's 1ms estimate fits the budget: it queues (and times
+            # out as DeadlineExceeded since the slot stays held) instead
+            # of being shed up front
+            with pytest.raises(DeadlineExceeded):
+                g.run(lambda: 1, klass="expand")
+    finally:
+        ev.set()
+        t.join()
+
+
+def test_kernel_klass_labels():
+    assert kernel_klass(TaskQuery("follows",
+                                  frontier=np.zeros(1, np.int64))) == \
+        "expand"
+    assert kernel_klass(TaskQuery("emb",
+                                  func=("similar_to", ["[1]", 1]))) == \
+        "vector"
+    assert kernel_klass(TaskQuery("name", func=("eq", ["x"]))) == "root"
+
+
+def test_classify_rejects_unbatchable_shapes(device_expand):
+    node = _graph_node()
+    snap = node.snapshot()
+    schema = node.store.schema
+    # value predicate
+    key, reason, _ = classify(snap, schema,
+                              TaskQuery("name",
+                                        frontier=np.asarray([1, 2])))
+    assert key is None and reason == "value_pred"
+    # root function
+    key, reason, _ = classify(snap, schema,
+                              TaskQuery("name", func=("eq", ["p1"])))
+    assert key is None and reason == "root_func"
+    # device-class expand classifies, key pinned to the CSR object
+    key, kind, work = classify(
+        snap, schema, TaskQuery("follows",
+                                frontier=np.asarray([1, 2], np.int64)))
+    assert kind == "expand" and key[1] == id(work.csr)
+    # a commit stamps a delta overlay on the tablet: overlay tablets serve
+    # on the solo merge-on-read path until compaction folds a fresh base
+    node.mutate(set_nquads="<0x1> <follows> <0x9> .", commit_now=True)
+    snap2 = node.snapshot()
+    key2, reason2, _ = classify(
+        snap2, schema, TaskQuery("follows",
+                                 frontier=np.asarray([1, 2], np.int64)))
+    assert key2 is None and reason2 == "overlay"
+    # compaction re-folds the base: batching resumes under a NEW key
+    node._assembler.compact(node._lock, force=True)
+    snap3 = node.snapshot()
+    key3, kind3, work3 = classify(
+        snap3, schema, TaskQuery("follows",
+                                 frontier=np.asarray([1, 2], np.int64)))
+    assert kind3 == "expand" and key3 != key and work3.csr is not work.csr
+    node.close()
