@@ -1158,6 +1158,152 @@ def bench_batch(n_subjects=4000, follows=6, pool=128, reps=3,
     return out
 
 
+SKEW_ARTIFACT = "SKEW_r10.json"
+
+
+def bench_skew(n_people=60, rounds=80, seed=20260803, max_ticks=10):
+    """Round-15 placement battery (ISSUE 10): a 3-group wire cluster
+    under seeded Zipfian read-heavy load — ~85% of requests hammer one
+    tablet, pinning its owner group. Measures utilization spread + p50 /
+    QPS of the hot query BEFORE self-heal, runs the placement controller
+    until the spread converges below threshold, and measures AFTER:
+    moves/replicas issued, ticks to heal, spread shrink, and a
+    byte-identity gate over every sampled request (no wrong results
+    through the transitions). Writes SKEW_r10.json."""
+    import random
+
+    from dgraph_tpu.coord.placement import (PlacementConfig,
+                                            PlacementController,
+                                            ZeroOpsExecutor, wire_collect)
+    from dgraph_tpu.coord.zero import Zero
+    from dgraph_tpu.coord.zero_service import ZeroOps, serve_zero
+    from dgraph_tpu.parallel.client import ClusterClient
+    from dgraph_tpu.parallel.remote import serve_worker
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
+
+    schema = ("name: string @index(exact) .\n"
+              "age: int @index(int) .\n"
+              "follows: [uid] @reverse .")
+    zero = Zero(3)
+    zero.move_tablet("name", 0)
+    zero.move_tablet("age", 1)
+    zero.move_tablet("follows", 2)
+    zsrv, zport, svc = serve_zero(zero, "localhost:0")
+    stores, wsrvs, addrs = [], [], []
+    for g in range(3):
+        s = Store()
+        for e in parse_schema(schema):
+            s.set_schema(e)
+        stores.append(s)
+        srv, port = serve_worker(s, "localhost:0")
+        wsrvs.append(srv)
+        addrs.append(f"localhost:{port}")
+        svc._members[g] = [addrs[g]]
+    client = ClusterClient(f"localhost:{zport}",
+                           {g: [addrs[g]] for g in range(3)})
+    try:
+        nq = []
+        for i in range(n_people):
+            nq.append(f'_:p{i} <name> "p{i}" .')
+            nq.append(f'_:p{i} <age> "{20 + i % 50}"^^<xs:int> .')
+        for i in range(n_people - 1):
+            nq.append(f"_:p{i} <follows> _:p{i + 1} .")
+        client.mutate(set_nquads="\n".join(nq))
+        rng = random.Random(seed)
+
+        def ask(qt):
+            client.task_cache.clear()       # force the wire + router
+            return json.dumps(client.query(qt), sort_keys=True)
+
+        hot = ['{ q(func: eq(name, "p%d")) { name } }' % i
+               for i in range(8)]
+        warm = ['{ q(func: ge(age, 45)) { age } }',
+                '{ q(func: has(follows), first: 3) { uid } }']
+        goldens = {qt: ask(qt) for qt in hot + warm}
+
+        def zipf_round(n, lat=None):
+            wrong = 0
+            for _ in range(n):
+                r = rng.random()
+                qt = hot[rng.randrange(len(hot))] if r < 0.85 else \
+                    warm[0] if r < 0.93 else warm[1]
+                t0 = time.perf_counter()
+                got = ask(qt)
+                if lat is not None and qt in hot:
+                    lat.append(time.perf_counter() - t0)
+                if got != goldens[qt]:
+                    wrong += 1
+            return wrong
+
+        cfg = PlacementConfig(threshold=0.6, persist_ticks=1,
+                              cooldown_s=0.0, max_replicas=2, min_rate=0.5)
+        ctl = PlacementController(zero, wire_collect(ops := ZeroOps(svc)),
+                                  ZeroOpsExecutor(ops), cfg=cfg)
+
+        def measure():
+            lat = []
+            t0 = time.perf_counter()
+            wrong = zipf_round(rounds, lat)
+            dt = time.perf_counter() - t0
+            lat.sort()
+            return {"qps": round(rounds / dt, 1),
+                    "p50_ms": round(1e3 * lat[len(lat) // 2], 3),
+                    "wrong": wrong}
+
+        ctl.tick()                           # baseline the counters
+        before = measure()
+        actions, ticks, during_wrong = [], 0, 0
+        act = ctl.tick()                     # first decision on 'before'
+        before["spread"] = ctl.last_diag.get("spread", 0.0)
+        if act is not None:
+            actions.append({"kind": act.kind, "tablet": act.attr,
+                            "dst": act.dst})
+        for _t in range(max_ticks):
+            if actions and \
+                    ctl.last_diag.get("spread", 1.0) <= cfg.threshold:
+                break
+            ticks += 1
+            during_wrong += zipf_round(rounds // 2)
+            act = ctl.tick()
+            if act is not None:
+                actions.append({"kind": act.kind, "tablet": act.attr,
+                                "dst": act.dst})
+        after = measure()
+        ctl.tick()
+        after["spread"] = ctl.last_diag.get("spread", 1.0)
+        holders = zero.replica_holders("name")
+        served = sum(wsrvs[g].dgt_svc.tablet_load_snapshot()
+                     .get("name", {}).get("r", 0) for g in holders)
+        out = {
+            "seed": seed, "rounds": rounds,
+            "before": before, "after": after,
+            "actions": actions, "ticks_to_heal": ticks,
+            "replicas": {a: sorted(gs) for a, gs in
+                         zero.replicas().items()},
+            "replica_served_reads": int(served),
+            "healed_below_threshold":
+                after["spread"] <= cfg.threshold,
+            "byte_identity_pass":
+                before["wrong"] == 0 and during_wrong == 0
+                and after["wrong"] == 0,
+        }
+        if (n_people, rounds) == (60, 80):
+            import os
+
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    SKEW_ARTIFACT), "w") as f:
+                json.dump(out, f, indent=1, sort_keys=True)
+                f.write("\n")
+        return out
+    finally:
+        client.close()
+        for srv in wsrvs:
+            srv.stop(0)
+        zsrv.stop(0)
+
+
 def bench_query_configs():
     """BASELINE configs 2-5: DQL text in -> JSON out on the film graph."""
     from dgraph_tpu.models.film import film_node
@@ -1294,6 +1440,10 @@ def main():
         batch = bench_batch()
     except Exception as e:  # batched-dispatch battery must not sink it
         batch = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        skew = bench_skew()
+    except Exception as e:  # placement battery must not sink it either
+        skew = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -1313,6 +1463,7 @@ def main():
         "chaos": chaos,
         "vector": vector,
         "batch": batch,
+        "skew": skew,
     }))
 
 

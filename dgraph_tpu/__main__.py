@@ -190,7 +190,20 @@ def cmd_worker(args) -> int:
         zc = ZeroClient(args.zero)
         svc = server.dgt_svc
         my_addr = svc.advertise_addr
-        group, rid = zc.connect(my_addr, args.group)
+        # a worker booting while the zeros are still electing (multi-zero
+        # bootstrap) must wait for a leader, not die: retry the initial
+        # registration against transient transport / not-leader rejections
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                group, rid = zc.connect(my_addr, args.group)
+                break
+            except Exception as e:      # noqa: BLE001 — startup retry
+                if time.monotonic() >= deadline:
+                    raise
+                lg.info("zero not ready; retrying connect",
+                        error=type(e).__name__)
+                time.sleep(0.5)
         lg.info("worker joined group", group=group, replica=rid)
 
         def _learn_members():
@@ -265,9 +278,41 @@ def cmd_zero(args) -> int:
         lg.info("zero replica up", idx=args.idx,
                 members=len(replica.members), leader=replica.is_leader)
     ops = ZeroOps(svc)
-    httpd, hport = serve_zero_http(svc, ops, args.host, args.http_port)
+    controller = None
+    if args.rebalance_interval_s > 0 and not args.no_rebalance:
+        # load-aware placement controller (coord/placement.py): scores
+        # tablets by size x measured load from the workers' Status
+        # reports and heals skew with moves + hot-tablet read replicas
+        from dgraph_tpu.coord.placement import (PlacementConfig,
+                                                PlacementController,
+                                                ZeroOpsExecutor,
+                                                wire_collect)
+
+        class _DynamicZero:
+            # multi-zero promotion swaps svc.zero; always read through ops
+            def tablets(self):
+                return ops.zero.tablets()
+
+            def replicas(self):
+                return ops.zero.replicas()
+
+            def moving_tablets(self):
+                return ops.zero.moving_tablets()
+
+        cfg = PlacementConfig(threshold=args.rebalance_threshold,
+                              max_replicas=args.max_replicas)
+        controller = PlacementController(
+            _DynamicZero(), wire_collect(ops), ZeroOpsExecutor(ops),
+            cfg=cfg, logger=lg)
+        controller.start(args.rebalance_interval_s)
+        lg.info("placement controller up",
+                interval_s=args.rebalance_interval_s,
+                threshold=args.rebalance_threshold,
+                max_replicas=args.max_replicas)
+    httpd, hport = serve_zero_http(svc, ops, args.host, args.http_port,
+                                   controller=controller)
     lg.info(f"zero ops HTTP on {args.host}:{hport}")
-    if args.rebalance_interval > 0:
+    if args.rebalance_interval > 0 and not args.no_rebalance:
         def loop():
             while True:
                 time.sleep(args.rebalance_interval)
@@ -291,11 +336,22 @@ def cmd_zero(args) -> int:
 
 
 def cmd_convert(args) -> int:
+    lg = log.get_logger("convert")
+    if args.ldbc:
+        from dgraph_tpu.loader.convert import convert_ldbc
+
+        stats = convert_ldbc(args.ldbc, args.out)
+        lg.info("ldbc convert done", persons=stats.persons,
+                knows=stats.knows, posts=stats.posts,
+                triples=stats.triples, out=args.out)
+        return 0
+    if not args.geo:
+        raise SystemExit("convert needs --geo <file> or --ldbc <dir>")
     from dgraph_tpu.loader.convert import convert_geojson
 
     stats = convert_geojson(args.geo, args.out, geopred=args.geopred)
-    log.get_logger("convert").info("convert done", features=stats.features,
-                                   triples=stats.triples, out=args.out)
+    lg.info("convert done", features=stats.features,
+            triples=stats.triples, out=args.out)
     return 0
 
 
@@ -501,8 +557,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "survive restarts (a crash skips at most one "
                          "10k lease block, assign.go semantics)")
     zp.add_argument("--rebalance_interval", type=float, default=0,
-                    help="seconds between automatic tablet rebalance ticks "
-                         "(0 = off)")
+                    help="seconds between LEGACY size-based rebalance ticks "
+                         "(tablet.go:60-74; 0 = off)")
+    zp.add_argument("--rebalance_interval_s", type=float, default=0,
+                    help="seconds between load-aware placement controller "
+                         "ticks (coord/placement.py: scores tablets by "
+                         "size x measured load, heals skew with moves + "
+                         "hot-tablet read replicas; 0 = off)")
+    zp.add_argument("--rebalance_threshold", type=float, default=0.35,
+                    help="group utilization spread (max-min)/max above "
+                         "which the controller acts")
+    zp.add_argument("--max_replicas", type=int, default=2,
+                    help="read-replica holders per tablet (0 disables "
+                         "replication; moves still run)")
+    zp.add_argument("--no_rebalance", action="store_true",
+                    help="disable ALL automatic placement (both the "
+                         "size-based tick and the load controller): "
+                         "placement stays exactly as manual moves left it")
     zp.add_argument("--peers", default="",
                     help="multi-zero: comma-separated addresses of ALL "
                          "zeros (incl. this one); state replicates to a "
@@ -513,8 +584,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "as leader)")
     zp.set_defaults(fn=cmd_zero)
 
-    cp = sub.add_parser("convert", help="GeoJSON -> RDF (.rdf.gz)")
-    cp.add_argument("--geo", required=True, help="GeoJSON file (optionally .gz)")
+    cp = sub.add_parser("convert",
+                        help="GeoJSON or LDBC-SNB CSV -> RDF (.rdf.gz)")
+    cp.add_argument("--geo", default=None,
+                    help="GeoJSON file (optionally .gz)")
+    cp.add_argument("--ldbc", default=None,
+                    help="LDBC-SNB interactive CSV dump dir (persons/"
+                         "knows/posts subset mapped to N-Quads)")
     cp.add_argument("--out", default="output.rdf.gz")
     cp.add_argument("--geopred", default="loc",
                     help="predicate for geometries")

@@ -101,6 +101,10 @@ async function show(r, t0){
     const ba = m.batching || {};
     if (ba.formed) lat += ' · batch ' +
         (ba.occupancy.mean||0).toFixed(1) + 'x/' + ba.formed;
+    const tl = Object.entries(m.tablet_load || {})
+        .sort((a,b)=>(b[1].r||0)-(a[1].r||0))[0];
+    if (tl) lat += ' · hot ' + tl[0] + ' (' + (tl[1].r||0) + 'r/' +
+        (tl[1].w||0) + 'w)';
   }catch(e){}
   document.getElementById('lat').textContent = lat;
   try{document.getElementById('out').textContent =
@@ -244,6 +248,12 @@ def _serving_metrics(node: Node) -> dict:
             "degraded_reads": c("dgraph_degraded_reads_total"),
             "faults_injected": c("dgraph_fault_injected_total"),
         },
+        # per-tablet load counters (coord/placement.py TabletLoadBook):
+        # the placement controller's scoring inputs — reads/writes/result
+        # bytes/serve seconds per predicate — inspectable here and as the
+        # dgraph_tablet_load{pred,group,stat} series on /metrics
+        # independently of any controller's decisions
+        "tablet_load": node.tablet_book.snapshot(),
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),
                  "latency": m.histogram(

@@ -214,6 +214,14 @@ class Node:
             self.mesh_exec = MeshExecutor(
                 n_devices=None if mesh_devices < 0 else mesh_devices,
                 metrics=self.metrics, shard_min_edges=mesh_min_edges)
+        # per-tablet load counters (coord/placement.py TabletLoadBook):
+        # every dispatched task and applied edge counts toward the
+        # dgraph_tablet_load{pred,group,stat} series on /metrics and the
+        # /debug/metrics tablet_load section — the placement controller's
+        # scoring inputs, inspectable on the embedded node too
+        from dgraph_tpu.coord.placement import TabletLoadBook
+
+        self.tablet_book = TabletLoadBook(self.metrics, group=0)
 
     def set_memory_budget(self, budget_bytes: int) -> None:
         """Install/retarget the memory budget and ensure the background
@@ -484,6 +492,14 @@ class Node:
             else float(timeout_ms)
         return dl.scope(ms / 1000.0 if ms and ms > 0 else None)
 
+    def _count_task(self, tq, res, dt: float) -> None:
+        """Executor on_task hook: per-tablet read accounting."""
+        attr = tq.attr[1:] if tq.attr.startswith("~") else tq.attr
+        out_bytes = 0.0
+        if getattr(res, "dest_uids", None) is not None:
+            out_bytes = 8.0 * len(res.dest_uids)
+        self.tablet_book.record_read(attr, out_bytes=out_bytes, serve_s=dt)
+
     def query(self, q: str, variables: dict | None = None,
               start_ts: int | None = None,
               read_only: bool = False,
@@ -595,7 +611,8 @@ class Node:
                            edge_limit=edge_limit, plan=plan,
                            explain=recorder,
                            mesh=self.mesh_exec,
-                           batcher=self.batcher).execute(req)
+                           batcher=self.batcher,
+                           on_task=self._count_task).execute(req)
             tr.printf("executed")
             if rkey is not None:
                 self.result_cache.put(rkey, out)
@@ -649,7 +666,8 @@ class Node:
                                   cache=self.task_cache,
                                   gate=self.dispatch_gate,
                                   mesh=self.mesh_exec,
-                                  batcher=self.batcher)
+                                  batcher=self.batcher,
+                                  on_task=self._count_task)
                     out = ex.execute(self._parse(q, variables))
                     vars_map = ex.vars
                 uid_map: dict = {}
@@ -785,8 +803,12 @@ class Node:
                         # if oracle bookkeeping above raised
                         ctx.inflight -= 1
                         self._inflight_cv.notify_all()
+            from collections import Counter
+
+            edge_counts = Counter(e.attr for e in edges)
             for p in preds:
                 self.zero.should_serve(p)
+                self.tablet_book.record_write(p, n=edge_counts[p] or 1)
             res = MutationResult(uids=uid_map, context=ctx)
             if commit_now:
                 self.commit(ctx.start_ts)

@@ -293,6 +293,12 @@ class Zero:
         self.uids = UidLease()
         self.n_groups = max(1, n_groups)
         self._tablets: dict[str, int] = {}
+        # read-only tablet replicas (coord/placement.py): attr -> {holder
+        # group: applied watermark}. A holder serves reads of a tablet it
+        # does NOT own, kept fresh by delta ships; the watermark is the
+        # owner commit ts its copy provably covers (the replica-read gate
+        # bound). Owners never appear as their own holders.
+        self._replicas: dict[str, dict[int, int]] = {}
         self._moving: set[str] = set()     # tablets mid-move: writes blocked
         self._tlock = threading.Lock()
         self._dir = dirpath
@@ -317,6 +323,9 @@ class Zero:
                     self.uids.bump_to(self._uid_ceiling)
                 self._tablets = {a: int(g)
                                  for a, g in st.get("tablets", {}).items()}
+                self._replicas = {
+                    a: {int(g): int(wm) for g, wm in gs.items()}
+                    for a, gs in st.get("replicas", {}).items()}
                 self.n_groups = max(self.n_groups,
                                     int(st.get("n_groups", self.n_groups)))
             # lease-source callbacks run UNDER the issuing lock, so a ts
@@ -338,20 +347,25 @@ class Zero:
     # durable write — the leader's ZeroReplica ships it to standby zeros
     persist_sink = None
 
-    def _persist(self, tablets: dict | None = None) -> None:
+    def _persist(self, tablets: dict | None = None,
+                 replicas: dict | None = None) -> None:
         import json as _json
         import os as _os
 
-        # take the tablet snapshot BEFORE _plock (callers inside _tlock
-        # pass it; taking _tlock under _plock would deadlock against the
-        # _tlock -> _plock order of the claim paths)
+        # take the tablet/replica snapshots BEFORE _plock (callers inside
+        # _tlock pass them; taking _tlock under _plock would deadlock
+        # against the _tlock -> _plock order of the claim paths)
         snap = tablets if tablets is not None else self.tablets()
+        rsnap = replicas if replicas is not None else self.replicas()
         path = _os.path.join(self._dir, "zero_state.json")
         tmp = path + ".tmp"
         with self._plock:   # ts/uid/tablet persists may race each other
             payload = _json.dumps({"ts_ceiling": self._ts_ceiling,
                                    "uid_ceiling": self._uid_ceiling,
                                    "tablets": snap,
+                                   "replicas": {a: {str(g): wm
+                                                    for g, wm in gs.items()}
+                                                for a, gs in rsnap.items()},
                                    "n_groups": self.n_groups})
             with open(tmp, "w") as f:
                 f.write(payload)
@@ -397,7 +411,8 @@ class Zero:
                     # durable BEFORE any caller can act on the claim — a
                     # crash must not re-balance a tablet that data already
                     # landed on (the reference Raft-proposes the claim)
-                    self._persist(tablets=dict(self._tablets))
+                    self._persist(tablets=dict(self._tablets),
+                                  replicas=self._replicas_locked())
         return g
 
     def tablets(self) -> dict[str, int]:
@@ -407,8 +422,69 @@ class Zero:
     def move_tablet(self, attr: str, group: int) -> None:
         with self._tlock:
             self._tablets[attr] = group
+            # the new owner must not also be listed as a read replica of
+            # itself (a move to a holder group collapses that replica)
+            holders = self._replicas.get(attr)
+            if holders is not None:
+                holders.pop(group, None)
+                if not holders:
+                    del self._replicas[attr]
             if self._dir:
-                self._persist(tablets=dict(self._tablets))
+                self._persist(tablets=dict(self._tablets),
+                              replicas=self._replicas_locked())
+
+    # -- read-only tablet replicas (coord/placement.py) ----------------------
+
+    def _replicas_locked(self) -> dict:
+        return {a: dict(gs) for a, gs in self._replicas.items()}
+
+    def replicas(self) -> dict[str, dict[int, int]]:
+        """attr -> {holder group: covered watermark} for every tablet with
+        read replicas."""
+        with self._tlock:
+            return self._replicas_locked()
+
+    def replica_holders(self, attr: str) -> dict[int, int]:
+        with self._tlock:
+            return dict(self._replicas.get(attr, {}))
+
+    def add_replica(self, attr: str, group: int, watermark: int) -> None:
+        """Register a read replica AFTER its data is installed (routing
+        starts the moment the map carries it — never before the copy is
+        complete)."""
+        with self._tlock:
+            if self._tablets.get(attr) == group:
+                return                 # the owner is not a replica
+            self._replicas.setdefault(attr, {})[group] = int(watermark)
+            if self._dir:
+                self._persist(tablets=dict(self._tablets),
+                              replicas=self._replicas_locked())
+
+    def set_replica_watermark(self, attr: str, group: int,
+                              watermark: int) -> None:
+        with self._tlock:
+            holders = self._replicas.get(attr)
+            if holders is not None and group in holders:
+                holders[group] = max(holders[group], int(watermark))
+                if self._dir:
+                    self._persist(tablets=dict(self._tablets),
+                                  replicas=self._replicas_locked())
+
+    def drop_replica(self, attr: str, group: int) -> bool:
+        """Unregister a replica BEFORE its data is deleted (routing stops
+        first; in-flight reads are covered by the holder-side existence
+        check in serve_task)."""
+        with self._tlock:
+            holders = self._replicas.get(attr)
+            if holders is None or group not in holders:
+                return False
+            del holders[group]
+            if not holders:
+                del self._replicas[attr]
+            if self._dir:
+                self._persist(tablets=dict(self._tablets),
+                              replicas=self._replicas_locked())
+            return True
 
     def state(self) -> dict:
         """Membership dump (reference /state, dgraph/cmd/zero/http.go:130)."""
@@ -418,6 +494,10 @@ class Zero:
             # per-tablet last commit ts: the replica-read floor hedged
             # reads carry (TaskRequest.min_applied)
             "predCommit": dict(self.oracle.pred_commit),
+            # read-replica holders per tablet (the query router spreads
+            # reads across owner + holders; coord/placement.py maintains)
+            "replicaMap": {a: sorted(gs)
+                           for a, gs in self.replicas().items()},
             "groups": {str(g): {"tablets": sorted(
                 a for a, gg in self.tablets().items() if gg == g)}
                 for g in range(self.n_groups)},

@@ -561,6 +561,18 @@ class ZeroOps:
         import base64
 
         with self._move_lock:
+            # read replicas of a moving tablet are dropped FIRST — inside
+            # _move_lock, so a concurrent install_replica (controller tick
+            # or manual /addReplica) cannot re-install one between the
+            # drop and the stream: the move streams into the destination
+            # store, and a destination that already holds replica rows
+            # would union two copies; holders on other groups would keep
+            # pulling deltas from a deposed owner.
+            for g in sorted(self.zero.replica_holders(attr)):
+                try:
+                    self.drop_replica(attr, g)
+                except Exception:
+                    pass    # routing already stopped; data reaped later
             src_group = self.zero.tablets().get(attr)
             if src_group is None:
                 raise MoveError(f"tablet {attr!r} is not served")
@@ -606,6 +618,7 @@ class ZeroOps:
                     sent = ingested = 0
                     cursor = b""
                     while True:
+                        faults.fire("move.chunk_ship")
                         resp = src.predicate_data(
                             attr, read_ts, move_st.start_ts, after=cursor,
                             max_bytes=self.chunk_bytes)
@@ -655,6 +668,191 @@ class ZeroOps:
                 src.close()
                 dst.close()
 
+    # -- read-only tablet replicas (coord/placement.py drives these) --------
+
+    def install_replica(self, attr: str, dst_group: int) -> dict:
+        """Install a read-only copy of a tablet on another group — the
+        move protocol's streaming half with neither the map flip nor the
+        source delete, and WITHOUT blocking writes (the copy is a snapshot
+        cut; later commits reach the holder via delta ships).
+
+        Coverage ordering makes the replica-read gate exact: read_ts is
+        taken FIRST, so every commit <= read_ts was assigned before it and
+        is <= the oracle's per-tablet floor read afterwards; waiting for
+        the source to APPLY up to that floor guarantees the stream at
+        read_ts contains them all. The holder commits the copy at read_ts
+        — its gate watermark claims exactly what the cut holds."""
+        with self._move_lock:
+            src_group = self.zero.tablets().get(attr)
+            if src_group is None:
+                raise MoveError(f"tablet {attr!r} is not served")
+            if src_group == dst_group:
+                return {"installed_records": 0, "tablet": attr,
+                        "noop": "owner"}
+            if dst_group in self.zero.replica_holders(attr):
+                return {"installed_records": 0, "tablet": attr,
+                        "noop": "already a holder"}
+            src = self._leader_of(src_group)
+            try:
+                dst = self._leader_of(dst_group)
+            except BaseException:
+                src.close()
+                raise
+            try:
+                # clear any ORPHANED copy first: a prior drop_replica may
+                # have unregistered the holder but failed the delete
+                # (holder unreachable) — streaming over the stale copy
+                # would union the two and resurrect deleted edges behind
+                # a watermark that claims full freshness. Idempotent on a
+                # clean destination.
+                dst.delete_predicate(attr)
+                read_ts = self.zero.oracle.read_ts()
+                target = self.zero.oracle.pred_commit.get(attr, 0)
+                deadline = time.monotonic() + 5.0
+                while target and time.monotonic() < deadline:
+                    applied = json.loads(
+                        src.membership().pred_commit_json or "{}")
+                    if int(applied.get(attr, 0)) >= target:
+                        break
+                    time.sleep(0.05)
+                else:
+                    if target:
+                        raise MoveError(
+                            f"source never applied commits on {attr!r} up "
+                            f"to ts {target}; replica install aborted")
+                start_ts = self.zero.oracle.timestamps(1)
+                keys_b64: list[str] = []
+                sent = ingested = 0
+                cursor = b""
+                try:
+                    import base64
+
+                    while True:
+                        faults.fire("move.chunk_ship")
+                        resp = src.predicate_data(
+                            attr, read_ts, start_ts, after=cursor,
+                            max_bytes=self.chunk_bytes)
+                        keys_b64.extend(base64.b64encode(bytes(k)).decode()
+                                        for k in resp.keys)
+                        sent += len(resp.records)
+                        if resp.records:
+                            ingested += dst.ingest_records(
+                                list(resp.records))
+                        if resp.done:
+                            break
+                        cursor = bytes(resp.next)
+                    if ingested != sent:
+                        raise MoveError(
+                            f"replica install handshake failed: sent "
+                            f"{sent}, destination ingested {ingested}")
+                    crec = json.dumps(
+                        {"t": "c", "s": start_ts, "ts": read_ts,
+                         "k": keys_b64}, separators=(",", ":")).encode()
+                    dst.ingest_records([crec])
+                except BaseException:
+                    # reap the partial copy; the tablet was never routed
+                    # to this holder, so aborting the buffered txn is safe
+                    try:
+                        arec = json.dumps(
+                            {"t": "a", "s": start_ts, "k": keys_b64},
+                            separators=(",", ":")).encode()
+                        dst.ingest_records([arec])
+                    except Exception:
+                        pass
+                    raise
+                # routing starts ONLY now, with the data fully installed
+                self.zero.add_replica(attr, dst_group, read_ts)
+                return {"installed_records": sent, "tablet": attr,
+                        "src": src_group, "dst": dst_group,
+                        "watermark": read_ts}
+            finally:
+                src.close()
+                dst.close()
+
+    def ship_replica_delta(self, attr: str, holder_group: int) -> dict:
+        """Freshness ship: pull the owner's O(Δ) journal above the
+        holder's watermark as DEL_ALL+rewrite records, apply them on the
+        holder, commit at the owner's covered watermark. A journal that
+        cannot prove completeness triggers a full re-install."""
+        faults.fire("replica.delta_ship")
+        holders = self.zero.replica_holders(attr)
+        if holder_group not in holders:
+            raise MoveError(f"group {holder_group} holds no replica of "
+                            f"{attr!r}")
+        since = int(holders[holder_group])
+        src_group = self.zero.tablets().get(attr)
+        if src_group is None or src_group == holder_group:
+            raise MoveError(f"tablet {attr!r} has no distinct owner")
+        src = self._leader_of(src_group)
+        try:
+            dst = self._leader_of(holder_group)
+        except BaseException:
+            src.close()
+            raise
+        try:
+            read_ts = self.zero.oracle.read_ts()
+            start_ts = self.zero.oracle.timestamps(1)
+            resp = src.tablet_delta(attr, since, read_ts, start_ts)
+            watermark = int(resp.watermark)
+            if resp.full_resync:
+                # journal overflow / bulk install: drop + re-install
+                self.drop_replica(attr, holder_group)
+                out = self.install_replica(attr, holder_group)
+                out["resync"] = True
+                return out
+            if watermark <= since or not resp.records:
+                self.zero.set_replica_watermark(attr, holder_group,
+                                                watermark)
+                return {"shipped_records": 0, "tablet": attr,
+                        "watermark": max(watermark, since)}
+            import base64
+
+            keys_b64 = [base64.b64encode(bytes(k)).decode()
+                        for k in resp.keys]
+            try:
+                dst.ingest_records(list(resp.records))
+                crec = json.dumps(
+                    {"t": "c", "s": start_ts, "ts": watermark,
+                     "k": keys_b64}, separators=(",", ":")).encode()
+                dst.ingest_records([crec])
+            except BaseException:
+                # reap the buffered rewrite txn: a failure between the
+                # record ship and the commit record would otherwise leave
+                # uncommitted layers at start_ts on the holder forever
+                # (nothing else ever decides that ts)
+                try:
+                    arec = json.dumps(
+                        {"t": "a", "s": start_ts, "k": keys_b64},
+                        separators=(",", ":")).encode()
+                    dst.ingest_records([arec])
+                except Exception:
+                    pass
+                raise
+            self.zero.set_replica_watermark(attr, holder_group, watermark)
+            return {"shipped_records": len(resp.records), "tablet": attr,
+                    "keys": len(resp.keys), "watermark": watermark}
+        finally:
+            src.close()
+            dst.close()
+
+    def drop_replica(self, attr: str, holder_group: int) -> bool:
+        """Demote a replica: unregister from the map FIRST (routing stops;
+        in-flight reads are covered by the holder's serve-time existence
+        check), then delete the copy at the holder."""
+        if not self.zero.drop_replica(attr, holder_group):
+            return False
+        try:
+            rw = self._leader_of(holder_group)
+            try:
+                rw.delete_predicate(attr)
+            finally:
+                rw.close()
+        except Exception:
+            # holder unreachable: the data is orphaned but unrouted; a
+            # later install to this group starts from delete anyway
+            pass
+        return True
+
     def rebalance_once(self) -> dict | None:
         """One tick: size reports from every group's leader feed the shared
         decision (coord/zero.choose_rebalance_move), then move_tablet."""
@@ -673,8 +871,12 @@ class ZeroOps:
                     rw.status().tablet_sizes_json or "{}").items()}
             finally:
                 rw.close()
-        pick = choose_rebalance_move(sizes,
-                                     blocked=self.zero.moving_tablets())
+        # replicated tablets are the load controller's responsibility —
+        # their copies also inflate holder sizes, which would mislead the
+        # size-only decision
+        pick = choose_rebalance_move(
+            sizes, blocked=self.zero.moving_tablets()
+            | set(self.zero.replicas()))
         if pick is None:
             return None
         attr, _src, dst, sz = pick
@@ -694,10 +896,13 @@ class ZeroOps:
 
 
 def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
-                    port: int = 0):
+                    port: int = 0, controller=None):
     """Zero's ops HTTP endpoints (dgraph/cmd/zero/http.go:38-130):
     GET /state, GET /moveTablet?tablet=X&group=N,
-    GET /removeNode?group=N&addr=A. Returns (server, bound_port)."""
+    GET /removeNode?group=N&addr=A, plus the placement surface —
+    GET /placement (controller decision log + load book + config),
+    GET /addReplica?tablet=X&group=N, GET /dropReplica?tablet=X&group=N,
+    GET /shipReplica?tablet=X&group=N. Returns (server, bound_port)."""
     import http.server
     import urllib.parse
 
@@ -727,6 +932,24 @@ def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
                 elif u.path == "/removeNode":
                     ok = ops.remove_node(int(q["group"][0]), q["addr"][0])
                     self._reply(200 if ok else 404, {"removed": ok})
+                elif u.path == "/addReplica":
+                    self._reply(200, ops.install_replica(
+                        q["tablet"][0], int(q["group"][0])))
+                elif u.path == "/dropReplica":
+                    ok = ops.drop_replica(q["tablet"][0],
+                                          int(q["group"][0]))
+                    self._reply(200 if ok else 404, {"dropped": ok})
+                elif u.path == "/shipReplica":
+                    self._reply(200, ops.ship_replica_delta(
+                        q["tablet"][0], int(q["group"][0])))
+                elif u.path == "/placement":
+                    if controller is None:
+                        self._reply(200, {"enabled": False,
+                                          "replicaMap": {
+                                              a: sorted(gs) for a, gs in
+                                              ops.zero.replicas().items()}})
+                    else:
+                        self._reply(200, controller.snapshot())
                 else:
                     self._reply(404, {"error": f"unknown path {u.path}"})
             except Exception as e:      # noqa: BLE001 — ops surface

@@ -85,7 +85,15 @@ def render(registry) -> str:
     for name, g in sorted(keyed.items()):
         name = _safe(name)
         out.append(f"# TYPE {name} gauge")
+        labels = getattr(g, "labels", None)
         for key, v in sorted(g.snapshot().items()):
+            if labels:
+                parts = key.split("|", len(labels) - 1)
+                if len(parts) == len(labels):
+                    lbl = ",".join(f'{n}="{_esc(p)}"'
+                                   for n, p in zip(labels, parts))
+                    out.append(f"{name}{{{lbl}}} {_num(v)}")
+                    continue
             out.append(f'{name}{{key="{_esc(key)}"}} {_num(v)}')
 
     return "\n".join(out) + "\n"

@@ -129,6 +129,11 @@ class ClusterClient:
         # request lifelines (ISSUE 7)
         self.default_timeout_ms = float(default_timeout_ms)
         self.degraded_reads = degraded_reads
+        # replica-spread cursor shared across requests (each request
+        # builds its own NetworkDispatcher; the rotation must survive it)
+        import itertools
+
+        self._replica_rr = itertools.count()
         self.last_degraded: dict | None = None   # set per degraded query
         self._last_zstate: tuple[float, dict] | None = None
         self._retry_rng = retry_rng      # injectable backoff jitter source
@@ -378,6 +383,11 @@ class ClusterClient:
         read_ts = int(zstate.get("maxTxnTs", 0))
         floors = {k: int(v)
                   for k, v in zstate.get("predCommit", {}).items()}
+        # read-replica holders (coord/placement.py): reads spread across
+        # owner + holders; NOT in degraded mode — a frozen map cannot
+        # prove which holders are still fresh, so only primaries serve
+        replica_map = {a: [int(g) for g in gs]
+                       for a, gs in zstate.get("replicaMap", {}).items()}
         zero = self.zero
         if degraded is not None:
             # Zero is unreachable: route from the last known tablet map
@@ -386,12 +396,15 @@ class ClusterClient:
             # observability mirror that concurrent requests may reset)
             self.last_degraded = degraded
             zero = _FrozenZero(zstate.get("tabletMap", {}))
+            replica_map = {}
         dispatcher = NetworkDispatcher(
             zero, local_group=-1,
             local_snap_fn=lambda ts: GraphSnapshot(ts),
             remotes=dict(self.replicas),
             schema=schema, pred_floors=floors,
-            cache=self.task_cache, gate=self.dispatch_gate)
+            cache=self.task_cache, gate=self.dispatch_gate,
+            tablet_replicas=replica_map, metrics=self.metrics,
+            rr_counter=self._replica_rr)
         snap = GraphSnapshot(read_ts)
         ex = Executor(snap, schema,
                       dispatch=lambda tq: dispatcher.process_task(tq, read_ts))

@@ -33,8 +33,8 @@ from ..utils.ballot import tally as _tally
 from ..utils.retry import CircuitBreaker
 from ..query.task import TaskQuery, TaskResult, process_task
 from ..storage.csr_build import STRUCTURAL_RECORDS
-from ..storage.store import decode_record
-from ..storage.postings import DirectedEdge, Op
+from ..storage.store import _key_bytes, decode_record
+from ..storage.postings import DirectedEdge, Op, Posting
 from ..storage.store import _val_from_json, _val_to_json
 
 SERVICE = "dgraph_tpu.internal.Worker"
@@ -114,14 +114,16 @@ def decode_result(msg: ipb.TaskResponse) -> TaskResult:
 
 
 def encode_task(q: TaskQuery, read_ts: int,
-                min_applied: int = 0) -> ipb.TaskRequest:
+                min_applied: int = 0,
+                replica_read: bool = False) -> ipb.TaskRequest:
     return ipb.TaskRequest(
         attr=q.attr, has_frontier=q.frontier is not None,
         frontier=_uids_to_bytes(q.frontier) if q.frontier is not None else b"",
         func_name=q.func[0] if q.func else "",
         func_args_json=json.dumps(q.func[1]) if q.func else "",
         lang=q.lang, facet_keys=list(q.facet_keys), first=q.first,
-        reverse=q.reverse, read_ts=read_ts, min_applied=min_applied)
+        reverse=q.reverse, read_ts=read_ts, min_applied=min_applied,
+        replica_read=replica_read)
 
 
 def decode_task(msg: ipb.TaskRequest) -> tuple[TaskQuery, int]:
@@ -225,6 +227,25 @@ class WorkerService:
                                          max_batch=batch_max)
         # replica-read gate concurrency cap (see serve_task convoy guard)
         self._gate_slots = threading.BoundedSemaphore(2)
+        # per-tablet load counters since process start — reads/writes/
+        # result-bytes/serve-seconds per attr, reported on Status as
+        # tablet_load_json: the placement controller's scoring input
+        # (coord/placement.py diffs successive polls). The book also
+        # mirrors the dgraph_tablet_load gauge into this worker's
+        # registry; group is unknown until Connect, so it stays 0 here.
+        from ..coord.placement import TabletLoadBook
+
+        self.tablet_book = TabletLoadBook(self.metrics)
+        # move fences (coord/placement.py systest gate: no wrong results
+        # during moves). A worker that DELETED a tablet after moving it
+        # away must refuse its reads typed — a client with a stale (TTL'd)
+        # tablet map would otherwise get silently-empty answers; and a
+        # worker that INGESTED a tablet refuses reads below the install
+        # commit ts — the streamed copy has no history under it. Both
+        # refusals are FAILED_PRECONDITION: the client invalidates its
+        # caches and retries against fresh routing + a fresh read_ts.
+        self._moved_away: set[str] = set()
+        self._ingest_floor: dict[str, int] = {}
         self._move_keys_cache = None
         # replication role. _rlock guards follower-side state ONLY; the
         # leader-side _ship path deliberately takes no service lock (it runs
@@ -314,12 +335,50 @@ class WorkerService:
                 except Exception:
                     pass     # context already terminated (abort path)
 
+    def tablet_load_snapshot(self) -> dict:
+        return self.tablet_book.snapshot()
+
     def _serve_task_inner(self, msg: ipb.TaskRequest,
                           context) -> ipb.TaskResponse:
         faults.fire("worker.serve_task", m=self.metrics)
         q, read_ts = decode_task(msg)
-        if msg.min_applied:
-            attr = q.attr[1:] if q.attr.startswith("~") else q.attr
+        attr = q.attr[1:] if q.attr.startswith("~") else q.attr
+        if msg.replica_read:
+            # tablet-replica serving (coord/placement.py): this store holds
+            # a read-only COPY whose per-tablet watermark is the owner
+            # commit ts the last install/delta ship covered. Both bounds
+            # refuse with FAILED_PRECONDITION so the router falls back to
+            # the primary instead of serving a wrong cut:
+            #   behind — a commit the read's floor requires has not been
+            #            shipped (no wait: ships are controller-paced, the
+            #            primary can answer now);
+            #   ahead  — a delta rewrite landed ABOVE this read's snapshot
+            #            ts; rewrites replace whole keys, so per-key
+            #            history below the rewrite is not point-in-time
+            #            faithful for this older read.
+            wm = self.store.pred_commit_ts.get(attr, 0)
+            if msg.min_applied and wm < msg.min_applied:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"tablet replica behind on {attr!r}: covered {wm} "
+                    f"< {msg.min_applied}")
+            if wm > read_ts:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"tablet replica ahead on {attr!r}: covered {wm} "
+                    f"> read_ts {read_ts}")
+        else:
+            if attr in self._moved_away \
+                    and attr not in self.store.predicates():
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              f"tablet {attr!r} moved away from this group")
+            floor = self._ingest_floor.get(attr, 0)
+            if floor and read_ts < floor:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"tablet {attr!r} was installed here at ts {floor}; "
+                    f"read_ts {read_ts} predates its history")
+        if not msg.replica_read and msg.min_applied:
             if self.store.pred_commit_ts.get(attr, 0) < msg.min_applied:
                 # bounded waiters: gated reads must not occupy the whole
                 # server pool and starve the Append/Decide RPCs that would
@@ -355,6 +414,7 @@ class WorkerService:
                     self._gate_slots.release()
         from ..query.qcache import task_token
 
+        t0 = time.monotonic()
         snap = self._snapshot(read_ts)
         solo = lambda tq, klass=None: process_task(     # noqa: E731
             snap, tq, self.store.schema)
@@ -362,7 +422,19 @@ class WorkerService:
             lambda tq: self.batcher.dispatch(
                 snap, self.store.schema, tq, solo))
         res = self.task_cache.dispatch(task_token(snap, q), q, run)
-        return encode_result(res)
+        if msg.replica_read and attr not in self.store.predicates():
+            # the controller dropped this replica mid-request: the answer
+            # may have been computed over an already-deleted tablet — a
+            # snapshot assembled BEFORE the delete is still a valid cut
+            # (refusing it merely costs a fallback), one assembled after
+            # would serve empty. Refuse either way; the primary serves.
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"tablet replica of {attr!r} was dropped")
+        out = encode_result(res)
+        self.tablet_book.record_read(attr,
+                                     out_bytes=float(out.ByteSize()),
+                                     serve_s=time.monotonic() - t0)
+        return out
 
     def membership(self, _msg: ipb.MembershipRequest,
                    context) -> ipb.MembershipResponse:
@@ -384,6 +456,8 @@ class WorkerService:
         edges = [decode_edge(e) for e in msg.edges]
         touched, conflict, preds = mut.apply_mutations(
             self.store, edges, msg.start_ts)
+        for e in edges:
+            self.tablet_book.record_write(e.attr)
         return ipb.MutateResponse(keys=touched, conflict_keys=conflict,
                                   preds=sorted(preds))
 
@@ -827,7 +901,10 @@ class WorkerService:
             leader=self.is_leader,
             max_commit_ts=self.store.max_seen_commit_ts,
             tablets=self.store.predicates(), tablet_bytes=cached[1],
-            tablet_sizes_json=cached[2])
+            tablet_sizes_json=cached[2],
+            # live, not TTL-cached: load moves far faster than sizes and
+            # the snapshot is one locked dict copy
+            tablet_load_json=json.dumps(self.tablet_load_snapshot()))
 
     # -- distributed sort + schema (worker/sort.go:50, worker/schema.go:160) --
 
@@ -928,6 +1005,61 @@ class WorkerService:
         return ipb.PredicateDataResponse(records=records, keys=keys,
                                          next=next_cursor, done=not more)
 
+    def tablet_delta(self, msg: ipb.TabletDeltaRequest,
+                     context) -> ipb.TabletDeltaResponse:
+        """Source side of a replica freshness ship (coord/placement.py):
+        every key of the tablet committed after since_ts — from the O(Δ)
+        delta journal (storage/store.delta_since, PR 2) — emitted as a
+        DEL_ALL rewrite plus the key's effective postings at read_ts.
+        The holder applies the records and commits them at `watermark`
+        (the applied per-tablet ts this enumeration provably covers), so
+        its replica-read gate stays exact. The watermark is read BEFORE
+        the journal: a commit racing in between ships extra data but is
+        never claimed as covered (understating is the safe direction).
+        full_resync=true when the journal cannot prove completeness
+        (overflow / bulk install / pre-journal base) — the controller
+        re-installs from a full PredicateData stream instead."""
+        from ..storage.store import encode_record
+
+        attr = msg.attr
+        watermark = self.store.pred_commit_ts.get(attr, 0)
+        delta = self.store.delta_since(attr, int(msg.since_ts))
+        if delta is None:
+            return ipb.TabletDeltaResponse(full_resync=True,
+                                           watermark=watermark)
+        records: list[bytes] = []
+        keys: list[bytes] = []
+        start_ts = int(msg.start_ts)
+        for kb in sorted(delta):
+            pl = self.store.lists.get(kb)
+            if pl is None:
+                continue
+            # DEL_ALL first: add_mutation folds it into the same txn
+            # layer, clearing prior postings, so the rewrite REPLACES the
+            # holder's copy of this key instead of unioning with it
+            records.append(encode_record(
+                {"t": "m", "s": start_ts, "k": kb,
+                 "p": Posting(0, Op.DEL_ALL)}))
+            # the read cut is the CLAIMED watermark, not the caller's
+            # read_ts: a commit applied between the watermark read and
+            # this key's read must not leak into a rewrite stamped at the
+            # watermark (the holder would serve it to reads below its
+            # commit ts — fresher than the snapshot asked for). A rollup
+            # that folded past the watermark is equivalent at base_ts:
+            # this tablet has no committed layer in (watermark, base_ts]
+            # (watermark IS its max applied), so the folded base is the
+            # same cut.
+            try:
+                effective = pl.postings(watermark)
+            except ValueError:
+                effective = pl.postings(pl.base_ts)
+            for p in effective:
+                records.append(encode_record(
+                    {"t": "m", "s": start_ts, "k": kb, "p": p}))
+            keys.append(kb)
+        return ipb.TabletDeltaResponse(records=records, keys=keys,
+                                       watermark=watermark)
+
     def ingest_records(self, msg: ipb.IngestRequest,
                        context) -> ipb.IngestResponse:
         """Destination side (ReceivePredicate): records flow through the
@@ -936,11 +1068,27 @@ class WorkerService:
         if self.term > 0 and not self.is_leader:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           f"not leader (term {self.term})")
+        from ..storage import keys as K
+
         structural = False
         n = 0
         for data in msg.records:
             rec = decode_record(bytes(data))
             structural |= rec.get("t") in STRUCTURAL_RECORDS
+            t = rec.get("t")
+            if t == "m":
+                # a re-ingested tablet serves again (move-back); record
+                # arrival BEFORE apply so a racing read can't observe the
+                # data while the moved-away fence still refuses it
+                self._moved_away.discard(
+                    K.kind_attr_of(_key_bytes(rec["k"]))[1])
+            elif t == "c":
+                # install floor: the streamed copy has no history below
+                # its commit — reads under it must go elsewhere (typed)
+                for kraw in rec.get("k", ()):
+                    a = K.kind_attr_of(_key_bytes(kraw))[1]
+                    if int(rec["ts"]) > self._ingest_floor.get(a, 0):
+                        self._ingest_floor[a] = int(rec["ts"])
             self.store.ingest_record(rec)
             n += 1
         if structural:
@@ -955,6 +1103,9 @@ class WorkerService:
         if self.term > 0 and not self.is_leader:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                           f"not leader (term {self.term})")
+        # fence BEFORE the delete: a stale-routed read arriving mid-delete
+        # must refuse (typed) rather than serve the half-deleted tablet
+        self._moved_away.add(msg.attr)
         self.store.delete_predicate(msg.attr)
         with self._lock:
             self._assembler.invalidate()
@@ -991,6 +1142,8 @@ class WorkerService:
             "DeletePredicate": u(self.delete_predicate,
                                  ipb.DeletePredicateRequest,
                                  ipb.DeletePredicateResponse),
+            "TabletDelta": u(self.tablet_delta, ipb.TabletDeltaRequest,
+                             ipb.TabletDeltaResponse),
         })
 
 
@@ -1094,6 +1247,10 @@ class RemoteWorker:
             f"/{SERVICE}/DeletePredicate",
             request_serializer=ipb.DeletePredicateRequest.SerializeToString,
             response_deserializer=ipb.DeletePredicateResponse.FromString)
+        self._tablet_delta = self.channel.unary_unary(
+            f"/{SERVICE}/TabletDelta",
+            request_serializer=ipb.TabletDeltaRequest.SerializeToString,
+            response_deserializer=ipb.TabletDeltaResponse.FromString)
 
     def append(self, term: int, index: int, data: bytes,
                leader_addr: str = "",
@@ -1150,15 +1307,23 @@ class RemoteWorker:
     def delete_predicate(self, attr: str) -> None:
         self._delete_pred(ipb.DeletePredicateRequest(attr=attr))
 
+    def tablet_delta(self, attr: str, since_ts: int, read_ts: int,
+                     start_ts: int) -> "ipb.TabletDeltaResponse":
+        return self._tablet_delta(ipb.TabletDeltaRequest(
+            attr=attr, since_ts=since_ts, read_ts=read_ts,
+            start_ts=start_ts))
+
     def process_task(self, q: TaskQuery, read_ts: int,
-                     min_applied: int = 0) -> TaskResult:
+                     min_applied: int = 0,
+                     replica_read: bool = False) -> TaskResult:
         """ServeTask with span AND deadline propagation: the caller's
         remaining budget ships as invocation metadata (the server bounds
         its own waits by it) and doubles as the gRPC per-call timeout, so
         a blackholed peer costs exactly the remaining budget, never an
         unbounded wait."""
         faults.fire("rpc.send")
-        msg = encode_task(q, read_ts, min_applied)
+        msg = encode_task(q, read_ts, min_applied,
+                          replica_read=replica_read)
         md = []
         timeout = None
         ddl = dl.to_metadata()
@@ -1369,12 +1534,13 @@ class HedgedReplicas:
                 and e.code() == grpc.StatusCode.FAILED_PRECONDITION)
 
     def _call(self, idx: int, q, read_ts: int,
-              min_applied: int) -> TaskResult:
+              min_applied: int, replica_read: bool = False) -> TaskResult:
         """One replica attempt, feeding its breaker with the outcome and
         latency (the hedger's own signals)."""
         t0 = time.monotonic()
         try:
-            res = self.workers[idx].process_task(q, read_ts, min_applied)
+            res = self.workers[idx].process_task(q, read_ts, min_applied,
+                                                 replica_read=replica_read)
         except Exception as e:
             self._record(idx, False, e=e)
             raise
@@ -1390,7 +1556,17 @@ class HedgedReplicas:
         return self._call(idx, q, read_ts, 0)
 
     def process_task(self, q: TaskQuery, read_ts: int,
-                     min_applied: int = 0) -> TaskResult:
+                     min_applied: int = 0,
+                     replica_read: bool = False) -> TaskResult:
+        if replica_read:
+            # tablet-replica read (coord/placement.py): every freshness
+            # decision is the HOLDER's (behind/ahead/dropped gates in
+            # serve_task). No floor-stripping retry and no leader-only
+            # fallback — a refusal here must bubble to the dispatcher,
+            # whose fallback is the tablet's PRIMARY group, the only
+            # party allowed to serve without the replica gates.
+            return self._call(self._order()[0], q, read_ts, min_applied,
+                              replica_read=True)
         order = self._order()
         if len(order) == 1:
             try:
@@ -1500,7 +1676,9 @@ class NetworkDispatcher:
     def __init__(self, zero, local_group: int, local_snap_fn,
                  remotes: dict[int, RemoteWorker], schema,
                  pred_floors: dict[str, int] | None = None,
-                 cache=None, gate=None) -> None:
+                 cache=None, gate=None,
+                 tablet_replicas: dict[str, list[int]] | None = None,
+                 metrics=None, rr_counter=None) -> None:
         self.zero = zero
         self.local_group = local_group
         self.local_snap_fn = local_snap_fn     # read_ts -> GraphSnapshot
@@ -1509,6 +1687,23 @@ class NetworkDispatcher:
         # per-tablet commit floors (Zero oracle): hedged replica reads wait
         # for (or refuse below) this applied watermark
         self.pred_floors = pred_floors or {}
+        # read-only tablet replicas (coord/placement.py): attr -> holder
+        # groups. Reads spread round-robin across owner + holders; any
+        # holder refusal (behind / ahead / dropped — FAILED_PRECONDITION)
+        # or transport failure collapses back to the primary. Requires a
+        # known commit floor: with floor 0 (cold cluster / Zero restart)
+        # only the owner is provably current, so holders are skipped.
+        self.tablet_replicas = tablet_replicas or {}
+        self.metrics = metrics
+        # replica spread cursor: callers that build a dispatcher PER
+        # REQUEST (ClusterClient) pass a shared itertools.count so the
+        # rotation continues across requests — a per-dispatcher cursor
+        # would pin every request's first task to the owner
+        import itertools
+
+        self._rr = rr_counter if rr_counter is not None \
+            else itertools.count()
+        self._rr_lock = threading.Lock()
         # client-side task cache + dispatch gate over the fan-out: k-hop
         # queries replaying the same shape skip the wire entirely, and
         # concurrent identical tasks share one in-flight RPC. Keyed on
@@ -1537,14 +1732,49 @@ class NetworkDispatcher:
         group = self.zero.tablets().get(attr)
         if group is None or group == self.local_group:
             return process_task(self.local_snap_fn(read_ts), q, self.schema)
+        floor = self.pred_floors.get(attr, 0)
+        holder = self._pick_replica(attr, group, floor)
+        if holder is not None:
+            hr = self.remotes.get(holder)
+            try:
+                res = hr.process_task(q, read_ts, min_applied=floor,
+                                      replica_read=True)
+                if self.metrics is not None:
+                    self.metrics.counter("dgraph_replica_reads_total").inc()
+                return res
+            except Exception:
+                # behind/ahead/dropped refusals AND transport failures all
+                # collapse to the primary — replica reads are an
+                # optimization, never a correctness dependency
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "dgraph_replica_fallbacks_total").inc()
         rw = self.remotes.get(group)
         if rw is None:
             # a silent local fallback would answer with empty results for
             # data that exists — surface the unreachable group instead
             raise RuntimeError(
                 f"no connection to group {group} serving {attr!r}")
-        return rw.process_task(q, read_ts,
-                               min_applied=self.pred_floors.get(attr, 0))
+        return rw.process_task(q, read_ts, min_applied=floor)
+
+    def _pick_replica(self, attr: str, owner: int,
+                      floor: int) -> int | None:
+        """Round-robin slot for this read over [owner] + holder groups;
+        None = serve from the owner (no holders, unknown floor, or the
+        cursor landed on the owner's slot)."""
+        if floor <= 0:
+            return None
+        holders = self.tablet_replicas.get(attr)
+        if not holders:
+            return None
+        cands = [h for h in holders
+                 if h != owner and h in self.remotes]
+        if not cands:
+            return None
+        with self._rr_lock:
+            slot = next(self._rr)
+        pick = slot % (len(cands) + 1)         # owner owns one slot
+        return None if pick == 0 else cands[pick - 1]
 
     def sort_over_network(self, attr: str, uids, desc: bool, lang: str,
                           read_ts: int, need: int = 0):

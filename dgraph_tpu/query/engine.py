@@ -91,7 +91,7 @@ class Executor:
                  dispatch=None, cache=None, gate=None,
                  edge_limit: int | None = None,
                  plan=None, explain: dict | None = None,
-                 mesh=None, batcher=None):
+                 mesh=None, batcher=None, on_task=None):
         self.snap = snap
         self.schema = schema
         # mesh deployment mode (parallel/mesh_exec.MeshExecutor): pure
@@ -155,6 +155,23 @@ class Executor:
         else:
             self._dispatch = raw
         self._dispatch = self._traced_dispatch(self._dispatch)
+        if on_task is not None:
+            # per-tablet load accounting seam (coord/placement.py): the
+            # hook sees every dispatched task — cache tiers and gate run
+            # inside, so the elapsed time is what the caller experienced
+            inner_hooked = self._dispatch
+
+            def _counted(q, _inner=inner_hooked, _hook=on_task):
+                import time as _time
+
+                t0 = _time.monotonic()
+                res = _inner(q)
+                try:
+                    _hook(q, res, _time.monotonic() - t0)
+                except Exception:
+                    pass          # accounting must never fail a query
+                return res
+            self._dispatch = _counted
 
     @staticmethod
     def _traced_dispatch(inner):

@@ -54,6 +54,10 @@ POINTS = (
     "disk.wal_write",       # store WAL append/commit records
     "disk.spill",           # out-of-core ingest spill-run writes
     "device.dispatch",      # device-dispatch gate critical section
+    # placement subsystem (coord/placement.py)
+    "zero.rebalance_decide",  # controller tick, before acting on a pick
+    "move.chunk_ship",      # per-chunk in the tablet move/replica stream
+    "replica.delta_ship",   # replica freshness delta ship
 )
 
 

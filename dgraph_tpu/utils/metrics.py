@@ -120,13 +120,19 @@ class Meter:
 class KeyedGauge:
     """Per-key integer gauges under one metric name (Prometheus labeled
     gauge shape) — per-predicate overlay depth, per-tablet sizes. Zero
-    values drop their key so an idle predicate doesn't grow the map."""
+    values drop their key so an idle predicate doesn't grow the map.
 
-    __slots__ = ("_vals", "_lock")
+    `labels` names multi-dimensional keys: when set, keys are the label
+    VALUES joined with '|' (e.g. labels=("pred", "group"), key
+    "follows|2") and obs/prom.py renders them as separate Prometheus
+    labels instead of the default key="..."."""
 
-    def __init__(self) -> None:
+    __slots__ = ("_vals", "_lock", "labels")
+
+    def __init__(self, labels: tuple[str, ...] | None = None) -> None:
         self._vals: dict[str, int] = {}
         self._lock = threading.Lock()
+        self.labels = labels
 
     def set(self, key: str, v: int) -> None:
         with self._lock:
@@ -230,10 +236,30 @@ class Registry:
                      "dgraph_vector_searches_total",
                      "dgraph_vector_ivf_probes_total",
                      "dgraph_vector_fused_pipelines_total",
-                     "dgraph_vector_mesh_dispatches_total"):
+                     "dgraph_vector_mesh_dispatches_total",
+                     # self-driving shard placement (coord/placement.py;
+                     # ISSUE 10): controller ticks, actions, replica
+                     # freshness ships, and the replica read/fallback
+                     # counters on the query router
+                     "dgraph_placement_ticks_total",
+                     "dgraph_placement_moves_total",
+                     "dgraph_placement_replicas_added_total",
+                     "dgraph_placement_replicas_dropped_total",
+                     "dgraph_placement_delta_ships_total",
+                     "dgraph_placement_resyncs_total",
+                     "dgraph_placement_cooldown_skips_total",
+                     "dgraph_placement_errors_total",
+                     "dgraph_replica_reads_total",
+                     "dgraph_replica_fallbacks_total"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
+        # per-tablet live load counters (the placement controller's
+        # inputs): key "<pred>|<group>|<stat>" renders as labeled series
+        # dgraph_tablet_load{pred=,group=,stat=} with stat one of
+        # reads/writes/bytes/serve_ms
+        self.keyed_gauges["dgraph_tablet_load"] = KeyedGauge(
+            labels=("pred", "group", "stat"))
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s", "dgraph_compaction_s",
                      "dgraph_planner_est_error_log2"):
@@ -251,9 +277,10 @@ class Registry:
         with self._lock:
             return self.meters.setdefault(name, Meter())
 
-    def keyed(self, name: str) -> KeyedGauge:
+    def keyed(self, name: str,
+              labels: tuple[str, ...] | None = None) -> KeyedGauge:
         with self._lock:
-            return self.keyed_gauges.setdefault(name, KeyedGauge())
+            return self.keyed_gauges.setdefault(name, KeyedGauge(labels))
 
     def to_dict(self) -> dict:
         """expvar-style dump for /debug/vars."""
