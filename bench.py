@@ -46,6 +46,11 @@ loops; the reference's own inner loops are scalar Go over bp128 blocks).
     queries (unique text per request — no cache tier can hide the win)
     replayed at concurrency 1/8/32/64, batching on vs off, with batch
     occupancy and a byte-identity gate. Writes BATCH_r09.json.
+  * `residency` — the HBM working-set round (ISSUE 11): a graph ~10x an
+    artificial device budget, mixed device-path battery QPS tiered vs
+    fully-resident (gated within 2x), byte-identity throughout,
+    admission/eviction churn and prefetch hit rate. Writes
+    RESIDENCY_r11.json.
 
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "band", "query_path", "query_configs", "throughput", "freshness",
@@ -1158,6 +1163,103 @@ def bench_batch(n_subjects=4000, follows=6, pool=128, reps=3,
     return out
 
 
+RESIDENCY_ARTIFACT = "RESIDENCY_r11.json"
+
+
+def bench_residency(n_preds=16, n_subj=256, fanout=16, rounds=4):
+    """Round-16 HBM working-set battery (ISSUE 11): n_preds uid tablets
+    of ~equal device footprint; the TIERED node gets a device budget of
+    total/10 (bigger than one tablet, 10x smaller than the graph) while
+    the RESIDENT node runs unbounded. Both replay the same mixed
+    device-path battery (caches off, host cutover forced low so every
+    expand is a device-tier step): byte-identity is asserted per query,
+    warm QPS is measured on both, and the tiered node reports its
+    admission/eviction churn + prefetch hit rate. Gate (smoke): tiered
+    QPS within 2x of fully-resident. Writes RESIDENCY_r11.json."""
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.query import task as taskmod
+    from dgraph_tpu.storage import residency as resmod
+
+    preds = [f"p{i:02d}" for i in range(n_preds)]
+    queries = [f"{{ q(func: has({p})) {{ {p} {{ uid }} }} }}"
+               for p in preds]
+
+    def build():
+        n = Node(task_cache_mb=0, result_cache_mb=0, planner=False)
+        n.alter(schema_text="\n".join(f"{p}: [uid] ." for p in preds))
+        rng = np.random.default_rng(16)
+        nq = []
+        for p in preds:
+            for i in range(1, n_subj + 1):
+                for t in rng.choice(n_subj, fanout, replace=False) + 1:
+                    nq.append(f"<{i:#x}> <{p}> <{int(t):#x}> .")
+        n.mutate(set_nquads="\n".join(nq), commit_now=True)
+        return n
+
+    old_cut = taskmod.HOST_EXPAND_MAX
+    taskmod.HOST_EXPAND_MAX = 64          # every battery expand = device
+    resident = build()
+    tiered = build()
+    try:
+        total = sum(resmod.pred_host_nbytes(pd)
+                    for pd in tiered.snapshot().preds.values())
+        budget = total // 10
+        tiered.residency.budget = budget
+
+        def replay(node):
+            out = []
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                for q in queries:
+                    out.append(json.dumps(node.query(q)[0],
+                                          sort_keys=True))
+            dt = time.perf_counter() - t0
+            return out, (rounds * len(queries)) / dt
+
+        # warm-up (compiles) then the timed sweeps, resident first
+        replay(resident)
+        replay(tiered)
+        want, qps_resident = replay(resident)
+        got, qps_tiered = replay(tiered)
+        identical = want == got
+        m = tiered.residency.metrics
+        c = lambda n: m.counter(n).value
+        pf_hits = c("dgraph_residency_prefetch_hits_total")
+        pf_waste = c("dgraph_residency_prefetch_wasted_total")
+        out = {
+            "graph_device_bytes": int(total),
+            "device_budget_bytes": int(budget),
+            "budget_ratio": round(total / max(budget, 1), 2),
+            "qps_fully_resident": round(qps_resident, 1),
+            "qps_tiered": round(qps_tiered, 1),
+            "tiered_vs_resident": round(qps_tiered / qps_resident, 3),
+            "within_2x": qps_tiered * 2.0 >= qps_resident,
+            "byte_identity_pass": identical,
+            "admissions": c("dgraph_residency_admissions_total"),
+            "evictions": c("dgraph_residency_evictions_total"),
+            "thrash": c("dgraph_residency_thrash_total"),
+            "cold_serves": c("dgraph_residency_cold_serves_total"),
+            "prefetch_hits": pf_hits,
+            "prefetch_wasted": pf_waste,
+            "prefetch_hit_rate": round(
+                pf_hits / max(pf_hits + pf_waste, 1), 3),
+            "hbm_bytes_at_rest": tiered.residency.usage()["hbm_bytes"],
+        }
+        if (n_preds, n_subj, fanout) == (16, 256, 16):
+            import os
+
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    RESIDENCY_ARTIFACT), "w") as f:
+                json.dump(out, f, indent=1, sort_keys=True)
+                f.write("\n")
+        return out
+    finally:
+        taskmod.HOST_EXPAND_MAX = old_cut
+        resident.close()
+        tiered.close()
+
+
 SKEW_ARTIFACT = "SKEW_r10.json"
 
 
@@ -1444,6 +1546,10 @@ def main():
         skew = bench_skew()
     except Exception as e:  # placement battery must not sink it either
         skew = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        residency = bench_residency()
+    except Exception as e:  # working-set battery must not sink it either
+        residency = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -1464,6 +1570,7 @@ def main():
         "vector": vector,
         "batch": batch,
         "skew": skew,
+        "residency": residency,
     }))
 
 

@@ -47,7 +47,9 @@ def cmd_serve(args) -> int:
                 default_timeout_ms=args.default_timeout_ms,
                 vector_nprobe=args.vector_nprobe,
                 vector_centroids=args.vector_centroids,
-                vector_ivf_min_rows=args.vector_ivf_min_rows)
+                vector_ivf_min_rows=args.vector_ivf_min_rows,
+                device_budget_mb=args.device_budget_mb,
+                residency_pin=args.residency_pin)
     if args.faults or args.faults_seed is not None:
         from dgraph_tpu.utils import faults as faults_mod
 
@@ -457,6 +459,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--vector_ivf_min_rows", type=int, default=0,
                     help="embedding tablets below this row count stay "
                          "brute-force exact (0 = default 4096)")
+    sp.add_argument("--device_budget_mb", type=int, default=0,
+                    help="device (HBM) byte budget for the working-set "
+                         "manager; tablets admit/evict by load score and "
+                         "graphs larger than the budget serve through the "
+                         "host tiers (0 = unbounded)")
+    sp.add_argument("--residency_pin", default="",
+                    help="comma-separated predicates pinned in the HBM "
+                         "tier (never evicted by the working-set manager)")
     sp.add_argument("--memory_mb", type=int, default=0,
                     help="posting-list memory budget; periodic rollup + "
                          "cache drop keeps usage under it (0 = unbounded)")

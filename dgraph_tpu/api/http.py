@@ -248,6 +248,11 @@ def _serving_metrics(node: Node) -> dict:
             "degraded_reads": c("dgraph_degraded_reads_total"),
             "faults_injected": c("dgraph_fault_injected_total"),
         },
+        # HBM working-set manager (ISSUE 11, storage/residency.py): tier
+        # byte totals (hbm/warm/cold), admission/eviction/prefetch/thrash
+        # counters, pinned tablets, and the currently-resident buffer
+        # groups — the device-memory runbook's readout
+        "residency": node.residency.debug_snapshot(),
         # per-tablet load counters (coord/placement.py TabletLoadBook):
         # the placement controller's scoring inputs — reads/writes/result
         # bytes/serve seconds per predicate — inspectable here and as the

@@ -98,7 +98,9 @@ class Node:
                  vector_ivf_min_rows: int = 0,
                  batching: bool = True,
                  batch_window_ms: float = 2.0,
-                 batch_max: int = 16) -> None:
+                 batch_max: int = 16,
+                 device_budget_mb: int = 0,
+                 residency_pin: str = "") -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -109,6 +111,23 @@ class Node:
         # checkpoint/ingest gauges (peak transient bytes etc.) land in this
         # node's registry — they show on /metrics next to the query tiers
         self.store.metrics = self.metrics
+        # HBM working-set manager (ISSUE 11, storage/residency.py): owns
+        # the node's device-byte budget and the HBM ↔ host ↔ paged tiers.
+        # Folded tablets attach at build_pred/stamp_pred (store.residency),
+        # device uploads admit against the budget (evicting colder tablets
+        # by the same rate×log2(size) score the placement controller
+        # uses), and COLD tablets (footprint > budget) serve through the
+        # host-cutover machinery. budget 0 = unbounded: accounting only —
+        # fully-resident traffic pays no admission/eviction work.
+        from dgraph_tpu.storage.residency import ResidencyManager
+
+        pins = residency_pin
+        if isinstance(pins, str):
+            pins = tuple(p.strip() for p in pins.split(",") if p.strip())
+        self.residency = ResidencyManager(
+            budget_bytes=int(device_budget_mb) << 20,
+            metrics=self.metrics, pins=tuple(pins))
+        self.store.residency = self.residency
         self.traces = metrics.TraceStore(fraction=trace_fraction,
                                          rng=trace_rng)
         # span tracing + device profiling (obs/otrace.py): root spans start
@@ -213,7 +232,8 @@ class Node:
 
             self.mesh_exec = MeshExecutor(
                 n_devices=None if mesh_devices < 0 else mesh_devices,
-                metrics=self.metrics, shard_min_edges=mesh_min_edges)
+                metrics=self.metrics, shard_min_edges=mesh_min_edges,
+                residency=self.residency)
         # per-tablet load counters (coord/placement.py TabletLoadBook):
         # every dispatched task and applied edge counts toward the
         # dgraph_tablet_load{pred,group,stat} series on /metrics and the
@@ -493,12 +513,15 @@ class Node:
         return dl.scope(ms / 1000.0 if ms and ms > 0 else None)
 
     def _count_task(self, tq, res, dt: float) -> None:
-        """Executor on_task hook: per-tablet read accounting."""
+        """Executor on_task hook: per-tablet read accounting — feeds BOTH
+        the placement controller's load book and the residency manager's
+        admission/eviction scores (the same rate×log2(size) signal)."""
         attr = tq.attr[1:] if tq.attr.startswith("~") else tq.attr
         out_bytes = 0.0
         if getattr(res, "dest_uids", None) is not None:
             out_bytes = 8.0 * len(res.dest_uids)
         self.tablet_book.record_read(attr, out_bytes=out_bytes, serve_s=dt)
+        self.residency.touch(attr)
 
     def query(self, q: str, variables: dict | None = None,
               start_ts: int | None = None,
@@ -606,6 +629,14 @@ class Node:
                         "filter_reorders": len(plan.and_order),
                         "sibling_reorders": len(plan.child_order),
                         "cutover_overrides": len(plan.cutover)})
+            if self.residency.enabled and not req.mutations:
+                # plan-driven prefetch (ISSUE 11): the plan's statically
+                # derivable predicate read set starts async warm->HBM
+                # uploads BEFORE dispatch, overlapping the transfer with
+                # the preceding host work / device step
+                pf_attrs = qcache.plan_attrs(req)
+                if pf_attrs:
+                    self.residency.prefetch(pf_attrs, snap)
             out = Executor(snap, self.store.schema,
                            cache=self.task_cache, gate=self.dispatch_gate,
                            edge_limit=edge_limit, plan=plan,
@@ -942,17 +973,36 @@ class Node:
         if overlay_bytes and stats["bytes"] + overlay_bytes > budget_bytes:
             compacted = self._assembler.compact(self._lock, force=True)
             overlay_bytes = self._assembler.overlay_bytes()
+        # device-byte accounting routes through the ResidencyManager
+        # (ISSUE 11 satellite): fold_bytes is the HOST footprint of every
+        # live folded PredData — CSR columns, value tables, token indexes,
+        # AND vector embedding matrices, which the old accounting never
+        # saw (a vector-heavy snapshot silently blew the budget). The
+        # manager also re-enforces its own device budget here.
+        fold_bytes = self.residency.host_bytes()
+        res_evicted = 0
+        if self.residency.enabled:
+            res_evicted = self.residency.evict_to(self.residency.budget)
         dropped_snaps = 0
-        if stats["bytes"] > budget_bytes:
+        if stats["bytes"] + fold_bytes > budget_bytes:
             with self._lock:
                 dropped_snaps = self._assembler.invalidate()
+            # dropped PredData frees its device buffers too (weakref
+            # entries unregister as the folds are collected); make any
+            # survivors' device bytes visible immediately. fold_bytes
+            # stays the MEASURED value — the number that triggered the
+            # drop, not the post-drop remainder.
+            self.residency.usage()
         self.metrics.counter("dgraph_memory_bytes").set(stats["bytes"])
         return {"bytes": stats["bytes"], "lists": stats["lists"],
                 "layers": stats["layers"], "rolled_up": rolled,
                 "dropped_caches": dropped_snaps,
                 "task_cache_evicted": cache_evicted,
                 "overlay_bytes": overlay_bytes,
-                "overlays_compacted": compacted}
+                "overlays_compacted": compacted,
+                "fold_bytes": fold_bytes,
+                "residency_evicted": res_evicted,
+                "residency": self.residency.usage()}
 
     # -- ops -----------------------------------------------------------------
 
@@ -966,4 +1016,5 @@ class Node:
     def close(self) -> None:
         self._rollup_stop.set()
         self.slow_log.close()
+        self.residency.close()
         self.store.close()

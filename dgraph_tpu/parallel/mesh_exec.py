@@ -116,13 +116,19 @@ class MeshExecutor:
     _SNAP_CACHE = 8         # placed-snapshot entries (identity-keyed)
 
     def __init__(self, mesh: Mesh | None = None, n_devices: int | None = None,
-                 metrics=None, shard_min_edges: int | None = None) -> None:
+                 metrics=None, shard_min_edges: int | None = None,
+                 residency=None) -> None:
         from dgraph_tpu.utils.metrics import Registry
 
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.metrics = metrics if metrics is not None else Registry()
         if shard_min_edges is not None:
             self.SHARD_MIN_EDGES = int(shard_min_edges)
+        # device working-set manager (storage/residency.py): placement
+        # defers to it — a tablet whose per-device row-shard would not
+        # fit the node's device budget stays on the host/replicated path
+        # instead of pinning every device's HBM
+        self.residency = residency
         # id(PredData) -> (PredData ref, placed PredData): the assembler
         # reuses PredData identity for clean predicates, so identity-keyed
         # placement keeps per-predicate cache tokens stable across commits
@@ -207,6 +213,12 @@ class MeshExecutor:
         if vi is None or vi.is_overlay or \
                 vi.n * vi.dim < self.SHARD_MIN_EDGES:
             return vi
+        if self.residency is not None and self.residency.enabled and \
+                vi.device_nbytes() // max(self.n_devices, 1) > \
+                self.residency.budget:
+            self.metrics.counter(
+                "dgraph_mesh_residency_deferred_total").inc()
+            return vi
         import copy
 
         placed = copy.copy(vi)
@@ -225,6 +237,15 @@ class MeshExecutor:
             return csr               # OverlayCSR etc.: host fallback
         if csr.num_edges < self.SHARD_MIN_EDGES:
             return csr               # small tablet: replicated
+        if self.residency is not None and self.residency.enabled and \
+                csr.host_nbytes() // max(self.n_devices, 1) > \
+                self.residency.budget:
+            # placement defers to the working-set manager: even one
+            # row-shard of this tablet would blow the per-device budget —
+            # keep it on the warm/cold host path (task._expand_csr)
+            self.metrics.counter(
+                "dgraph_mesh_residency_deferred_total").inc()
+            return csr
         sub, ptr, idx = csr.host_arrays()
         placed = DistPredCSR(sub, ptr, idx, self.mesh)
         placed.metrics = self.metrics

@@ -56,8 +56,12 @@ def distribute_snapshot(snap: GraphSnapshot, mesh: Mesh,
         csr = pd.csr
         rev = pd.rev_csr
         if csr is not None:
-            csr = DistPredCSR(csr.subjects, csr.indptr, csr.indices, sub)
+            # shard from the host fold — re-sharding must not force a
+            # single-device upload of the whole tablet first
+            s, p, i = csr.host_arrays()
+            csr = DistPredCSR(s, p, i, sub)
         if rev is not None:
-            rev = DistPredCSR(rev.subjects, rev.indptr, rev.indices, sub)
+            s, p, i = rev.host_arrays()
+            rev = DistPredCSR(s, p, i, sub)
         out.preds[attr] = replace(pd, csr=csr, rev_csr=rev)
     return out

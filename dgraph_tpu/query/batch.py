@@ -139,6 +139,13 @@ def _classify_expand(snap, schema, q):
     rows, _indptr_h, deg, need = taskmod._frontier_degrees(csr, frontier)
     if need <= (q.cutover or taskmod.HOST_EXPAND_MAX):
         return None, "host_path", None
+    # residency tier consult (storage/residency.py): a COLD tablet must
+    # not be uploaded by a batched kernel any more than by a solo one —
+    # the solo path serves it through the host gather (and counts the
+    # cold serve there; this is a consult, not a serve)
+    pf = getattr(csr, "prefer_host", None)
+    if pf is not None and pf():
+        return None, "cold_tier", None
     # the reverse-resolved task process_task would execute (its rewrite)
     cq = taskmod.TaskQuery(attr, frontier, q.func, reverse, q.lang,
                            q.facet_keys, q.first, q.cutover)
@@ -174,6 +181,9 @@ def _classify_vector(snap, schema, q):
         return None, "vector_variant", None
     if vi.n * vi.dim <= vecmod.HOST_SCAN_MAX:
         return None, "host_path", None
+    if vi.prefer_host():
+        # cold vector tablet: vecindex.search serves the exact host scan
+        return None, "vector_cold", None
     kprime = vops.k_capacity(k, vops.row_capacity(vi.n))
     # kprime is a static kernel argument — grouping by it means one batch
     # is exactly one compiled program (different final k values still
@@ -380,7 +390,8 @@ class DeviceBatcher:
     _SOLO_KLASS = {
         "root_func": "host", "no_pred": "host", "value_pred": "host",
         "empty_csr": "host", "empty_frontier": "host", "host_path": "host",
-        "vector_solo": "host",
+        "vector_solo": "host", "cold_tier": "host",
+        "vector_cold": "host",
     }
 
     def dispatch(self, snap, schema, q, solo: Callable):
@@ -454,14 +465,33 @@ class DeviceBatcher:
                                     jnp.asarray(rows_cat), out_cap=tot)
             return np.asarray(res.targets)
 
-        with otrace.span("device_kernel", kernel="batch.expand",
-                         need=total, batch=nbatch) as sp:
-            targets = self._gate_run(kernel, "expand")
-            if sp:
-                sp.set(edges=total,
-                       transfer_h2d_bytes=int(rows_cat.nbytes),
-                       transfer_d2h_bytes=int(targets.nbytes))
-        targets = targets[:total].astype(np.int64)
+        from dgraph_tpu.utils.faults import FaultError
+
+        try:
+            with otrace.span("device_kernel", kernel="batch.expand",
+                             need=total, batch=nbatch) as sp:
+                targets = self._gate_run(kernel, "expand")
+                if sp:
+                    sp.set(edges=total,
+                           transfer_h2d_bytes=int(rows_cat.nbytes),
+                           transfer_d2h_bytes=int(targets.nbytes))
+            targets = targets[:total].astype(np.int64)
+        except FaultError:
+            # injected residency.h2d_upload fault at the batched upload
+            # seam: the host gather is byte-identical per slot (the same
+            # fallback the solo path performs), so the batch members get
+            # correct results instead of a shared typed failure
+            taskmod._upload_fault_fallback(csr)
+            _subs_h, indptr_h, indices_h = csr.host_arrays()
+            parts = []
+            for e in entries:
+                w = e.work
+                offs = np.zeros(len(w.frontier) + 1, dtype=np.int64)
+                np.cumsum(w.deg, out=offs[1:])
+                parts.append(taskmod._gather_rows_host(
+                    indptr_h, indices_h, w.rows, w.deg, offs))
+            targets = np.concatenate(parts) if parts \
+                else np.zeros(0, np.int64)
         base = 0
         for e in entries:
             w = e.work
@@ -510,12 +540,31 @@ class DeviceBatcher:
                 jnp.asarray(dr), k=kprime, metric=vi.metric, block=block)
             return np.asarray(nd), np.asarray(rows)
 
-        with otrace.span("device_kernel", kernel="batch.vector_topk",
-                         rows=int(vi.n), k=kprime, batch=nbatch) as sp:
-            nd_h, rows_h = self._gate_run(kernel, "vector")
-            if sp:
-                sp.set(transfer_h2d_bytes=int(Q.nbytes),
-                       transfer_d2h_bytes=int(nd_h.nbytes + rows_h.nbytes))
+        from dgraph_tpu.utils.faults import FaultError
+
+        try:
+            with otrace.span("device_kernel", kernel="batch.vector_topk",
+                             rows=int(vi.n), k=kprime, batch=nbatch) as sp:
+                nd_h, rows_h = self._gate_run(kernel, "vector")
+                if sp:
+                    sp.set(transfer_h2d_bytes=int(Q.nbytes),
+                           transfer_d2h_bytes=int(
+                               nd_h.nbytes + rows_h.nbytes))
+        except FaultError:
+            # injected residency.h2d_upload fault: each member answers
+            # through vecindex.search, whose own fallback serves the
+            # byte-identical host float64 scan
+            for e in entries:
+                w = e.work
+                try:
+                    uids, dists = vx.search(vi, w.vec, w.k,
+                                            metrics=w.metrics)
+                    res = taskmod.TaskResult()
+                    taskmod.set_similar_result(res, uids, dists)
+                    e.result = res
+                except BaseException as err:
+                    e.error = err
+            return
         for i, e in enumerate(entries):
             w = e.work
             try:

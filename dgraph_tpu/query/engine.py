@@ -528,6 +528,12 @@ class Executor:
         if cpd is None or not isinstance(cpd.csr, PredCSR) or \
                 cpd.csr.num_edges == 0:
             return None
+        # residency tier consult: a COLD vector matrix or expansion CSR
+        # (device footprint > budget, storage/residency.py) must not ride
+        # the fused device program — the classic stepped path serves it
+        # through the host-cutover machinery, byte-identically
+        if vi.prefer_host() or cpd.csr.prefer_host():
+            return None
         return vi, cgq, cpd.csr
 
     def _try_vector_fused(self, sg: SubGraph) -> bool:
@@ -558,26 +564,34 @@ class Executor:
         kprime = vops.k_capacity(k, vops.row_capacity(vi.n))
         ecap = 1 << max(int(np.ceil(np.log2(
             min(csr.num_edges, kprime * max(csr.max_degree(), 1)) + 1))), 4)
-        mat, norms, subs_dev = vi.device()
-        block = min(int(mat.shape[0]), max(vops.BLOCK_ROWS, kprime))
-        mcap = 8
-        dr = jnp.full((mcap,), int(mat.shape[0]), jnp.int32)
-        with otrace.span("device_kernel", kernel="vector.ann_expand",
-                         rows=int(vi.n), k=kprime, ecap=ecap) as sp:
-            nd, uids, res = self.gated(lambda: vops.ann_expand(
-                mat, norms, jnp.asarray(vec), jnp.int32(vi.n), dr,
-                subs_dev, csr.subjects, csr.indptr, csr.indices,
-                k=kprime, metric=vi.metric, block=block, ecap=ecap),
-                klass="vector")
-            nd_h = np.asarray(nd)
-            uids_h = np.asarray(uids).astype(np.int64)
-            counts_h = np.asarray(res.counts)[:kprime]
-            targets_h = np.asarray(res.targets)
-            if sp:
-                sp.set(edges=int(res.total),
-                       transfer_d2h_bytes=int(
-                           nd_h.nbytes + uids_h.nbytes + counts_h.nbytes
-                           + targets_h.nbytes))
+        from dgraph_tpu.utils.faults import FaultError
+
+        try:
+            mat, norms, subs_dev = vi.device()
+            block = min(int(mat.shape[0]), max(vops.BLOCK_ROWS, kprime))
+            mcap = 8
+            dr = jnp.full((mcap,), int(mat.shape[0]), jnp.int32)
+            with otrace.span("device_kernel", kernel="vector.ann_expand",
+                             rows=int(vi.n), k=kprime, ecap=ecap) as sp:
+                nd, uids, res = self.gated(lambda: vops.ann_expand(
+                    mat, norms, jnp.asarray(vec), jnp.int32(vi.n), dr,
+                    subs_dev, csr.subjects, csr.indptr, csr.indices,
+                    k=kprime, metric=vi.metric, block=block, ecap=ecap),
+                    klass="vector")
+                nd_h = np.asarray(nd)
+                uids_h = np.asarray(uids).astype(np.int64)
+                counts_h = np.asarray(res.counts)[:kprime]
+                targets_h = np.asarray(res.targets)
+                if sp:
+                    sp.set(edges=int(res.total),
+                           transfer_d2h_bytes=int(
+                               nd_h.nbytes + uids_h.nbytes
+                               + counts_h.nbytes + targets_h.nbytes))
+        except FaultError:
+            # injected residency.h2d_upload fault before any result state
+            # was written: the classic stepped path (which falls back to
+            # host scans itself) serves the query byte-identically
+            return False
         ok = nd_h > -np.inf
         cand_uids = uids_h[ok]
         if len(cand_uids) == 0:

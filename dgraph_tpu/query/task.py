@@ -129,6 +129,39 @@ def _gather_rows_host(indptr_h: np.ndarray, indices_h: np.ndarray,
     return indices_h[pos].astype(np.int64)
 
 
+def _tier_prefer_host(csr) -> bool:
+    """Residency tier consult (storage/residency.py): True when the
+    tablet is COLD — its device footprint exceeds the node's whole device
+    budget — so the expand must take the host-mirror gather regardless of
+    frontier size. Unmanaged tablets (no ResidencyManager attached) never
+    prefer host: exactly the pre-residency behavior.
+
+    This helper sits at the SERVE sites (expand / overlay / index
+    union), so a True here counts one cold serve — consult-only callers
+    (fused-shape checks) use owner.prefer_host() directly."""
+    f = getattr(csr, "prefer_host", None)
+    if f is None:
+        return False
+    try:
+        if not f():
+            return False
+    except Exception:
+        return False
+    mgr = getattr(csr, "_res", None)
+    if mgr is not None:
+        mgr.note_cold_serve()
+    return True
+
+
+def _upload_fault_fallback(csr) -> None:
+    """An injected residency.h2d_upload fault surfaced mid-expand: count
+    it and let the caller serve the byte-identical host gather."""
+    mgr = getattr(csr, "_res", None)
+    if mgr is not None:
+        mgr.metrics.counter(
+            "dgraph_residency_host_fallbacks_total").inc()
+
+
 def _expand_overlay(ov, uids: np.ndarray,
                     cutover: int = 0) -> tuple[list[np.ndarray], int]:
     """Merge-on-read expand over an OverlayCSR (storage/delta.py): gather
@@ -145,27 +178,39 @@ def _expand_overlay(ov, uids: np.ndarray,
     base = ov.base
     if base is None or need_base == 0:
         base_targets = np.zeros(0, np.int64)
-    elif need_base <= (cutover or HOST_EXPAND_MAX):
+    elif need_base <= (cutover or HOST_EXPAND_MAX) \
+            or _tier_prefer_host(base):
         _, indptr_h, indices_h = base.host_arrays()
         base_targets = _gather_rows_host(indptr_h, indices_h, rb, deg_b,
                                          offs)
     else:
+        from dgraph_tpu.utils.faults import FaultError
+
         cap = 1 << max(int(np.ceil(np.log2(need_base + 1))), 4)
-        with otrace.span("device_kernel", kernel="csr.expand_masked",
-                         need=need_base,
-                         cutover=int(cutover or HOST_EXPAND_MAX)) as sp:
-            res = csrops.expand_masked(base.indptr, base.indices,
-                                       jnp.asarray(rb), ro >= 0, out_cap=cap)
-            if sp:
-                # fence so the kernel's wall time lands in THIS span, not
-                # wherever the lazy value is first read
-                res.targets.block_until_ready()
-            targets_dev = np.asarray(res.targets)   # one D2H, shared below
-            if sp:
-                sp.set(edges=need_base,
-                       transfer_h2d_bytes=int(rb.nbytes),
-                       transfer_d2h_bytes=int(targets_dev.nbytes))
-            base_targets = targets_dev[:need_base].astype(np.int64)
+        try:
+            with otrace.span("device_kernel", kernel="csr.expand_masked",
+                             need=need_base,
+                             cutover=int(cutover or HOST_EXPAND_MAX)) as sp:
+                res = csrops.expand_masked(base.indptr, base.indices,
+                                           jnp.asarray(rb), ro >= 0,
+                                           out_cap=cap)
+                if sp:
+                    # fence so the kernel's wall time lands in THIS span,
+                    # not wherever the lazy value is first read
+                    res.targets.block_until_ready()
+                targets_dev = np.asarray(res.targets)  # one D2H, shared
+                if sp:
+                    sp.set(edges=need_base,
+                           transfer_h2d_bytes=int(rb.nbytes),
+                           transfer_d2h_bytes=int(targets_dev.nbytes))
+                base_targets = targets_dev[:need_base].astype(np.int64)
+        except FaultError:
+            # injected residency.h2d_upload fault: the host gather is
+            # byte-identical by the size-adaptive-strategy contract
+            _upload_fault_fallback(base)
+            _, indptr_h, indices_h = base.host_arrays()
+            base_targets = _gather_rows_host(indptr_h, indices_h, rb,
+                                             deg_b, offs)
     matrix = [base_targets[offs[i]: offs[i + 1]] for i in range(len(uids))]
     for i in np.flatnonzero(ro >= 0).tolist():
         matrix[i] = ov.delta.rows[ro[i]]
@@ -206,37 +251,52 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
         matrix, total = _expand_overlay(csr, uids, cutover)
     else:
         rows, indptr_h, deg, need = _frontier_degrees(csr, uids)
-        if need <= (cutover or HOST_EXPAND_MAX):
+        if need <= (cutover or HOST_EXPAND_MAX) or _tier_prefer_host(csr):
             # size-adaptive strategy (the TPU-era analog of the reference's
             # linear/gallop/binary ratio switch, algo/uidlist.go:147-155):
             # a small gather is microseconds on the cached host mirror but
             # pays fixed per-dispatch + sync latency on device — the device
-            # path wins only once the edge volume amortizes it
+            # path wins only once the edge volume amortizes it. COLD
+            # tablets (residency tier: footprint > device budget) take
+            # this path at ANY frontier size.
             matrix = _host_expand_matrix(indptr_h, csr.host_arrays()[2],
                                          rows, deg, uids, need, cutover)
             total = need
         else:
-            cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
-            with otrace.span("device_kernel", kernel="csr.expand",
-                             need=need,
-                             cutover=int(cutover or HOST_EXPAND_MAX)) as sp:
-                res = csrops.expand(csr.indptr, csr.indices,
-                                    jnp.asarray(rows), out_cap=cap)
-                total = int(res.total)   # device sync point
-                if total > cap:  # capacity retry (cannot happen: cap >= degrees)
+            from dgraph_tpu.utils.faults import FaultError
+
+            try:
+                cap = 1 << max(int(np.ceil(np.log2(need + 1))), 4)
+                with otrace.span("device_kernel", kernel="csr.expand",
+                                 need=need,
+                                 cutover=int(cutover
+                                             or HOST_EXPAND_MAX)) as sp:
                     res = csrops.expand(csr.indptr, csr.indices,
-                                        jnp.asarray(rows), out_cap=total)
-                targets_dev = np.asarray(res.targets)
-                if sp:
-                    sp.set(edges=total,
-                           transfer_h2d_bytes=int(rows.nbytes),
-                           transfer_d2h_bytes=int(targets_dev.nbytes))
-            targets = targets_dev[:total].astype(np.int64)
-            counts = np.asarray(res.counts)[: len(uids)]
-            offs = np.zeros(len(uids) + 1, dtype=np.int64)
-            np.cumsum(counts, out=offs[1:])
-            matrix = [targets[offs[i]: offs[i + 1]]
-                      for i in range(len(uids))]
+                                        jnp.asarray(rows), out_cap=cap)
+                    total = int(res.total)   # device sync point
+                    if total > cap:  # capacity retry (cannot happen)
+                        res = csrops.expand(csr.indptr, csr.indices,
+                                            jnp.asarray(rows),
+                                            out_cap=total)
+                    targets_dev = np.asarray(res.targets)
+                    if sp:
+                        sp.set(edges=total,
+                               transfer_h2d_bytes=int(rows.nbytes),
+                               transfer_d2h_bytes=int(targets_dev.nbytes))
+                targets = targets_dev[:total].astype(np.int64)
+                counts = np.asarray(res.counts)[: len(uids)]
+                offs = np.zeros(len(uids) + 1, dtype=np.int64)
+                np.cumsum(counts, out=offs[1:])
+                matrix = [targets[offs[i]: offs[i + 1]]
+                          for i in range(len(uids))]
+            except FaultError:
+                # injected residency.h2d_upload fault: the host gather
+                # is byte-identical, the read never fails
+                _upload_fault_fallback(csr)
+                matrix = _host_expand_matrix(
+                    indptr_h, csr.host_arrays()[2], rows, deg, uids,
+                    need, cutover)
+                total = need
     return apply_first(matrix, first), total
 
 
@@ -263,25 +323,38 @@ def _merge_matrix(matrix: list[np.ndarray]) -> np.ndarray:
 
 def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
     """Union of uid lists of the chosen token rows (size-adaptive: host
-    merge below the dispatch-amortization point, device merge above)."""
+    merge below the dispatch-amortization point, device merge above;
+    COLD-tier indexes — residency consult — stay on the host merge)."""
     if not rows:
         return np.zeros(0, np.int64)
     indptr_h, uids_h = ti.host_arrays()
     total = int(sum(indptr_h[r + 1] - indptr_h[r] for r in rows))
-    if total <= HOST_EXPAND_MAX:
+
+    def host_union():
         parts = [uids_h[indptr_h[r]: indptr_h[r + 1]] for r in rows]
         return np.unique(np.concatenate(parts)) if parts \
             else np.zeros(0, np.int64)
+
+    if total <= HOST_EXPAND_MAX or _tier_prefer_host(ti):
+        return host_union()
+    from dgraph_tpu.utils.faults import FaultError
+
     rows_arr = us.make_set(np.asarray(rows, dtype=np.int32), capacity=len(rows))
     cap = int(indptr_h[-1]) or 1
-    with otrace.span("device_kernel", kernel="csr.expand_dest",
-                     need=total, rows=len(rows)) as sp:
-        dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr,
-                                          out_cap=cap)
-        out = us.to_numpy(dest).astype(np.int64)
-        if sp:
-            sp.set(edges=int(len(out)), transfer_d2h_bytes=int(out.nbytes))
-    return out
+    try:
+        with otrace.span("device_kernel", kernel="csr.expand_dest",
+                         need=total, rows=len(rows)) as sp:
+            dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr,
+                                              out_cap=cap)
+            out = us.to_numpy(dest).astype(np.int64)
+            if sp:
+                sp.set(edges=int(len(out)),
+                       transfer_d2h_bytes=int(out.nbytes))
+        return out
+    except FaultError:
+        # injected residency.h2d_upload fault: host merge, byte-identical
+        _upload_fault_fallback(ti)
+        return host_union()
 
 
 def _index_uids_intersect_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:

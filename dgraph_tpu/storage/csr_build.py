@@ -42,51 +42,121 @@ from dgraph_tpu.utils.types import TypeID, Val, to_device_scalar
 MAX_DEVICE_UID = 2**31 - 2  # int32 space, sentinel-exclusive
 
 
-@dataclass
 class PredCSR:
-    """Adjacency of one predicate: row r = subjects[r] → indices[indptr[r]:indptr[r+1]]."""
+    """Adjacency of one predicate: row r = subjects[r] → indices[indptr[r]:indptr[r+1]].
 
-    subjects: jnp.ndarray   # int32[N] sorted
-    indptr: jnp.ndarray     # int32[N+1]
-    indices: jnp.ndarray    # int32[E] sorted within each row
-    _host: tuple | None = None   # lazy (subjects, indptr, indices) mirrors
-    _max_degree: int | None = None   # lazy per-snapshot constant
+    Residency refactor (storage/residency.py): the HOST numpy columns are
+    the authoritative fold; the device columns are a droppable cache that
+    uploads lazily on first kernel access and — when a ResidencyManager
+    is attached at fold time — admits against the node's device-byte
+    budget (evicting colder tablets) and can be demoted back to the warm
+    host tier without touching this object's identity. Identity stability
+    is the contract qcache per-predicate tokens, the DeviceBatcher's
+    same-CSR-object rule, and mesh placement caches all rely on."""
+
+    # residency owner protocol (set by ResidencyManager.adopt_pred)
+    _res = None
+    _res_attr = ""
+    _res_kind = "csr"
+
+    def __init__(self, subjects, indptr, indices) -> None:
+        self._subjects_h = np.asarray(subjects)   # int32[N] sorted
+        self._indptr_h = np.asarray(indptr)       # int32[N+1]
+        self._indices_h = np.asarray(indices)     # int32[E] sorted per row
+        self._dev: tuple | None = None            # droppable device cache
+        self._max_degree: int | None = None       # lazy per-snapshot const
 
     @property
     def num_subjects(self) -> int:
-        return int(self.subjects.shape[0])
+        return int(self._subjects_h.shape[0])
 
     @property
     def num_edges(self) -> int:
-        return int(self.indices.shape[0])
+        return int(self._indices_h.shape[0])
+
+    # -- device tier ----------------------------------------------------------
+
+    def device_arrays(self, prefetch: bool = False) -> tuple:
+        """(subjects, indptr, indices) on device — the HBM tier. Uploads
+        on first access through the residency seam (budget admission +
+        the residency.h2d_upload fault point) when managed."""
+        from dgraph_tpu.storage import residency as resmod
+
+        return resmod.ensure_device(
+            self, "_dev",
+            lambda: (jnp.asarray(self._subjects_h),
+                     jnp.asarray(self._indptr_h),
+                     jnp.asarray(self._indices_h)),
+            prefetch=prefetch)
+
+    @property
+    def subjects(self):
+        return self.device_arrays()[0]
+
+    @property
+    def indptr(self):
+        return self.device_arrays()[1]
+
+    @property
+    def indices(self):
+        return self.device_arrays()[2]
+
+    def device_resident(self) -> bool:
+        return self._dev is not None
+
+    def drop_device(self) -> None:
+        """Demote to the warm tier: free the device buffers, keep the
+        host fold. Kernels mid-flight keep their array references alive;
+        the next device access re-uploads byte-identical columns."""
+        self._dev = None
+
+    def device_nbytes(self) -> int:
+        return int(self._subjects_h.nbytes + self._indptr_h.nbytes
+                   + self._indices_h.nbytes)
+
+    def host_nbytes(self) -> int:
+        return self.device_nbytes()
+
+    def prefer_host(self) -> bool:
+        """Tier consult for the query layer: True = COLD (footprint
+        exceeds the whole device budget) — serve via the host-cutover
+        machinery instead of uploading."""
+        from dgraph_tpu.storage import residency as resmod
+
+        return resmod.prefer_host(self)
+
+    # -- host tier ------------------------------------------------------------
 
     def host_arrays(self) -> tuple:
-        """(subjects, indptr, indices) as numpy — cached: frontier→row
-        mapping, degree counting, and recurse edge-dedup run per expand and
-        must not re-fetch from device."""
-        if self._host is None:
-            self._host = (np.asarray(self.subjects), np.asarray(self.indptr),
-                          np.asarray(self.indices))
-        return self._host
+        """(subjects, indptr, indices) as numpy — the warm-tier truth:
+        frontier→row mapping, degree counting, and recurse edge-dedup run
+        per expand and never touch the device."""
+        return (self._subjects_h, self._indptr_h, self._indices_h)
 
     def max_degree(self) -> int:
         """Largest row length — cached: capacity sizing (the fused ANN
         pipeline's ecap) runs per query and must not rescan indptr."""
         if self._max_degree is None:
-            ptr = self.host_arrays()[1]
+            ptr = self._indptr_h
             self._max_degree = int(np.max(ptr[1:] - ptr[:-1])) \
                 if len(ptr) > 1 else 0
         return self._max_degree
 
 
-@dataclass
 class TokenIndex:
-    """token→uid CSR for one (predicate, tokenizer)."""
+    """token→uid CSR for one (predicate, tokenizer). Same host-truth +
+    droppable-device-cache shape as PredCSR (the residency tiers)."""
 
-    terms: list[bytes]      # sorted; host-side (binary-searched for ranges)
-    indptr: jnp.ndarray     # int32[T+1]
-    uids: jnp.ndarray       # int32[sum row lens], sorted per row
-    _host: tuple | None = None   # lazy (indptr, uids) int64 host mirrors
+    _res = None
+    _res_attr = ""
+    _res_kind = "index"
+
+    def __init__(self, terms: list[bytes], indptr, uids) -> None:
+        self.terms = terms      # sorted; host-side (binary-searched)
+        self._indptr_h = np.asarray(indptr)   # int32[T+1]
+        self._uids_h = np.asarray(uids)       # int32[sum lens], sorted/row
+        self._dev: tuple | None = None
+        self._host: tuple | None = None       # lazy (indptr, uids64)
 
     def term_row(self, term: bytes) -> int:
         import bisect
@@ -94,12 +164,46 @@ class TokenIndex:
         i = bisect.bisect_left(self.terms, term)
         return i if i < len(self.terms) and self.terms[i] == term else -1
 
+    def device_arrays(self, prefetch: bool = False) -> tuple:
+        from dgraph_tpu.storage import residency as resmod
+
+        return resmod.ensure_device(
+            self, "_dev",
+            lambda: (jnp.asarray(self._indptr_h),
+                     jnp.asarray(self._uids_h)),
+            prefetch=prefetch)
+
+    @property
+    def indptr(self):
+        return self.device_arrays()[0]
+
+    @property
+    def uids(self):
+        return self.device_arrays()[1]
+
+    def device_resident(self) -> bool:
+        return self._dev is not None
+
+    def drop_device(self) -> None:
+        self._dev = None
+
+    def device_nbytes(self) -> int:
+        return int(self._indptr_h.nbytes + self._uids_h.nbytes)
+
+    def host_nbytes(self) -> int:
+        return self.device_nbytes()
+
+    def prefer_host(self) -> bool:
+        from dgraph_tpu.storage import residency as resmod
+
+        return resmod.prefer_host(self)
+
     def host_arrays(self) -> tuple[np.ndarray, np.ndarray]:
-        """(indptr, uids) host mirrors, fetched from device once per
-        snapshot (index sorts / bucket walks are host-orchestrated)."""
+        """(indptr, uids int64) host mirrors (index sorts / bucket walks
+        are host-orchestrated and never touch the device)."""
         if self._host is None:
-            self._host = (np.asarray(self.indptr),
-                          np.asarray(self.uids).astype(np.int64))
+            self._host = (self._indptr_h,
+                          self._uids_h.astype(np.int64))
         return self._host
 
 
@@ -161,9 +265,9 @@ def _csr_from_rows(rows: list[tuple[int, np.ndarray]]) -> PredCSR | None:
     if len(indices) and indices.max() > MAX_DEVICE_UID:
         raise ValueError("object uid exceeds device uid space")
     return PredCSR(
-        jnp.asarray(subjects.astype(np.int32)),
-        jnp.asarray(indptr),
-        jnp.asarray(indices.astype(np.int32)),
+        subjects.astype(np.int32),
+        indptr,
+        indices.astype(np.int32),
     )
 
 
@@ -177,7 +281,7 @@ def _token_index(rows: list[tuple[bytes, np.ndarray]]) -> TokenIndex:
         uids = np.concatenate([u for _, u in rows]).astype(np.int32)
     else:
         uids = np.zeros(0, dtype=np.int32)
-    return TokenIndex(terms, jnp.asarray(indptr), jnp.asarray(uids))
+    return TokenIndex(terms, indptr, uids)
 
 
 class GraphSnapshot:
@@ -200,13 +304,20 @@ class GraphSnapshot:
                     if est is not None:  # overlay: don't force a merge
                         total += est()
                         continue
-                    total += csr.subjects.nbytes + csr.indptr.nbytes + csr.indices.nbytes
+                    hn = getattr(csr, "host_nbytes", None)
+                    if hn is not None:   # host truth — never forces upload
+                        total += hn()
+                    else:                # mesh-sharded DistPredCSR
+                        total += csr.subjects.nbytes + \
+                            csr.indptr.nbytes + csr.indices.nbytes
             if pd.value_subjects is not None:
                 total += pd.value_subjects.nbytes
             if pd.num_values is not None:
                 total += pd.num_values.nbytes
             for ti in pd.indexes.values():
-                total += ti.indptr.nbytes + ti.uids.nbytes
+                hn = getattr(ti, "host_nbytes", None)
+                total += hn() if hn is not None else \
+                    (ti.indptr.nbytes + ti.uids.nbytes)
             if pd.vecindex is not None:
                 total += pd.vecindex.nbytes()
         return total
@@ -268,9 +379,9 @@ def _csr_from_flat(subjects: np.ndarray, counts: np.ndarray,
     indptr = np.zeros(int(keep.sum()) + 1, dtype=np.int32)
     np.cumsum(counts[keep], out=indptr[1:])
     return PredCSR(
-        jnp.asarray(subjects_k.astype(np.int32)),
-        jnp.asarray(indptr),
-        jnp.asarray(indices.astype(np.int32)),
+        subjects_k.astype(np.int32),
+        indptr,
+        indices.astype(np.int32),
     )
 
 
@@ -471,9 +582,13 @@ def build_pred(store: Store, attr: str, read_ts: int,
         if vs[-1] > MAX_DEVICE_UID:
             raise ValueError("value subject uid exceeds device uid space")
         pd.value_subjects_host = vs
-        pd.value_subjects = jnp.asarray(vs.astype(np.int32))
+        # the narrow value-table mirrors are host-resident: nothing reads
+        # them on device (compares run on the float64 host mirror), so
+        # eagerly uploading them only burned HBM the residency budget now
+        # accounts for
+        pd.value_subjects = vs.astype(np.int32)
         pd.num_values_host = np.asarray(num_vals, dtype=np.float64)[order]
-        pd.num_values = jnp.asarray(pd.num_values_host.astype(np.float32))
+        pd.num_values = pd.num_values_host.astype(np.float32)
 
     # reverse CSR (flat fold; facets belong to the forward tablet)
     if entry is not None and entry.reverse:
@@ -510,6 +625,13 @@ def build_pred(store: Store, attr: str, read_ts: int,
             by_tok[name].append((key.term[1:], u))
         for name, rows in by_tok.items():
             pd.indexes[name] = _token_index(rows)
+
+    # residency adoption: when the owning node runs a device working-set
+    # manager (storage/residency.py), every device-buffer owner of this
+    # fold admits against the node's budget and is demotable/evictable
+    mgr = getattr(store, "residency", None)
+    if mgr is not None:
+        mgr.adopt_pred(pd)
     return pd
 
 

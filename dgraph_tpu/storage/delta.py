@@ -107,6 +107,12 @@ class OverlayCSR:
     """
 
     is_dist = False
+    # residency owner protocol (storage/residency.py): the overlay's
+    # MERGED device view is the droppable buffer group; the base PredCSR
+    # is adopted separately and keeps its own entry
+    _res = None
+    _res_attr = ""
+    _res_kind = "csr:merged"
 
     def __init__(self, base, delta: OverlayRows) -> None:
         # stacking overlays would hide the true base: the assembler always
@@ -155,10 +161,7 @@ class OverlayCSR:
         return int(self.subjects_degrees_host()[1].sum())
 
     def approx_nbytes(self) -> int:
-        base = 0
-        if self.base is not None:
-            base = int(self.base.subjects.nbytes + self.base.indptr.nbytes
-                       + self.base.indices.nbytes)
+        base = self.base.host_nbytes() if self.base is not None else 0
         return base + self.delta.nbytes()
 
     # -- hot-path merge plan (task._expand_csr) ------------------------------
@@ -219,16 +222,35 @@ class OverlayCSR:
 
     def _merged_device(self):
         if self._merged_dev is None:
-            import jax.numpy as jnp
-
+            from dgraph_tpu.storage import residency as resmod
             from dgraph_tpu.storage.csr_build import PredCSR
 
-            subs, indptr, indices = self.host_arrays()
-            self._merged_dev = PredCSR(
-                jnp.asarray(subs.astype(np.int32)),
-                jnp.asarray(indptr.astype(np.int32)),
-                jnp.asarray(indices.astype(np.int32)))
+            def build():
+                subs, indptr, indices = self.host_arrays()
+                return PredCSR(subs.astype(np.int32),
+                               indptr.astype(np.int32),
+                               indices.astype(np.int32))
+
+            resmod.ensure_device(self, "_merged_dev", build)
         return self._merged_dev
+
+    def device_resident(self) -> bool:
+        return self._merged_dev is not None
+
+    def drop_device(self) -> None:
+        self._merged_dev = None
+
+    def device_nbytes(self) -> int:
+        return self.approx_nbytes()
+
+    def prefer_host(self) -> bool:
+        from dgraph_tpu.storage import residency as resmod
+
+        # the hot expand path merges on read against the BASE device
+        # arrays: the overlay defers to the base's tier for that decision
+        if self.base is not None:
+            return self.base.prefer_host()
+        return resmod.prefer_host(self)
 
     @property
     def subjects(self):
@@ -273,7 +295,12 @@ class LazyTokenIndex:
     """TokenIndex duck-type over merged HOST columns: the terms list and
     host mirrors are exact at stamp time (inequality walks, sorts, and the
     sub-64k union path never touch the device); the device columns upload
-    lazily on the first large union."""
+    lazily on the first large union — through the residency seam when a
+    manager is attached (storage/residency.py owner protocol)."""
+
+    _res = None
+    _res_attr = ""
+    _res_kind = "index:merged"
 
     def __init__(self, terms: list[bytes], indptr: np.ndarray,
                  uids: np.ndarray) -> None:
@@ -289,13 +316,34 @@ class LazyTokenIndex:
     def host_arrays(self):
         return self._indptr_h, self._uids_h
 
+    def device_resident(self) -> bool:
+        return self._dev is not None
+
+    def drop_device(self) -> None:
+        self._dev = None
+
+    def device_nbytes(self) -> int:
+        # int32 device columns (half the int64 host mirror width)
+        return int(self._indptr_h.nbytes + self._uids_h.nbytes) // 2
+
+    def host_nbytes(self) -> int:
+        return int(self._indptr_h.nbytes + self._uids_h.nbytes)
+
+    def prefer_host(self) -> bool:
+        from dgraph_tpu.storage import residency as resmod
+
+        return resmod.prefer_host(self)
+
     def _device(self):
-        if self._dev is None:
+        from dgraph_tpu.storage import residency as resmod
+
+        def build():
             import jax.numpy as jnp
 
-            self._dev = (jnp.asarray(self._indptr_h.astype(np.int32)),
-                         jnp.asarray(self._uids_h.astype(np.int32)))
-        return self._dev
+            return (jnp.asarray(self._indptr_h.astype(np.int32)),
+                    jnp.asarray(self._uids_h.astype(np.int32)))
+
+        return resmod.ensure_device(self, "_dev", build)
 
     @property
     def indptr(self):
@@ -410,6 +458,12 @@ def stamp_pred(store, attr: str, base_pd, read_ts: int,
             pd.rev_csr = OverlayCSR(base, overlay_rows(store, rev_k, read_ts))
     if idx_k:
         _stamp_indexes(store, pd, base_pd, entry, idx_k, read_ts)
+    # residency adoption of the NEW owners a stamp minted (OverlayCSR
+    # merged views, merged token indexes); base objects keep their
+    # existing manager entries — the no-re-upload contract
+    mgr = getattr(store, "residency", None)
+    if mgr is not None:
+        mgr.adopt_pred(pd)
     return pd
 
 
@@ -491,11 +545,9 @@ def _stamp_data(store, pd, base_pd, entry, tid, data_k, read_ts) -> None:
 
 def _patch_value_arrays(pd, base_pd, touched: np.ndarray,
                         val_entries: dict[int, float]) -> None:
-    """Splice the touched subjects into the sorted value tables (and their
-    device mirrors — they changed, so fresh uploads are correct here; the
-    uid-edge CSR is the identity-preserving one)."""
-    import jax.numpy as jnp
-
+    """Splice the touched subjects into the sorted value tables (host
+    mirrors — value compares run on the float64 host mirror, never on
+    device; the uid-edge CSR is the identity-preserving one)."""
     from dgraph_tpu.storage.csr_build import MAX_DEVICE_UID
 
     vs = base_pd.value_subjects_host
@@ -519,9 +571,9 @@ def _patch_value_arrays(pd, base_pd, touched: np.ndarray,
     if int(new_vs[-1]) > MAX_DEVICE_UID:
         raise ValueError("value subject uid exceeds device uid space")
     pd.value_subjects_host = new_vs
-    pd.value_subjects = jnp.asarray(new_vs.astype(np.int32))
+    pd.value_subjects = new_vs.astype(np.int32)
     pd.num_values_host = new_nv
-    pd.num_values = jnp.asarray(new_nv.astype(np.float32))
+    pd.num_values = new_nv.astype(np.float32)
 
 
 def _stamp_indexes(store, pd, base_pd, entry, idx_k, read_ts) -> None:

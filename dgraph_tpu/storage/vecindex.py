@@ -97,6 +97,12 @@ class VectorIndex:
     lifetime — the HBM-resident contract)."""
 
     is_overlay = False
+    # residency owner protocol (storage/residency.py): the [R, D] device
+    # matrix + norms + subjects are the droppable buffer group; the host
+    # float32 fold is the warm-tier truth
+    _res = None
+    _res_attr = ""
+    _res_kind = "vec"
 
     def __init__(self, attr: str, spec: VectorSpec, subjects: np.ndarray,
                  vecs: np.ndarray, ivf: IVFIndex | None = None,
@@ -140,10 +146,15 @@ class VectorIndex:
             return self._vecs64[rows]
         return self.vecs[rows].astype(np.float64)
 
-    def device(self):
+    def device(self, prefetch: bool = False):
         """(matrix [R, D], norms [R], subjects [R] int32) padded to the
-        pow2 row-capacity class (bounds jit retraces, ops/vector.py)."""
-        if self._dev is None:
+        pow2 row-capacity class (bounds jit retraces, ops/vector.py).
+        Uploads through the residency seam when managed — admission
+        against the device budget, evictable back to the warm host tier
+        without touching this object's identity."""
+        from dgraph_tpu.storage import residency as resmod
+
+        def build():
             import jax.numpy as jnp
 
             R = vops.row_capacity(self.n)
@@ -153,9 +164,25 @@ class VectorIndex:
             norms[: self.n] = np.linalg.norm(self.vecs, axis=1)
             subs = np.zeros(R, dtype=np.int32)
             subs[: self.n] = self.subjects.astype(np.int32)
-            self._dev = (jnp.asarray(mat), jnp.asarray(norms),
-                         jnp.asarray(subs))
-        return self._dev
+            return (jnp.asarray(mat), jnp.asarray(norms),
+                    jnp.asarray(subs))
+
+        return resmod.ensure_device(self, "_dev", build, prefetch=prefetch)
+
+    def device_resident(self) -> bool:
+        return self._dev is not None
+
+    def drop_device(self) -> None:
+        self._dev = None
+
+    def device_nbytes(self) -> int:
+        R = vops.row_capacity(self.n)
+        return int(R * self.dim * 4 + R * 4 + R * 4)
+
+    def prefer_host(self) -> bool:
+        from dgraph_tpu.storage import residency as resmod
+
+        return resmod.prefer_host(self)
 
 
 class VecOverlay:
@@ -398,6 +425,22 @@ def search(vi, q, k: int, *, nprobe: int | None = None,
     base = vi.base if vi.is_overlay else vi
     dead = vi.dead_rows if vi.is_overlay else np.zeros(0, np.int32)
 
+    # residency tier consult: a COLD vector tablet (device matrix larger
+    # than the whole device budget) serves the exact float64 host scan —
+    # the same ranking rule, never an upload
+    cold = base is not None and base.prefer_host()
+    if cold and getattr(base, "_res", None) is not None:
+        base._res.note_cold_serve()
+
+    def _host_scan():
+        d = vops.host_distances(base.vecs64(), q64, base.metric)
+        if len(dead):
+            d[dead] = np.inf
+        rows = np.argsort(d, kind="stable")[: min(k, base.n)]
+        rows = rows[np.isfinite(d[rows])]
+        cand_subs.append(base.subjects[rows])
+        cand_d.append(d[rows])
+
     cand_subs: list[np.ndarray] = []
     cand_d: list[np.ndarray] = []
     if base is not None and base.n:
@@ -416,27 +459,37 @@ def search(vi, q, k: int, *, nprobe: int | None = None,
             if len(dead):
                 rows = rows[~np.isin(rows, dead)]
             if len(rows):
-                if len(rows) * base.dim > HOST_SCAN_MAX:
-                    rows = _ivf_device_stage(base, q, rows, k, metrics)
+                if len(rows) * base.dim > HOST_SCAN_MAX and not cold:
+                    from dgraph_tpu.utils.faults import FaultError
+
+                    try:
+                        rows = _ivf_device_stage(base, q, rows, k, metrics)
+                    except FaultError:
+                        pass    # injected h2d fault: exact host re-rank
+                        # of the full probed candidate set (a superset)
                 s, d = _rescore(base, rows, q64)
                 cand_subs.append(s)
                 cand_d.append(d)
-        elif base.n * base.dim <= HOST_SCAN_MAX:
-            # tiny tablet: exact float64 host scan, no dispatch (sized on
-            # the BASE so vecs64() caching always applies here; a large
-            # base with many overlay-dead rows stays on the device path,
-            # which masks them without pinning a full float64 mirror)
-            d = vops.host_distances(base.vecs64(), q64, base.metric)
-            if len(dead):
-                d[dead] = np.inf
-            rows = np.argsort(d, kind="stable")[: min(k, base.n)]
-            rows = rows[np.isfinite(d[rows])]
-            cand_subs.append(base.subjects[rows])
-            cand_d.append(d[rows])
+        elif base.n * base.dim <= HOST_SCAN_MAX or cold:
+            # tiny tablet (or cold tier): exact float64 host scan, no
+            # dispatch (sized on the BASE so vecs64() caching always
+            # applies for the tiny case; a large base with many
+            # overlay-dead rows stays on the device path, which masks
+            # them without pinning a full float64 mirror)
+            _host_scan()
         else:
+            from dgraph_tpu.utils.faults import FaultError
+
             kprime = vops.k_capacity(k, vops.row_capacity(base.n))
-            rows = _device_candidates(base, q, kprime, dead, metrics)
-            if len(rows):
+            try:
+                rows = _device_candidates(base, q, kprime, dead, metrics)
+            except FaultError:
+                # injected h2d fault at the upload seam: byte-identical
+                # host scan (the shared float64 ranking rule)
+                rows = None
+            if rows is None:
+                _host_scan()
+            elif len(rows):
                 s, d = _rescore(base, rows, q64)
                 cand_subs.append(s)
                 cand_d.append(d)

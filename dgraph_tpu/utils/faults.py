@@ -58,6 +58,10 @@ POINTS = (
     "zero.rebalance_decide",  # controller tick, before acting on a pick
     "move.chunk_ship",      # per-chunk in the tablet move/replica stream
     "replica.delta_ship",   # replica freshness delta ship
+    # device working-set manager (storage/residency.py): the H2D upload
+    # seam every warm->hbm promotion crosses; query paths catch the
+    # injected error and serve the byte-identical host gather
+    "residency.h2d_upload",
 )
 
 

@@ -474,3 +474,120 @@ def test_lifeline_metrics_on_http_metrics():
         faults.GLOBAL.clear()
         srv.shutdown()
         node.close()
+
+
+def test_eviction_storm_under_write_mix():
+    """ISSUE 11 chaos schedule: an embedded node with a device budget
+    ~10x smaller than the graph, a seeded residency.h2d_upload fault
+    (10%), and a 10% write mix hammering OTHER predicates — an eviction
+    storm with failing promotions underneath. Contract: every read of the
+    static predicates is byte-identical or typed, no hangs."""
+    import threading as _th
+
+    import numpy as np
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.query import task as taskmod
+    from dgraph_tpu.storage import residency as resmod
+
+    preds = [f"e{i:02d}" for i in range(8)]
+    write_pred = "wp"
+
+    def build(budget: bool):
+        n = Node(task_cache_mb=0, result_cache_mb=0, planner=False)
+        n.alter(schema_text="\n".join(
+            [f"{p}: [uid] ." for p in preds] + [f"{write_pred}: [uid] ."]))
+        rng = np.random.default_rng(31)
+        nq = []
+        for p in preds:
+            for i in range(1, 33):
+                for t in rng.choice(32, 6, replace=False) + 1:
+                    nq.append(f"<{i:#x}> <{p}> <{int(t):#x}> .")
+        n.mutate(set_nquads="\n".join(nq), commit_now=True)
+        if budget:
+            total = sum(resmod.pred_host_nbytes(pd)
+                        for pd in n.snapshot().preds.values())
+            # ~10x the budget, floored above one ~1KB tablet so
+            # promotion/eviction churn (not pure cold serving) happens
+            n.residency.budget = max(total // 10, 2048)
+        return n
+
+    old_cut = taskmod.HOST_EXPAND_MAX
+    taskmod.HOST_EXPAND_MAX = 8          # force the device tier
+    clean = build(budget=False)
+    node = build(budget=True)
+    queries = [f"{{ q(func: has({p})) {{ {p} {{ uid }} }} }}"
+               for p in preds]
+    try:
+        golden = [json.dumps(clean.query(q)[0], sort_keys=True)
+                  for q in queries]
+        faults.GLOBAL.reseed(4242)
+        faults.GLOBAL.install("residency.h2d_upload", "error", p=0.1)
+        outcomes: list[dict] = []
+        stop = _th.Event()
+
+        def reader(qi):
+            rng = np.random.default_rng(qi)
+            while not stop.is_set():
+                i = int(rng.integers(len(queries)))
+                t0 = time.monotonic()
+                try:
+                    got = json.dumps(
+                        node.query(queries[i], timeout_ms=4000)[0],
+                        sort_keys=True)
+                    outcomes.append({"status": "ok",
+                                     "identical": got == golden[i],
+                                     "dt": time.monotonic() - t0})
+                except TYPED_ERRORS as e:
+                    outcomes.append({"status": type(e).__name__,
+                                     "identical": None,
+                                     "dt": time.monotonic() - t0})
+                except BaseException as e:
+                    outcomes.append(
+                        {"status": f"UNTYPED:{type(e).__name__}",
+                         "identical": None,
+                         "dt": time.monotonic() - t0})
+
+        def writer():
+            # ~10% write mix against a predicate the readers never touch
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    node.mutate(
+                        set_nquads=f"<{i % 32 + 1:#x}> <{write_pred}> "
+                                   f"<{i % 31 + 1:#x}> .",
+                        commit_now=True)
+                except TYPED_ERRORS:
+                    pass
+                time.sleep(0.01)
+
+        threads = [_th.Thread(target=reader, args=(qi,))
+                   for qi in range(4)] + [_th.Thread(target=writer)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        deadline = time.monotonic() + 8.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        hung = [t for t in threads if t.is_alive()]
+        assert not hung, f"{len(hung)} request threads hung"
+        assert outcomes, "no requests completed"
+        for o in outcomes:
+            if o["status"] == "ok":
+                assert o["identical"], f"WRONG READ under storm: {o}"
+            else:
+                assert not o["status"].startswith("UNTYPED"), \
+                    f"untyped error escaped: {o}"
+            assert o["dt"] <= 4.0 + WATCHDOG_SLACK_S, o
+        m = node.residency.metrics
+        # the storm actually stormed: promotions + failures both happened
+        assert m.counter("dgraph_residency_admissions_total").value > 0
+        assert faults.GLOBAL.snapshot()[
+            "points"]["residency.h2d_upload"]["fired"] > 0
+    finally:
+        taskmod.HOST_EXPAND_MAX = old_cut
+        faults.GLOBAL.clear()
+        clean.close()
+        node.close()
