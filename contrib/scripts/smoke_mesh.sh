@@ -4,13 +4,19 @@
 # Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
 # skip when the full suite already ran in an earlier CI stage).
 # Step 2 forces the 8-virtual-device CPU mesh and runs the mixed battery
-# (3-hop chain, fused recurse, shortest / k-shortest) on a mesh-mode Node
-# AND on a 3-group gRPC wire cluster over loopback, asserting:
+# (3-hop chain, filtered chain, paginated chain, fused recurse, shortest /
+# k-shortest) on a mesh-mode Node AND on a 3-group gRPC wire cluster over
+# loopback, asserting:
 #   * every battery query's JSON is byte-identical mesh vs wire,
-#   * the 3-hop chain crossing 3 predicate shards is ONE mesh dispatch
+#   * every traversal shape — including the filter/pagination shapes that
+#     used to bail to per-task dispatches, and shortest-path's whole
+#     expandOut loop — is ONE mesh dispatch
 #     (dgraph_mesh_dispatches_total delta == 1) while the wire path pays
-#     one ServeTask RPC per hop,
-#   * /metrics exposes the dgraph_mesh_* series and parses clean.
+#     one ServeTask RPC per hop (12 for shortest),
+#   * the p50 PARITY gate: mesh p50 <= gRPC p50 per battery entry, timed
+#     in interleaved rounds so box drift hits both paths equally,
+#   * /metrics exposes the dgraph_mesh_* series (incl. the reason-labeled
+#     dgraph_mesh_fallbacks_total) and parses clean.
 # Runs entirely on the XLA host platform — no TPU required.
 
 set -euo pipefail
@@ -36,6 +42,7 @@ echo "== mesh smoke (forced 8-device CPU) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 python - <<'PY'
 import json
+import time
 
 import jax
 
@@ -51,10 +58,13 @@ from dgraph_tpu.parallel.remote import serve_worker
 from dgraph_tpu.storage.store import Store
 from dgraph_tpu.utils.schema import parse_schema
 
-SCHEMA = "p0: [uid] .\np1: [uid] .\np2: [uid] .\nfollows: [uid] .\n"
+SCHEMA = ("p0: [uid] .\np1: [uid] .\np2: [uid] .\nfollows: [uid] .\n"
+          "rating: float @index(float) .\n")
 N = 400
 quads = []
 for i in range(1, N + 1):
+    quads.append(f'<0x{i:x}> <rating> "{(i * 13) % 100 / 10}"'
+                 f'^^<xs:float> .')
     for attr, mul, off in (("p0", 3, 1), ("p1", 5, 2), ("p2", 7, 3),
                            ("follows", 11, 5)):
         for k in range(3):
@@ -62,24 +72,35 @@ for i in range(1, N + 1):
             if t != i:
                 quads.append(f"<0x{i:x}> <{attr}> <0x{t:x}> .")
 
+# ONE-dispatch battery: every traversal family, incl. the fused-plan
+# shapes (filters/pagination mid-chain) PR 6 could not cover
 BATTERY = [
     ("chain3", '{ q(func: uid(0x1, 0x2, 0x3)) { p0 { p1 { p2 } } } }'),
+    ("chain3_filter", '{ q(func: uid(0x1, 0x2, 0x3)) '
+                      '{ p0 @filter(ge(rating, 2.0)) { p1 { p2 } } } }'),
+    ("chain3_page", '{ q(func: uid(0x1, 0x2, 0x3)) '
+                    '{ p0 (first: 2) { p1 { p2 } } } }'),
     ("recurse3", '{ q(func: uid(0x1)) @recurse(depth: 3) { follows } }'),
     ("shortest", '{ p as shortest(from: 0x1, to: 0x51) { follows } '
                  ' r(func: uid(p)) { uid } }'),
     ("kshortest", '{ p as shortest(from: 0x1, to: 0x51, numpaths: 2) '
                   '{ follows }  r(func: uid(p)) { uid } }'),
 ]
+ONE_DISPATCH = {"chain3", "chain3_filter", "chain3_page", "recurse3",
+                "shortest", "kshortest"}
 
-# -- mesh-mode node (every tablet sharded over the 8-device mesh) ----------
+# -- mesh-mode node (every tablet sharded over the 8-device mesh;
+# task/result caches off so dispatches are counted, plan cache on —
+# plans never skip a dispatch and production always runs with it) -------
 mnode = Node(mesh_devices=8, mesh_min_edges=1)
 mnode.alter(schema_text=SCHEMA)
 mnode.mutate(set_nquads="\n".join(quads), commit_now=True)
-mnode.plan_cache = mnode.task_cache = mnode.result_cache = None
+mnode.task_cache = mnode.result_cache = None
 
 # -- 3-group wire cluster over loopback gRPC -------------------------------
 zero = Zero(3)
-for attr, g in (("p0", 0), ("p1", 1), ("p2", 2), ("follows", 0)):
+for attr, g in (("p0", 0), ("p1", 1), ("p2", 2), ("follows", 0),
+                ("rating", 1)):
     zero.move_tablet(attr, g)
 zsrv, zport, _ = serve_zero(zero, "localhost:0")
 workers = []
@@ -95,25 +116,44 @@ client.task_cache = None      # count every wire dispatch
 
 rpc = [0]
 orig = remote_mod.RemoteWorker.process_task
-def counted(self, q, read_ts, min_applied=0):
+def counted(self, q, read_ts, min_applied=0, **kw):
     rpc[0] += 1
-    return orig(self, q, read_ts, min_applied)
+    return orig(self, q, read_ts, min_applied, **kw)
 remote_mod.RemoteWorker.process_task = counted
 
 mdisp = mnode.metrics.counter("dgraph_mesh_dispatches_total")
+parity_fail = []
 for name, q in BATTERY:
-    mjson, _ = mnode.query(q)
+    mjson, _ = mnode.query(q)      # warmup: fused-program compile
+    for _ in range(2):
+        mnode.query(q)
     wjson = client.query(q)
     assert json.dumps(mjson, sort_keys=True) == \
         json.dumps(wjson, sort_keys=True), f"{name}: mesh != wire"
     d0, rpc[0] = mdisp.value, 0
     mnode.query(q)
     client.query(q)
-    print(f"  {name}: identical; dispatches mesh={mdisp.value - d0} "
-          f"grpc={rpc[0]}")
+    md, wd = mdisp.value - d0, rpc[0]
+    if name in ONE_DISPATCH:
+        assert md == 1, f"{name} must be ONE mesh dispatch (got {md})"
+    # p50 parity: interleaved rounds so drift hits both paths equally
+    mlat, wlat = [], []
+    for _ in range(9):
+        t0 = time.perf_counter(); mnode.query(q)
+        mlat.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); client.query(q)
+        wlat.append(time.perf_counter() - t0)
+    mp50 = sorted(mlat)[len(mlat) // 2] * 1e3
+    wp50 = sorted(wlat)[len(wlat) // 2] * 1e3
+    ok = mp50 <= wp50
+    if not ok:
+        parity_fail.append(name)
+    print(f"  {name}: identical; dispatches mesh={md} grpc={wd}; "
+          f"p50 mesh={mp50:.1f}ms grpc={wp50:.1f}ms "
+          f"{'<= OK' if ok else 'PARITY FAIL'}")
     if name == "chain3":
-        assert mdisp.value - d0 == 1, "3-hop chain must be ONE dispatch"
-        assert rpc[0] == 3, "wire path pays one RPC per hop"
+        assert wd == 3, "wire path pays one RPC per hop"
+assert not parity_fail, f"mesh p50 parity failed: {parity_fail}"
 
 series = prom.parse(prom.render(mnode.metrics))
 assert series["dgraph_mesh_dispatches_total"][0][1] >= 1

@@ -138,6 +138,30 @@ def _hit_rate(hits: int, misses: int) -> float:
     return round(hits / total, 4) if total else 0.0
 
 
+def _mesh_metrics(node: Node) -> dict:
+    m = node.metrics
+    c = lambda n: m.counter(n).value
+    fused = c("dgraph_mesh_fused_queries_total")
+    unfused = c("dgraph_mesh_unfused_queries_total")
+    return {
+        "enabled": node.mesh_exec is not None,
+        "devices": c("dgraph_mesh_devices"),
+        "dispatches": c("dgraph_mesh_dispatches_total"),
+        "fused_hops": c("dgraph_mesh_fused_hops_total"),
+        "traversed_edges": c("dgraph_mesh_traversed_edges_total"),
+        "program_builds": c("dgraph_mesh_program_builds_total"),
+        "sharded_tablets": c("dgraph_mesh_sharded_tablets"),
+        "replicated_tablets": c("dgraph_mesh_replicated_tablets"),
+        "residency_deferred": c("dgraph_mesh_residency_deferred_total"),
+        "fallbacks": m.keyed("dgraph_mesh_fallbacks_total",
+                             labels=("reason",)).snapshot(),
+        "fused_queries": fused,
+        "unfused_queries": unfused,
+        "fused_coverage_ratio": round(fused / (fused + unfused), 4)
+        if fused + unfused else None,
+    }
+
+
 def _serving_metrics(node: Node) -> dict:
     """The /debug/metrics payload: cache tiers, dispatch gate, and
     per-endpoint QPS + latency (the round-6 serving-layer readout)."""
@@ -248,6 +272,12 @@ def _serving_metrics(node: Node) -> dict:
             "degraded_reads": c("dgraph_degraded_reads_total"),
             "faults_injected": c("dgraph_fault_injected_total"),
         },
+        # mesh deployment mode (ISSUE 12, parallel/mesh_exec.py): fused
+        # whole-plan dispatches, per-reason fallback breakdown, and the
+        # fused-coverage ratio — queries that touched mesh-owned tablets
+        # and ran their traversals fully fused vs ones that recorded at
+        # least one labeled fallback
+        "mesh": _mesh_metrics(node),
         # HBM working-set manager (ISSUE 11, storage/residency.py): tier
         # byte totals (hbm/warm/cold), admission/eviction/prefetch/thrash
         # counters, pinned tablets, and the currently-resident buffer
@@ -308,7 +338,8 @@ class _Handler(BaseHTTPRequestHandler):
     _DEBUG_INDEX = {
         "/debug/vars": "expvar-style dgraph_* counters/histograms",
         "/debug/requests": "sampled request breadcrumb traces (?n=32)",
-        "/debug/metrics": "serving-layer readout: caches, overlay, planner",
+        "/debug/metrics": "serving-layer readout: caches, overlay, "
+                          "planner, mesh, residency",
         "/debug/traces": "distributed span traces index (?n=32)",
         "/debug/traces/<trace_id>": "one trace as Chrome trace-event JSON "
                                     "(load in Perfetto / chrome://tracing)",

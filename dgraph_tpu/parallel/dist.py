@@ -112,7 +112,13 @@ def _expand_program(mesh: Mesh, fcap: int, edge_cap: int):
     uidMatrix (assemble_matrix). Besides the per-shard (counts, targets)
     the program emits the MERGED next frontier (dedup of the all-gathered
     dest sets) so a stepped multi-hop caller can stage it on device
-    between hops instead of re-uploading seeds each step."""
+    between hops instead of re-uploading seeds each step.
+
+    The frontier buffer is DONATED (SNIPPETS [1] donate_argnums): a
+    stepped caller replaying the staged merged frontier hands its buffer
+    back to XLA for the next merge instead of re-allocating HBM every
+    hop — expand_matrix always re-stages from the call's OUTPUT, so the
+    consumed input is never touched again."""
 
     @partial(
         shard_map, mesh=mesh,
@@ -128,7 +134,7 @@ def _expand_program(mesh: Mesh, fcap: int, edge_cap: int):
         merged = _dedup_sorted(jnp.sort(gathered.reshape(-1)))[:fcap]
         return res.counts[None, :], res.targets[None, :], merged
 
-    return jax.jit(run)
+    return jax.jit(run, donate_argnums=(3,))
 
 
 def assemble_matrix(counts: np.ndarray, targets: np.ndarray,
@@ -224,6 +230,10 @@ class DistPredCSR:
         if staged is not None and len(staged[0]) == F and \
                 np.array_equal(staged[0], uids):
             fr_dev, fcap = staged[1], int(staged[1].shape[0])
+            # the staged buffer is about to be DONATED to the program —
+            # drop the reference so no failure path can replay a
+            # consumed buffer
+            self._staged = None
         else:
             fcap = 1 << max(int(np.ceil(np.log2(F))), 4)
             fr_dev = jnp.asarray(pad_frontier(np.asarray(uids), fcap))
@@ -283,7 +293,10 @@ def _k_hop_program(mesh: Mesh, hops: int, frontier_cap: int, num_nodes: int,
         return lax.fori_loop(0, hops, body,
                              (seeds_in, visited0, jnp.int32(0)))
 
-    return jax.jit(run)
+    # seeds + visited are donated: the hop loop's carries reuse their
+    # HBM across iterations instead of re-allocating per hop (both are
+    # freshly built by dist_k_hop each call, never read back)
+    return jax.jit(run, donate_argnums=(3, 4))
 
 
 def dist_k_hop(csr: ShardedCSR, seeds: jax.Array, mesh: Mesh, *, hops: int,

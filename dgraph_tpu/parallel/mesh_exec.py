@@ -7,17 +7,29 @@ one ProcessTaskOverNetwork gRPC round trip PER HOP PER GROUP
 fan-out is remapped onto a `jax.sharding.Mesh` (the BASELINE north star):
 per-predicate CSR arrays are placed across the mesh as NamedSharding device
 arrays (row-range partition; small tablets stay replicated on the classic
-single-device/host path), and a multi-hop traversal — the nested-expansion
-chain, the fused single-child `@recurse`, and shortest/k-shortest frontier
-iteration — runs as ONE jitted `shard_map` program whose only inter-device
-traffic is the per-hop all_gather of frontier UID blocks over ICI. N hops
-across N shards = one device dispatch instead of N×hops RPCs.
+single-device/host path), and the planner's WHOLE physical plan — the
+expansion chain with its pointwise filters and per-row pagination windows
+(query/fusedplan.py), the fused single-child `@recurse`, and the
+shortest-path BFS — runs as ONE jitted `shard_map` program whose only
+inter-device traffic is one all_gather per hop of (frontier-UID block ‖
+local edge total) over ICI. N hops across N shards = one device dispatch
+instead of N×hops RPCs.
+
+Program shape (ISSUE 12, the perf remap): fused programs ship ONLY
+replicated frontier blocks and per-shard edge totals back to the host —
+never per-shard uidMatrix columns. Result materialization is inherently
+ragged and host-side by design (SURVEY §7): the host replays each hop's
+pruned rows from its CSR mirrors with the same allow-sets the device
+applied (fusedplan.replay_hop), byte-identical by construction. Shortest
+path runs its whole expandOut loop as a `lax.while_loop` with frontier,
+visited set, and distance vector device-resident between hops (12
+dispatches → 1), and every program donates its frontier/visited/distance
+input buffers (`donate_argnums`, SNIPPETS [1]) so hops stop re-allocating
+HBM.
 
 The gRPC path (parallel/remote.py) remains the cross-pod / CPU-host
-fallback: shapes the fused programs do not cover (filters between hops,
-facets, pagination, delta-overlay tablets awaiting compaction) fall back to
-the classic per-task seam, which itself routes mesh-sharded tablets through
-the cached one-hop program (parallel/dist.DistPredCSR.expand_matrix).
+fallback: shapes the fused programs do not cover fall back to the classic
+per-task seam, labeled by reason on dgraph_mesh_fallbacks_total{reason=}.
 
 Observability: every fused dispatch runs under a `device_kernel` span with
 one `mesh_hop` event per collective step (obs/otrace.py), and the
@@ -36,26 +48,17 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dgraph_tpu.obs import otrace
-from dgraph_tpu.ops.csr import expand
-from dgraph_tpu.ops.uidset import _dedup_sorted
 from dgraph_tpu.parallel.dist import (SNT, DistPredCSR, _local_rows,
-                                      assemble_matrix, pad_frontier)
+                                      pad_frontier)
 from dgraph_tpu.parallel.mesh import make_mesh, shard_map
 from dgraph_tpu.storage.csr_build import GraphSnapshot, PredCSR
-
-
-class MeshCapacityError(RuntimeError):
-    """A fused traversal's frontier outgrew the program's capacity class —
-    the caller must fall back to the stepped path (cannot happen when the
-    capacity bound derives from the predicates' distinct-target counts;
-    kept as a belt-and-braces guard for exotic callers)."""
 
 
 def _target_table(csr: DistPredCSR) -> np.ndarray:
     """Sorted distinct destination uids of one sharded tablet (cached: one
     O(E log E) host pass per placement). Doubles as the rank space for
-    traversal visited-sets — anything a hop can reach is in here, so a
-    visited vector over ranks is O(tablet), never O(uid-space)."""
+    traversal visited/distance vectors — anything a hop can reach is in
+    here, so a vector over ranks is O(tablet), never O(uid-space)."""
     t = getattr(csr, "_target_table", None)
     if t is None:
         t = (np.unique(csr.indices).astype(np.int32) if len(csr.indices)
@@ -100,10 +103,45 @@ def _edge_rows(csr: DistPredCSR) -> jax.Array:
     return er
 
 
+def _eval_formula(formula: tuple, membs: list[jax.Array]) -> jax.Array:
+    """Formula evaluation inside traced programs: jax arrays support the
+    same & | ~ operators numpy does, so the ONE implementation
+    (fusedplan.eval_formula_np) serves both the device masks and the
+    host replay — a future formula-node addition cannot diverge the two
+    sides of the byte-identity invariant."""
+    from dgraph_tpu.query.fusedplan import eval_formula_np
+
+    return eval_formula_np(formula, membs)
+
+
+def _pag_window_dense(keep: jax.Array, lptr: jax.Array, erow: jax.Array,
+                      rows_per: int, first: jax.Array,
+                      offset: jax.Array) -> jax.Array:
+    """Per-row [offset, offset+first) window over the filter-SURVIVING
+    positions — the device twin of engine._apply_child_row_mods' slicing
+    (negative first keeps the last |first| of the post-offset run).
+    lptr [rows_per+1] holds the shard-local row→edge offsets, erow the
+    per-edge local row. first/offset are traced scalars: one compiled
+    program serves every pagination value of the same plan shape."""
+    ecap = keep.shape[0]
+    ki = keep.astype(jnp.int32)
+    ci = jnp.cumsum(ki)
+    cexcl = ci - ki
+    cext = jnp.concatenate([cexcl, ci[-1:]])             # [ecap + 1]
+    base_r = jnp.take(cext, jnp.clip(lptr[:-1], 0, ecap))   # [rows_per]
+    cnt_r = jnp.take(cext, jnp.clip(lptr[1:], 0, ecap)) - base_r
+    er = jnp.clip(erow, 0, rows_per - 1)
+    p = cexcl - jnp.take(base_r, er)
+    win = p >= offset
+    win &= jnp.where(first > 0, p < offset + first, True)
+    win &= jnp.where(first < 0, p >= jnp.take(cnt_r, er) + first, True)
+    return keep & win
+
+
 class MeshExecutor:
-    """Owns the device mesh, the tablet placement cache, and the compiled
-    fused-traversal programs. One per Node (or one per group submesh on a
-    multi-group pod)."""
+    """Owns the device mesh, the tablet placement cache, the allow-set
+    cache, and the compiled fused-plan programs. One per Node (or one per
+    group submesh on a multi-group pod)."""
 
     # tablets below this edge count stay replicated (the classic
     # single-device/host path): sharding them buys no bandwidth and pays
@@ -114,6 +152,9 @@ class MeshExecutor:
     SHARD_MIN_EDGES = 1 << 16
     _PLACE_CACHE = 512      # placed-PredData entries (identity-keyed)
     _SNAP_CACHE = 8         # placed-snapshot entries (identity-keyed)
+    _ALLOW_CACHE = 512      # resolved allow-sets (pred-identity-keyed)
+    _DEVSET_CACHE = 256     # uploaded allow-set rank masks
+    _DENSE_CACHE = 256      # (tablet, rank-space) edge/row rank maps
 
     def __init__(self, mesh: Mesh | None = None, n_devices: int | None = None,
                  metrics=None, shard_min_edges: int | None = None,
@@ -135,14 +176,23 @@ class MeshExecutor:
         # to OTHER predicates
         self._placed_pd: OrderedDict[int, tuple] = OrderedDict()
         self._placed_snaps: OrderedDict[int, tuple] = OrderedDict()
-        self._chain_progs: dict = {}
-        self._recurse_progs: dict = {}
-        self._step_progs: dict = {}
+        self._progs: dict = {}
+        self._allow: OrderedDict[tuple, tuple] = OrderedDict()
+        self._dev_sets: OrderedDict[tuple, tuple] = OrderedDict()
+        self._dense: OrderedDict[tuple, tuple] = OrderedDict()
+        self._bfs_tgt: OrderedDict[tuple, tuple] = OrderedDict()
         m = self.metrics
         self._c_dispatch = m.counter("dgraph_mesh_dispatches_total")
         self._c_hops = m.counter("dgraph_mesh_fused_hops_total")
         self._c_edges = m.counter("dgraph_mesh_traversed_edges_total")
-        self._c_fallback = m.counter("dgraph_mesh_fallbacks_total")
+        # per-reason fallback breakdown (ISSUE 12 satellite): the labeled
+        # series dgraph_mesh_fallbacks_total{reason=} enumerates every
+        # fused-coverage gap from /metrics (one KeyedGauge, no shadow
+        # counter — two families under one name would break exposition)
+        self._k_fallback = m.keyed("dgraph_mesh_fallbacks_total",
+                                   labels=("reason",))
+        self._c_fused_q = m.counter("dgraph_mesh_fused_queries_total")
+        self._c_unfused_q = m.counter("dgraph_mesh_unfused_queries_total")
         self._c_compiles = m.counter("dgraph_mesh_program_builds_total")
         m.counter("dgraph_mesh_devices").set(self.n_devices)
         m.counter("dgraph_mesh_sharded_tablets").set(0)
@@ -156,6 +206,35 @@ class MeshExecutor:
         """Is this a tablet THIS executor placed (fused programs only run
         over their own mesh's shards)?"""
         return isinstance(csr, DistPredCSR) and csr.mesh is self.mesh
+
+    def fallback(self, reason: str) -> None:
+        """One labeled fused-coverage miss (the engine also folds these
+        into the per-query fused/unfused ratio)."""
+        self._k_fallback.inc(reason)
+
+    def fallback_total(self) -> int:
+        return sum(self._k_fallback.snapshot().values())
+
+    def note_query(self, fused: bool) -> None:
+        """Per-query coverage accounting: a query that touched mesh-owned
+        tablets either ran its traversals fully fused or recorded at least
+        one labeled fallback. fused/(fused+unfused) is the coverage ratio
+        surfaced on /debug/metrics."""
+        (self._c_fused_q if fused else self._c_unfused_q).inc()
+
+    # -- allow-set caches ----------------------------------------------------
+
+    def allow_cached(self, key: tuple, pd) -> np.ndarray | None:
+        hit = self._allow.get(key)
+        if hit is not None and hit[0] is pd:
+            self._allow.move_to_end(key)
+            return hit[1]
+        return None
+
+    def allow_store(self, key: tuple, pd, s: np.ndarray) -> None:
+        self._allow[key] = (pd, s)
+        while len(self._allow) > self._ALLOW_CACHE:
+            self._allow.popitem(last=False)
 
     # -- placement (snapshot assembly → mesh) --------------------------------
 
@@ -242,98 +321,253 @@ class MeshExecutor:
                 self.residency.budget:
             # placement defers to the working-set manager: even one
             # row-shard of this tablet would blow the per-device budget —
-            # keep it on the warm/cold host path (task._expand_csr)
+            # keep it on the warm/cold host path (task._expand_csr) and
+            # mark it so the fused-plan classifier can label the miss
+            # reason=budget instead of treating it as a small tablet
             self.metrics.counter(
                 "dgraph_mesh_residency_deferred_total").inc()
+            csr._mesh_deferred = True
             return csr
         sub, ptr, idx = csr.host_arrays()
         placed = DistPredCSR(sub, ptr, idx, self.mesh)
         placed.metrics = self.metrics
         return placed
 
-    # -- fused chain: N hops, N predicates, ONE dispatch ---------------------
+    # -- dense rank-space precomputes (host, identity-cached) ----------------
+    #
+    # Fused traversals run DENSE: frontiers are bool masks over a tablet's
+    # sorted distinct-target table (the rank space), edges carry
+    # precomputed (local row, target rank) indices, and the per-hop
+    # exchange is ONE psum of an int32 [nd+1] vector (per-rank
+    # contribution counts ‖ local raw edge total). No sorts, no
+    # searchsorted over frontiers, no capacity classes that could
+    # truncate — the same dense-mask design ops/pallas_bfs proved for the
+    # single-device kernel, lifted onto the mesh.
 
-    def _chain_program(self, ecaps: tuple[int, ...], fcap: int):
-        key = ("chain", ecaps, fcap)
-        prog = self._chain_progs.get(key)
+    def _dense_maps(self, csr: DistPredCSR, tgt: np.ndarray):
+        """(erank, rrank) device arrays for one (tablet, rank-space)
+        pair: erank [S, ecap] maps each local edge to its target's rank
+        in `tgt` (nd = dump slot for padding), rrank [S, rows_per] maps
+        each local row's SUBJECT to its rank (nd where absent) — the
+        hop-to-hop mask relay."""
+        key = (id(csr), id(tgt))
+        hit = self._dense.get(key)
+        if hit is not None and hit[0] is csr and hit[1] is tgt:
+            self._dense.move_to_end(key)
+            return hit[2], hit[3]
+        from jax.sharding import NamedSharding
+
+        nd = len(tgt)
+        S = csr.mesh.shape["shard"]
+        ecap = int(csr.sharded.indices.shape[-1])
+        rows_per = csr.rows_per
+        n_rows = len(csr.subjects)
+        erank = np.full((S, ecap), nd, dtype=np.int32)
+        rrank = np.full((S, rows_per), nd, dtype=np.int32)
+        for s in range(S):
+            lo = min(s * rows_per, n_rows)
+            hi = min((s + 1) * rows_per, n_rows)
+            seg = csr.indices[csr.indptr[lo]: csr.indptr[hi]]
+            if len(seg):
+                pos = np.searchsorted(tgt, seg)
+                pc = np.clip(pos, 0, max(nd - 1, 0))
+                erank[s, : len(seg)] = np.where(
+                    (nd > 0) & (tgt[pc] == seg), pc, nd)
+            subs = csr.subjects[lo:hi]
+            if len(subs):
+                pos = np.searchsorted(tgt, subs)
+                pc = np.clip(pos, 0, max(nd - 1, 0))
+                rrank[s, : len(subs)] = np.where(
+                    (nd > 0) & (tgt[pc] == subs), pc, nd)
+        sh = NamedSharding(csr.mesh, P("shard"))
+        erank_d = jax.device_put(erank, sh)
+        rrank_d = jax.device_put(rrank, sh)
+        self._dense[key] = (csr, tgt, erank_d, rrank_d)
+        while len(self._dense) > self._DENSE_CACHE:
+            self._dense.popitem(last=False)
+        return erank_d, rrank_d
+
+    def _dense_set_mask(self, s: np.ndarray, tgt: np.ndarray) -> jax.Array:
+        """One allow-set as a replicated bool[nd + 1] rank mask (tail
+        slot False for padding takes); identity-cached per (set,
+        rank-space) so repeated queries skip the upload."""
+        key = (id(s), id(tgt))
+        hit = self._dev_sets.get(key)
+        if hit is not None and hit[0] is s and hit[1] is tgt:
+            self._dev_sets.move_to_end(key)
+            return hit[2]
+        nd = len(tgt)
+        m = np.zeros(nd + 1, dtype=bool)
+        if nd and len(s):
+            pos = np.searchsorted(s, tgt)
+            pc = np.clip(pos, 0, len(s) - 1)
+            m[:nd] = s[pc] == tgt
+        dev = jnp.asarray(m)
+        self._dev_sets[key] = (s, tgt, dev)
+        while len(self._dev_sets) > self._DEVSET_CACHE:
+            self._dev_sets.popitem(last=False)
+        return dev
+
+    def _local_ptr(self, csr: DistPredCSR) -> jax.Array:
+        """[S, rows_per + 1] local row→edge offsets (the pagination
+        window's row boundaries), sharded like the CSR."""
+        lp = getattr(csr, "_local_ptr", None)
+        if lp is not None:
+            return lp
+        from jax.sharding import NamedSharding
+
+        S = csr.mesh.shape["shard"]
+        rows_per = csr.rows_per
+        n_rows = len(csr.subjects)
+        out = np.zeros((S, rows_per + 1), dtype=np.int32)
+        for s in range(S):
+            lo = min(s * rows_per, n_rows)
+            hi = min((s + 1) * rows_per, n_rows)
+            base = int(csr.indptr[lo])
+            out[s, : hi - lo + 1] = csr.indptr[lo: hi + 1] - base
+            out[s, hi - lo + 1:] = out[s, hi - lo]
+        lp = jax.device_put(out, NamedSharding(csr.mesh, P("shard")))
+        csr._local_ptr = lp
+        return lp
+
+    # -- whole-plan fused program: N hops + filters + pagination, ONE dispatch
+
+    def _plan_program(self, fcap0: int, meta: tuple):
+        """meta: per hop (ecap, rows_per, nd, formula, nsets, has_pag).
+        The compiled program ships back ONLY the per-hop dest rank masks
+        (replicated bool [nd]) and raw edge totals — the host replays
+        uidMatrix rows from its own mirrors, so no sharded result
+        columns ever cross the device boundary."""
+        key = ("plan", fcap0, meta)
+        prog = self._progs.get(key)
         if prog is not None:
             return prog
         self._c_compiles.inc()
         mesh = self.mesh
-        hops = len(ecaps)
+        nargs = 1 + sum(2 + m[4] + (3 if m[5] else 0) + (1 if h else 0)
+                        for h, m in enumerate(meta)) + 1
 
-        def run(*args):
-            fr = args[-1]
+        def run2(*args):
+            sub0 = args[0]
+            fr0 = args[-1]
+            i = 1
             outs = []
-            for h in range(hops):
-                sub, ptr, idx = args[3 * h: 3 * h + 3]
-                rows = _local_rows(sub[0], fr)
-                res = expand(ptr[0], idx[0], rows, ecaps[h])
-                tot = lax.psum(res.total.astype(jnp.int32), "shard")
-                outs += [fr, res.counts[None, :], res.targets[None, :], tot]
-                if h + 1 < hops:
-                    # the ONLY inter-device traffic: the frontier UID
-                    # blocks, all-gathered over ICI, merged replicated
-                    dest = _dedup_sorted(jnp.sort(res.targets))
-                    gathered = lax.all_gather(dest, "shard")
-                    fr = _dedup_sorted(jnp.sort(gathered.reshape(-1)))[:fcap]
+            carry_mext = None
+            for h, (ecap, rows_per, nd, formula, nsets, has_pag) \
+                    in enumerate(meta):
+                erow, erank = args[i: i + 2]
+                i += 2
+                if h:
+                    prow = args[i]
+                    i += 1
+                    act = jnp.concatenate([
+                        jnp.take(carry_mext, jnp.clip(prow[0], 0,
+                                                      carry_mext.shape[0]
+                                                      - 1)),
+                        jnp.zeros(1, bool)])
+                else:
+                    rows = _local_rows(sub0[0], fr0)
+                    act = jnp.zeros((rows_per + 1,), bool).at[
+                        jnp.where(rows == SNT, rows_per + 1, rows)].set(
+                        True, mode="drop")
+                sets = args[i: i + nsets]
+                i += nsets
+                if has_pag:
+                    lptr, first, offset = args[i: i + 3]
+                    i += 3
+                ae = jnp.take(act, erow[0])               # [ecap]
+                keep = ae
+                if formula is not None:
+                    er = erank[0]
+                    membs = [jnp.take(s_, er, mode="clip") for s_ in sets]
+                    keep &= _eval_formula(formula, membs)
+                if has_pag:
+                    keep = _pag_window_dense(keep, lptr[0], erow[0],
+                                             rows_per, first, offset)
+                contrib = jnp.zeros((nd + 1,), jnp.int32).at[
+                    jnp.where(keep, erank[0], nd)].add(1, mode="drop")
+                trav = jnp.sum(ae, dtype=jnp.int32)
+                packed = jnp.concatenate([contrib[:nd], trav[None]])
+                tot = lax.psum(packed, "shard")       # the ONE ICI hop
+                mask = tot[:nd] > 0
+                outs += [mask, tot[nd]]
+                carry_mext = jnp.concatenate([mask, jnp.zeros(1, bool)])
             return tuple(outs)
 
-        in_specs = (P("shard"), P("shard"), P("shard")) * hops + (P(),)
-        out_specs = (P(), P("shard"), P("shard"), P()) * hops
-        prog = jax.jit(shard_map(run, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_rep=False))
-        self._chain_progs[key] = prog
+        in_specs: list = [P("shard")]
+        for h, (_e, _r, _nd, _f, nsets, has_pag) in enumerate(meta):
+            in_specs += [P("shard")] * 2
+            if h:
+                in_specs.append(P("shard"))
+            in_specs += [P()] * nsets
+            if has_pag:
+                in_specs += [P("shard"), P(), P()]
+        in_specs.append(P())
+        out_specs = (P(), P()) * len(meta)
+        # the seed frontier buffer is donated (SNIPPETS [1]
+        # donate_argnums): the program reuses its HBM for the first hop's
+        # row scatter instead of allocating fresh
+        prog = jax.jit(shard_map(run2, mesh=mesh,
+                                 in_specs=tuple(in_specs),
+                                 out_specs=out_specs, check_rep=False),
+                       donate_argnums=(nargs - 1,))
+        self._progs[key] = prog
         return prog
 
-    def run_chain(self, csrs: list[DistPredCSR], seeds: np.ndarray):
-        """Execute the whole expansion chain seeds →p0→p1→…→pk as ONE
-        device dispatch. Returns one (matrix, counts, dest_uids, traversed)
-        per hop, where matrix rows are keyed to that hop's sorted input
-        frontier — byte-identical to the classic per-hop dispatch loop.
+    def run_plan(self, hops: list, seeds: np.ndarray):
+        """Execute a whole fused chain — root frontier through every hop's
+        filter/pagination/expansion — as ONE device dispatch.
 
-        The frontier capacity class derives from the predicates'
-        distinct-target counts, so the replicated merge can never truncate
-        a real frontier."""
+        hops: list of (csr, formula, sets, first, offset) where formula /
+        sets come from fusedplan (sets are sorted int64 host arrays).
+        Returns one (frontier_in, traversed, next_frontier) per hop; the
+        caller replays the pruned uidMatrix rows from the host mirrors
+        (fusedplan.replay_hop), byte-identical to the classic loop. Dense
+        rank masks cannot truncate, so there is no capacity class to
+        outgrow."""
         seeds = np.asarray(seeds, dtype=np.int64)
-        bound = max([len(seeds)] +
-                    [_distinct_targets(c) for c in csrs[:-1]])
-        fcap = _fcap_for(bound)
-        ecaps = tuple(int(c.sharded.indices.shape[-1]) for c in csrs)
-        args = []
-        for c in csrs:
-            args += [c.sharded.subjects, c.sharded.indptr, c.sharded.indices]
-        args.append(jnp.asarray(pad_frontier(seeds, fcap)))
-        prog = self._chain_program(ecaps, fcap)
-        with otrace.span("device_kernel", kernel="mesh.chain",
-                         hops=len(csrs), devices=self.n_devices,
-                         fcap=fcap) as sp:
+        fcap0 = _fcap_for(len(seeds))
+        meta = []
+        args: list = [hops[0][0].sharded.subjects]
+        tgts = []
+        prev_tgt = None
+        for h, (csr, formula, sets, first, offset) in enumerate(hops):
+            tgt = _target_table(csr)
+            tgts.append(tgt)
+            erank, _rrank = self._dense_maps(csr, tgt)
+            ecap = int(csr.sharded.indices.shape[-1])
+            has_pag = bool(first or offset)
+            meta.append((ecap, csr.rows_per, len(tgt), formula,
+                         len(sets), has_pag))
+            args += [_edge_rows(csr), erank]
+            if h:
+                _er, rrank_prev = self._dense_maps(csr, prev_tgt)
+                args.append(rrank_prev)
+            args += [self._dense_set_mask(s, tgt) for s in sets]
+            if has_pag:
+                args += [self._local_ptr(csr), jnp.int32(first),
+                         jnp.int32(offset)]
+            prev_tgt = tgt
+        args.append(jnp.asarray(pad_frontier(seeds, fcap0)))
+        prog = self._plan_program(fcap0, tuple(meta))
+        with otrace.span("device_kernel", kernel="mesh.plan",
+                         hops=len(hops), devices=self.n_devices) as sp:
             with self.mesh:
                 flat = prog(*args)
-            flat = jax.device_get(flat)     # ONE host round trip, at the end
+            flat = jax.device_get(flat)  # ONE host round trip, at the end
             self._c_dispatch.inc()
-            self._c_hops.inc(len(csrs))
+            self._c_hops.inc(len(hops))
             levels = []
             frontier = seeds
             total = 0
-            for h in range(len(csrs)):
-                fr_dev, counts, targets, trav = flat[4 * h: 4 * h + 4]
-                if h > 0:
-                    frontier = fr_dev[fr_dev != int(SNT)].astype(np.int64)
-                    if len(frontier) == fcap:
-                        raise MeshCapacityError("frontier hit capacity")
-                F = len(frontier)
-                matrix = assemble_matrix(np.asarray(counts),
-                                         np.asarray(targets), F)
-                dest = (np.unique(np.concatenate(matrix))
-                        if any(len(m) for m in matrix)
-                        else np.zeros(0, np.int64))
-                trav = int(trav)
+            for h in range(len(hops)):
+                mask, trav = flat[2 * h], int(flat[2 * h + 1])
+                nxt = tgts[h][mask].astype(np.int64)
                 total += trav
                 otrace.event("mesh_hop", hop=h, edges=trav,
-                             frontier=F, dest=int(len(dest)))
-                levels.append((frontier, matrix,
-                               [len(m) for m in matrix], dest, trav))
+                             frontier=len(frontier), dest=len(nxt))
+                levels.append((frontier, trav, nxt))
+                frontier = nxt
             self._c_edges.inc(total)
             if sp:
                 sp.set(edges=total)
@@ -341,121 +575,264 @@ class MeshExecutor:
 
     # -- fused @recurse: edge-dedup levels, ONE dispatch ---------------------
 
-    def _recurse_program(self, ecap: int, rows_per: int, fcap: int,
-                         depth: int, allow_loop: bool):
-        key = ("recurse", ecap, rows_per, fcap, depth, allow_loop)
-        prog = self._recurse_progs.get(key)
+    def _recurse_prog(self, key_meta: tuple):
+        (ecap, rows_per, nd, fcap0, depth, allow_loop, formula, nsets) = \
+            key_meta
+        key = ("recurse", key_meta)
+        prog = self._progs.get(key)
         if prog is not None:
             return prog
         self._c_compiles.inc()
         mesh = self.mesh
 
-        def run(sub, ptr, idx, erow, fr0):
+        def run(sub, erow, erank, rrank, *rest):
+            sets = rest[: nsets]
+            fr0 = rest[-1]
+            rows = _local_rows(sub[0], fr0)
+            act0 = jnp.zeros((rows_per + 1,), bool).at[
+                jnp.where(rows == SNT, rows_per + 1, rows)].set(
+                True, mode="drop")
+
             def body(carry, _):
-                fr, seen = carry
-                rows = _local_rows(sub[0], fr)
-                # active-row mask over [rows_per + 1]: slot rows_per is the
-                # reserved pad target (always False); sentinel rows drop
-                rmask = jnp.zeros((rows_per + 1,), bool).at[
-                    jnp.where(rows == SNT, rows_per + 1, rows)].set(
-                    True, mode="drop")
-                active = jnp.take(rmask, erow[0])          # [ecap]
-                traversed = lax.psum(
-                    jnp.sum(active, dtype=jnp.int32), "shard")
+                act, seen = carry
+                ae = jnp.take(act, erow[0])                # [ecap]
                 if allow_loop:
-                    fresh, seen2 = active, seen
+                    fresh_e, seen2 = ae, seen
                 else:
-                    fresh = active & ~seen                 # edge-dedup
-                    seen2 = seen | active                  # (recurse.go:129)
-                dest = jnp.where(fresh, idx[0], SNT)
-                destd = _dedup_sorted(jnp.sort(dest))
-                gathered = lax.all_gather(destd, "shard")  # ICI hop
-                merged = _dedup_sorted(
-                    jnp.sort(gathered.reshape(-1)))[:fcap]
-                return (merged, seen2), (fr, fresh[None, :], traversed)
+                    fresh_e = ae & ~seen                   # edge-dedup
+                    seen2 = seen | ae                      # (recurse.go:129)
+                contrib = jnp.zeros((nd + 1,), jnp.int32).at[
+                    jnp.where(fresh_e, erank[0], nd)].add(1, mode="drop")
+                trav = jnp.sum(ae, dtype=jnp.int32)
+                packed = jnp.concatenate([contrib[:nd], trav[None]])
+                tot = lax.psum(packed, "shard")            # ICI hop
+                mask = tot[:nd] > 0
+                if formula is not None:
+                    # classic recurse filters the NEXT frontier
+                    # (child.dest_uids), never the matrix rows
+                    mask &= _eval_formula(formula,
+                                          [s_[:nd] for s_ in sets])
+                mext = jnp.concatenate([mask, jnp.zeros(1, bool)])
+                act2 = jnp.concatenate([
+                    jnp.take(mext, jnp.clip(rrank[0], 0, nd)),
+                    jnp.zeros(1, bool)])
+                return (act2, seen2), (mask, tot[nd])
 
-            seen0 = jnp.zeros((idx.shape[-1],), dtype=bool)
-            (_f, _s), (frs, fresh, trav) = lax.scan(
-                body, (fr0, seen0), jnp.arange(depth), length=depth)
-            return frs, fresh, trav
+            seen0 = jnp.zeros((ecap,), dtype=bool)
+            (_a, _s), (masks, tots) = lax.scan(
+                body, (act0, seen0), jnp.arange(depth), length=depth)
+            return masks, tots
 
+        in_specs = (P("shard"),) * 4 + (P(),) * nsets + (P(),)
         prog = jax.jit(shard_map(
-            run, mesh=mesh,
-            in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P()),
-            out_specs=(P(), P(None, "shard"), P()), check_rep=False))
-        self._recurse_progs[key] = prog
+            run, mesh=mesh, in_specs=in_specs,
+            out_specs=(P(), P()), check_rep=False),
+            donate_argnums=(4 + nsets,))
+        self._progs[key] = prog
         return prog
 
     def run_recurse(self, csr: DistPredCSR, seeds: np.ndarray, depth: int,
-                    allow_loop: bool):
+                    allow_loop: bool, formula: tuple | None = None,
+                    sets: list | None = None):
         """All `depth` edge-dedup recurse levels in ONE dispatch (the mesh
-        analog of ops/pallas_bfs.recurse_fused): per level, each shard masks
-        its first-traversal edges against a carried seen vector and the
-        fresh dest blocks all-gather into the next frontier. Returns one
-        (frontier, matrix, counts, dest_uids, traversed) per level with the
-        exact semantics of the stepped (attr, from, to)-dedup wire path."""
+        analog of ops/pallas_bfs.recurse_fused): per level, each shard
+        masks its first-traversal edges against a carried seen vector,
+        the fresh target-rank contributions merge in ONE psum over ICI,
+        and the child filter's allow-set formula narrows the frontier
+        mask device-side. Returns one (frontier, traversed) per level;
+        matrices replay from the host mirrors (query/recurse.py),
+        byte-identical to the stepped (attr, from, to)-dedup wire path."""
         seeds = np.asarray(seeds, dtype=np.int64)
-        fcap = _fcap_for(max(len(seeds), _distinct_targets(csr)))
+        tgt = _target_table(csr)
+        nd = len(tgt)
+        fcap0 = _fcap_for(len(seeds))
         ecap = int(csr.sharded.indices.shape[-1])
-        prog = self._recurse_program(ecap, csr.rows_per, fcap, depth,
-                                     allow_loop)
+        erank, rrank = self._dense_maps(csr, tgt)
+        devsets = [self._dense_set_mask(s, tgt) for s in (sets or [])]
+        prog = self._recurse_prog((ecap, csr.rows_per, nd, fcap0, depth,
+                                   allow_loop, formula, len(devsets)))
         with otrace.span("device_kernel", kernel="mesh.recurse",
-                         depth=depth, devices=self.n_devices,
-                         fcap=fcap) as sp:
+                         depth=depth, devices=self.n_devices) as sp:
             with self.mesh:
-                frs, fresh, trav = prog(
-                    csr.sharded.subjects, csr.sharded.indptr,
-                    csr.sharded.indices, _edge_rows(csr),
-                    jnp.asarray(pad_frontier(seeds, fcap)))
-            frs, fresh, trav = jax.device_get((frs, fresh, trav))
+                masks, tots = prog(
+                    csr.sharded.subjects, _edge_rows(csr), erank, rrank,
+                    *devsets, jnp.asarray(pad_frontier(seeds, fcap0)))
+            masks, tots = jax.device_get((masks, tots))
             self._c_dispatch.inc()
             self._c_hops.inc(depth)
             levels = []
+            frontier = seeds
             total = 0
             for lvl in range(depth):
-                frontier = seeds if lvl == 0 else \
-                    frs[lvl][frs[lvl] != int(SNT)].astype(np.int64)
-                matrix = self._fresh_matrix(csr, frontier, fresh[lvl])
-                dest = (np.unique(np.concatenate(matrix))
-                        if any(len(m) for m in matrix)
-                        else np.zeros(0, np.int64))
-                t = int(trav[lvl])
-                total += t
-                otrace.event("mesh_hop", hop=lvl, edges=t,
-                             frontier=len(frontier), dest=int(len(dest)))
-                levels.append((frontier, matrix,
-                               [len(m) for m in matrix], dest, t))
+                trav = int(tots[lvl])
+                total += trav
+                otrace.event("mesh_hop", hop=lvl, edges=trav,
+                             frontier=len(frontier))
+                levels.append((frontier, trav))
+                frontier = tgt[masks[lvl]].astype(np.int64)
             self._c_edges.inc(total)
             if sp:
                 sp.set(edges=total)
         return levels
 
-    @staticmethod
-    def _fresh_matrix(csr: DistPredCSR, frontier: np.ndarray,
-                      fresh: np.ndarray) -> list[np.ndarray]:
-        """Per-source fresh-target lists for one recurse level: slice each
-        frontier row's global CSR span and keep the positions the device
-        flagged fresh (fresh is [S, ecap] in shard-local padded edge
-        space; shard s's local edge e maps to global edge_lo[s] + e)."""
-        subjects, indptr, indices = csr.host_arrays()
-        out: list[np.ndarray] = []
-        for u in frontier.tolist():
-            r = int(np.searchsorted(subjects, u))
-            if r >= len(subjects) or subjects[r] != u:
-                out.append(np.zeros(0, np.int64))
-                continue
-            g0, g1 = int(indptr[r]), int(indptr[r + 1])
-            s = r // csr.rows_per
-            l0 = g0 - int(csr.edge_lo[s])
-            keep = fresh[s, l0: l0 + (g1 - g0)]
-            out.append(indices[g0:g1][keep].astype(np.int64))
-        return out
+    # -- fused shortest-path BFS: the whole expandOut loop, ONE dispatch -----
 
+    def bfs_targets(self, csrs: list[DistPredCSR]) -> np.ndarray:
+        """Combined sorted distinct-target table of a multi-predicate
+        traversal — the rank space of the BFS distance vector (cached per
+        CSR identity tuple)."""
+        key = tuple(id(c) for c in csrs)
+        hit = self._bfs_tgt.get(key)
+        if hit is not None and all(a is b for a, b in zip(hit[0], csrs)):
+            self._bfs_tgt.move_to_end(key)
+            return hit[1]
+        tgt = (np.unique(np.concatenate(
+            [_target_table(c) for c in csrs]))
+            if csrs else np.zeros(0, np.int32))
+        self._bfs_tgt[key] = (tuple(csrs), tgt)
+        while len(self._bfs_tgt) > 64:
+            self._bfs_tgt.popitem(last=False)
+        return tgt
+
+    BFS_UNREACHED = np.int32(np.iinfo(np.int32).max)
+
+    def _bfs_program(self, shapes: tuple, nd: int):
+        """shapes: per pred (ecap, rows_per)."""
+        key = ("bfs", shapes, nd)
+        prog = self._progs.get(key)
+        if prog is not None:
+            return prog
+        self._c_compiles.inc()
+        mesh = self.mesh
+        P_n = len(shapes)
+
+        def run(*args):
+            csr_args = args[: 4 * P_n]    # per pred: sub, erow, erank, rrank
+            vis0, dist0, src, maxd, budget, stop = args[4 * P_n:]
+
+            acts0 = []
+            for p in range(P_n):
+                sub = csr_args[4 * p]
+                rows_per = shapes[p][1]
+                pos = jnp.searchsorted(sub[0], src).astype(jnp.int32)
+                posc = jnp.clip(pos, 0, rows_per - 1)
+                ok = jnp.take(sub[0], posc) == src
+                acts0.append(jnp.zeros((rows_per + 1,), bool).at[
+                    jnp.where(ok, posc, rows_per + 1)].set(
+                    True, mode="drop"))
+
+            def cond(c):
+                _acts, vis, _d, hop, edges, live = c
+                # stop >= 0: single-path callers exit once the target's
+                # level completes (its whole predecessor level is
+                # discovered by then — reference stopExpansion,
+                # query/shortest.go); stop < 0 explores exhaustively
+                # (k-shortest needs the full level adjacency)
+                found = (stop >= 0) & jnp.take(
+                    vis, jnp.clip(stop, 0, max(nd - 1, 0)), mode="clip")
+                return live & (hop < maxd) & (edges <= budget) & ~found
+
+            def body(c):
+                acts, vis, dist, hop, edges = c[:5]
+                contrib = jnp.zeros((nd + 1,), jnp.int32)
+                for p in range(P_n):
+                    erow, erank = csr_args[4 * p + 1], csr_args[4 * p + 2]
+                    ae = jnp.take(acts[p], erow[0])
+                    contrib = contrib.at[
+                        jnp.where(ae, erank[0], nd)].add(1, mode="drop")
+                    contrib = contrib.at[nd].add(
+                        jnp.sum(ae, dtype=jnp.int32))
+                tot = lax.psum(contrib, "shard")           # ICI hop
+                gmask = tot[:nd] > 0
+                fresh = gmask & ~vis
+                vis2 = vis | gmask
+                dist2 = jnp.where(fresh, hop + 1, dist)
+                fext = jnp.concatenate([fresh, jnp.zeros(1, bool)])
+                acts2 = tuple(
+                    jnp.concatenate([
+                        jnp.take(fext, jnp.clip(
+                            csr_args[4 * p + 3][0], 0, nd)),
+                        jnp.zeros(1, bool)])
+                    for p in range(P_n))
+                return (acts2, vis2, dist2, hop + 1,
+                        edges + tot[nd], jnp.any(fresh))
+
+            init = (tuple(acts0), vis0, dist0, jnp.int32(0),
+                    jnp.int32(0), jnp.bool_(True))
+            _a, vis, dist, hop, edges, _l = lax.while_loop(
+                cond, body, init)
+            return dist, hop, edges
+
+        in_specs = (P("shard"),) * (4 * P_n) + (P(),) * 6
+        # visited / distance carries are donated: the whole while_loop
+        # reuses their HBM between hops instead of re-allocating per
+        # level (the 12-dispatch loop's per-hop cost)
+        prog = jax.jit(shard_map(
+            run, mesh=mesh, in_specs=in_specs,
+            out_specs=(P(), P(), P()), check_rep=False),
+            donate_argnums=(4 * P_n, 4 * P_n + 1))
+        self._progs[key] = prog
+        return prog
+
+    def run_bfs(self, csrs: list[DistPredCSR], src: int, max_depth: int,
+                budget: int, stop_at: int | None = None):
+        """The whole shortest-path expandOut loop (query/shortest.go:134)
+        as ONE `lax.while_loop` dispatch: frontier masks, visited set,
+        and distance vector stay device-resident between hops — 12
+        stepped dispatches (or 12 gRPC rounds per group) become one
+        launch.
+
+        Returns (dist, hops, edges): dist[i] is the BFS level at which
+        the combined target table's i-th uid was first reached (UNREACHED
+        otherwise), hops the number of levels executed, edges the raw
+        traversed-edge total — everything the host needs to rebuild the
+        level adjacency byte-identically (query/shortest.py)."""
+        tgt = self.bfs_targets(csrs)
+        nd = len(tgt)
+        if nd == 0:
+            return (np.zeros(0, np.int32), 0, 0)
+        shapes = tuple((int(c.sharded.indices.shape[-1]), c.rows_per)
+                       for c in csrs)
+        prog = self._bfs_program(shapes, nd)
+        vis = np.zeros(nd, dtype=bool)
+        dist = np.full(nd, int(self.BFS_UNREACHED), dtype=np.int32)
+        pos = int(np.searchsorted(tgt, src))
+        if pos < nd and tgt[pos] == src:
+            vis[pos] = True
+            dist[pos] = 0
+        args = []
+        for c in csrs:
+            erank, rrank = self._dense_maps(c, tgt)
+            args += [c.sharded.subjects, _edge_rows(c), erank, rrank]
+        stop_rank = -1
+        if stop_at is not None:
+            sp_ = int(np.searchsorted(tgt, stop_at))
+            if sp_ < nd and tgt[sp_] == stop_at:
+                stop_rank = sp_
+        args += [jnp.asarray(vis), jnp.asarray(dist),
+                 jnp.int32(min(src, int(SNT))), jnp.int32(max_depth),
+                 jnp.int32(min(budget, (1 << 30))),
+                 jnp.int32(stop_rank)]
+        with otrace.span("device_kernel", kernel="mesh.bfs",
+                         devices=self.n_devices, preds=len(csrs),
+                         nd=nd) as sp:
+            with self.mesh:
+                dist_d, hops_d, edges_d = prog(*args)
+            dist_h, hops_h, edges_h = jax.device_get(
+                (dist_d, hops_d, edges_d))
+            self._c_dispatch.inc()
+            self._c_hops.inc(int(hops_h))
+            self._c_edges.inc(int(edges_h))
+            otrace.event("mesh_hop", hop=int(hops_h),
+                         edges=int(edges_h))
+            if sp:
+                sp.set(edges=int(edges_h), hops=int(hops_h))
+        return dist_h, int(hops_h), int(edges_h)
     # -- sharded vector top-k: row-scan fan-out, replicated merge ------------
 
     def _vec_program(self, rows_per: int, dim: int, kk: int, metric: str):
         key = ("vec", rows_per, dim, kk, metric)
-        prog = self._step_progs.get(key)
+        prog = self._progs.get(key)
         if prog is not None:
             return prog
         self._c_compiles.inc()
@@ -483,7 +860,7 @@ class MeshExecutor:
             run, mesh=mesh,
             in_specs=(P("shard"), P("shard"), P("shard"), P()),
             out_specs=(P(), P()), check_rep=False))
-        self._step_progs[key] = prog
+        self._progs[key] = prog
         return prog
 
     def _vec_sharded(self, vi):
@@ -540,113 +917,3 @@ class MeshExecutor:
             if sp:
                 sp.set(cands=int((scores_h > -np.inf).sum()))
         return rows_h[scores_h > -np.inf]
-
-    # -- stepped traversal: device-staged frontier (shortest / k-shortest) --
-
-    def _step_program(self, ecap: int, fcap: int, nd: int):
-        """One visited-gated collective hop; the visited set lives in
-        DST-RANK space (position in the tablet's sorted distinct-target
-        table, `nd` entries) — O(tablet), never O(uid-space): a long-lived
-        cluster's monotonic uid leases must not inflate per-query state."""
-        key = ("step", ecap, fcap, nd)
-        prog = self._step_progs.get(key)
-        if prog is not None:
-            return prog
-        self._c_compiles.inc()
-        mesh = self.mesh
-
-        def run(sub, ptr, idx, tgt, fr, visited):
-            rows = _local_rows(sub[0], fr)
-            res = expand(ptr[0], idx[0], rows, ecap)
-            tot = lax.psum(res.total.astype(jnp.int32), "shard")
-            dest = _dedup_sorted(jnp.sort(res.targets))
-            gathered = lax.all_gather(dest, "shard")       # ICI hop
-            merged = _dedup_sorted(jnp.sort(gathered.reshape(-1)))[:fcap]
-            # every real merged uid IS a target, so its rank is exact
-            pos = jnp.clip(jnp.searchsorted(tgt, merged), 0,
-                           max(nd - 1, 0)).astype(jnp.int32)
-            real = merged != SNT
-            seen = jnp.take(visited, pos, mode="clip") & real
-            fresh = jnp.sort(jnp.where(seen | ~real, SNT, merged))
-            fpos = jnp.clip(jnp.searchsorted(tgt, fresh), 0,
-                            max(nd - 1, 0)).astype(jnp.int32)
-            visited2 = visited.at[
-                jnp.where(fresh == SNT, nd, fpos)].set(True, mode="drop")
-            return res.counts[None, :], res.targets[None, :], fresh, \
-                visited2, tot
-
-        prog = jax.jit(shard_map(
-            run, mesh=mesh,
-            in_specs=(P("shard"), P("shard"), P("shard"), P(), P(), P()),
-            out_specs=(P("shard"), P("shard"), P(), P(), P()),
-            check_rep=False))
-        self._step_progs[key] = prog
-        return prog
-
-    def start_traversal(self, csr: DistPredCSR,
-                        seeds: np.ndarray) -> "MeshTraversal":
-        return MeshTraversal(self, csr, seeds)
-
-
-class MeshTraversal:
-    """Visited-gated level-synchronous frontier iteration with the frontier
-    AND the visited set staged on device between hops: each step is one
-    dispatch whose inputs are the previous step's device outputs — no
-    re-upload of seeds, no per-group RPC. This is `shortest` /
-    `KShortestPath`'s expandOut loop (query/shortest.go:134) with the
-    per-level gRPC scatter-gather replaced by one collective step."""
-
-    def __init__(self, ex: MeshExecutor, csr: DistPredCSR,
-                 seeds: np.ndarray) -> None:
-        self.ex = ex
-        self.csr = csr
-        seeds = np.unique(np.asarray(seeds, dtype=np.int64))
-        self.frontier = seeds
-        tgt = _target_table(csr)
-        self.nd = len(tgt)
-        self.fcap = _fcap_for(max(len(seeds), self.nd))
-        self.ecap = int(csr.sharded.indices.shape[-1])
-        tdev = getattr(csr, "_targets_dev", None)
-        if tdev is None:
-            tdev = csr._targets_dev = jnp.asarray(tgt)
-        self._tgt_dev = tdev
-        self._fr_dev = jnp.asarray(pad_frontier(seeds, self.fcap))
-        # visited in DST-RANK space: a seed that is never a target cannot
-        # reappear in any frontier, so only seed-ranks present in the
-        # target table need marking
-        v = np.zeros(max(self.nd, 1), dtype=bool)
-        if self.nd:
-            pos = np.searchsorted(tgt, seeds)
-            posc = np.clip(pos, 0, self.nd - 1)
-            v[posc[tgt[posc] == seeds]] = True
-        self._visited_dev = jnp.asarray(v[: self.nd]) if self.nd \
-            else jnp.zeros((0,), bool)
-
-    def step(self):
-        """One collective hop. Returns (matrix keyed to the current
-        frontier, next unvisited frontier as host uids, traversed edge
-        count); afterwards `self.frontier` is the next frontier."""
-        ex = self.ex
-        F = len(self.frontier)
-        prog = ex._step_program(self.ecap, self.fcap, self.nd)
-        with otrace.span("device_kernel", kernel="mesh.step",
-                         devices=ex.n_devices, frontier=F) as sp:
-            with ex.mesh:
-                counts, targets, fresh, visited2, tot = prog(
-                    self.csr.sharded.subjects, self.csr.sharded.indptr,
-                    self.csr.sharded.indices, self._tgt_dev, self._fr_dev,
-                    self._visited_dev)
-            counts_h, targets_h, fresh_h, tot_h = jax.device_get(
-                (counts, targets, fresh, tot))
-            ex._c_dispatch.inc()
-            ex._c_hops.inc(1)
-            ex._c_edges.inc(int(tot_h))
-            if sp:
-                sp.set(edges=int(tot_h))
-        matrix = assemble_matrix(counts_h, targets_h, F)
-        # stage: the device fresh frontier + visited feed the next step
-        self._fr_dev, self._visited_dev = fresh, visited2
-        self.frontier = fresh_h[fresh_h != int(SNT)].astype(np.int64)
-        if len(self.frontier) == self.fcap:
-            raise MeshCapacityError("frontier hit capacity")
-        return matrix, self.frontier, int(tot_h)

@@ -100,6 +100,16 @@ class Executor:
         # UID blocks) instead of one dispatch per hop; recurse/shortest
         # consult it too. None = classic per-task dispatch only.
         self.mesh = mesh
+        # fused-coverage accounting (ISSUE 12): per query, how many fused
+        # mesh programs ran, how many labeled fallbacks were recorded,
+        # and whether mesh-owned tablets were touched at all — execute()
+        # folds the three into the mesh executor's coverage ratio. A
+        # single-task serve of a mesh tablet (one expansion, one count
+        # read) is already at minimal dispatch count, so it counts as
+        # covered; only labeled fallbacks mark a query unfused.
+        self._mesh_fused = 0
+        self._mesh_misses = 0
+        self._mesh_touched = False
         self.vars: dict[str, VarValue] = {}
         self.traversed_edges = 0
         self.sort_index_buckets = -1  # sortWithIndex instrumentation
@@ -255,7 +265,21 @@ class Executor:
             if b.gq.attr == "var":
                 continue
             encode_result(self, b, out)
+        if self.mesh is not None and (self._mesh_fused or
+                                      self._mesh_misses or
+                                      self._mesh_touched):
+            # mesh-relevant query: its traversals ran fused / at minimal
+            # dispatch count, or it recorded labeled fallbacks — the
+            # ratio of the two counters is the fused-coverage number the
+            # /debug/metrics mesh section shows
+            self.mesh.note_query(self._mesh_misses == 0)
         return out
+
+    def _mesh_miss(self, reason: str) -> None:
+        """One labeled fused-coverage miss for this query."""
+        self._mesh_misses += 1
+        if self.mesh is not None:
+            self.mesh.fallback(reason)
 
     # ---------------------------------------------------------------- blocks
 
@@ -408,8 +432,8 @@ class Executor:
         gq = sg.gq
         frontier = np.sort(sg.dest_uids)
         eff = self._effective_children(gq, frontier)
-        if self.mesh is not None and len(eff) == 1 and len(frontier) and \
-                self._mesh_fused_chain(sg, eff[0], frontier):
+        if self.mesh is not None and len(frontier) and \
+                self._mesh_fused_plan(sg, eff, frontier):
             return
         order = None
         if self.plan is not None:
@@ -420,53 +444,69 @@ class Executor:
         seq = [(i, eff[i]) for i in order] if order is not None \
             else list(enumerate(eff))
         for slot, cgq in seq:
-            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-            slots[slot] = child
             if cgq.is_uid_node or cgq.attr in ("val", "math") or \
                cgq.attr.startswith("__agg_"):
+                child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
                 self._compute_virtual_child(sg, child, frontier)
+                slots[slot] = child
                 continue
-            tq = TaskQuery(cgq.attr, frontier=frontier, lang=cgq.lang,
-                           facet_keys=[k for _, k in (cgq.facets.keys if cgq.facets else [])]
-                           if cgq.facets is not None else [])
-            if cgq.facets is not None:
-                tq.facet_keys = tq.facet_keys or ["__all__"]
-            if self.plan is not None:
-                # estimated-frontier-size-driven host/device dispatch
-                # cutover (0 = the static task.HOST_EXPAND_MAX default)
-                tq.cutover = self.plan.cutover.get(id(cgq), 0)
-            res = self._dispatch(tq)
-            if self.plan is not None:
-                self.plan.record(cgq, res.traversed_edges, self.explain)
-            self.traversed_edges += res.traversed_edges
-            if self.traversed_edges > self.edge_budget():
-                raise QueryError("query exceeded edge budget (ErrTooBig)")
-            if cgq.checkpwd:
-                # checkpwd(pwd, "cand"): stored password -> bool per uid
-                # (query/outputnode.go checkPwd)
-                from dgraph_tpu.utils.types import verify_password
-                res.value_matrix = [
-                    [Val(TypeID.BOOL,
-                         bool(vs) and verify_password(cgq.checkpwd,
-                                                      str(vs[0].value)))]
-                    for vs in res.value_matrix]
-            child.uid_matrix = res.uid_matrix
-            child.value_matrix = res.value_matrix
-            child.facet_matrix = res.facet_matrix
-            child.counts = res.counts
-            child.dest_uids = res.dest_uids
-            child.traversed = res.traversed_edges
-            # facet filter prunes matrix entries
-            if cgq.facets is not None and cgq.facets.filter is not None:
-                self._apply_facet_filter(child)
-            # child-level @filter + pagination act per uidMatrix row
-            if child.uid_matrix and (cgq.filter is not None or
-                                     cgq.args.get("first") or cgq.args.get("offset")):
-                self._apply_child_row_mods(child)
-            self._record_child_vars(cgq, child, frontier)
+            child = self._run_child_task(cgq, frontier)
+            slots[slot] = child
             if cgq.children or cgq.cascade:
                 self._finish_level(child, is_root=False)
         sg.children.extend(c for c in slots if c is not None)
+
+    def _run_child_task(self, cgq: dql.GraphQuery,
+                        frontier: np.ndarray) -> SubGraph:
+        """One non-virtual child level through the dispatch seam: expand /
+        value fetch, facet filter, per-row filter+pagination, var
+        recording — the classic per-task loop body, shared with the fused
+        plan's co-children (which ride a fused traversal's frontiers but
+        keep the exact classic semantics)."""
+        child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
+        if self.mesh is not None and self._mesh_hop_csr(cgq) is not None:
+            # a one-task serve of a mesh-owned tablet: already at the
+            # minimal dispatch count, covered for the coverage ratio
+            self._mesh_touched = True
+        tq = TaskQuery(cgq.attr, frontier=frontier, lang=cgq.lang,
+                       facet_keys=[k for _, k in (cgq.facets.keys if cgq.facets else [])]
+                       if cgq.facets is not None else [])
+        if cgq.facets is not None:
+            tq.facet_keys = tq.facet_keys or ["__all__"]
+        if self.plan is not None:
+            # estimated-frontier-size-driven host/device dispatch
+            # cutover (0 = the static task.HOST_EXPAND_MAX default)
+            tq.cutover = self.plan.cutover.get(id(cgq), 0)
+        res = self._dispatch(tq)
+        if self.plan is not None:
+            self.plan.record(cgq, res.traversed_edges, self.explain)
+        self.traversed_edges += res.traversed_edges
+        if self.traversed_edges > self.edge_budget():
+            raise QueryError("query exceeded edge budget (ErrTooBig)")
+        if cgq.checkpwd:
+            # checkpwd(pwd, "cand"): stored password -> bool per uid
+            # (query/outputnode.go checkPwd)
+            from dgraph_tpu.utils.types import verify_password
+            res.value_matrix = [
+                [Val(TypeID.BOOL,
+                     bool(vs) and verify_password(cgq.checkpwd,
+                                                  str(vs[0].value)))]
+                for vs in res.value_matrix]
+        child.uid_matrix = res.uid_matrix
+        child.value_matrix = res.value_matrix
+        child.facet_matrix = res.facet_matrix
+        child.counts = res.counts
+        child.dest_uids = res.dest_uids
+        child.traversed = res.traversed_edges
+        # facet filter prunes matrix entries
+        if cgq.facets is not None and cgq.facets.filter is not None:
+            self._apply_facet_filter(child)
+        # child-level @filter + pagination act per uidMatrix row
+        if child.uid_matrix and (cgq.filter is not None or
+                                 cgq.args.get("first") or cgq.args.get("offset")):
+            self._apply_child_row_mods(child)
+        self._record_child_vars(cgq, child, frontier)
+        return child
 
     # ----------------------------------------------------- fused ANN pipeline
 
@@ -661,8 +701,8 @@ class Executor:
 
     # ------------------------------------------------------------- mesh mode
 
-    def _mesh_chain_csr(self, cgq: dql.GraphQuery):
-        """The mesh-sharded adjacency a chain node expands over, or None."""
+    def _mesh_hop_csr(self, cgq: dql.GraphQuery):
+        """The mesh-sharded adjacency a chain hop expands over, or None."""
         attr = cgq.attr
         rev = attr.startswith("~")
         pd = self.snap.pred(attr[1:] if rev else attr)
@@ -671,70 +711,137 @@ class Executor:
         csr = pd.rev_csr if rev else pd.csr
         return csr if (csr is not None and self.mesh.owns(csr)) else None
 
-    def _mesh_chain_ok(self, cgq: dql.GraphQuery) -> bool:
-        """A chain node is a PLAIN uid expansion: anything that needs host
-        logic between hops (filters, facets, pagination, lang, cascade,
-        count/val/math pseudo-attrs) breaks the fusion and falls back to
-        the classic per-hop dispatch — results are identical either way."""
-        if cgq.expand or cgq.is_uid_node or cgq.is_count or cgq.checkpwd:
-            return False
-        if cgq.attr in ("val", "math") or cgq.attr.startswith("__agg_"):
-            return False
-        if cgq.filter is not None or cgq.facets is not None:
-            return False
-        if cgq.lang or cgq.cascade or cgq.groupby is not None or cgq.order:
-            return False
-        if cgq.args.get("first") or cgq.args.get("offset") \
-                or cgq.args.get("after"):
-            return False
-        return self._mesh_chain_csr(cgq) is not None
+    def _mesh_break_reason(self, cgq: dql.GraphQuery) -> str | None:
+        """Why an UNOWNED tablet broke the chain — labeled only when the
+        tablet would have been mesh-class: a delta overlay awaiting
+        compaction, or shards the working-set manager declined to admit.
+        Small replicated tablets break chains silently (host-class by
+        design, not a coverage gap)."""
+        from dgraph_tpu.query import fusedplan as fp
+        from dgraph_tpu.storage.delta import OverlayCSR
 
-    def _mesh_fused_chain(self, sg: SubGraph, c0: dql.GraphQuery,
-                          frontier: np.ndarray) -> bool:
-        """Fuse a pure expansion chain (p0 { p1 { p2 … } }) into ONE mesh
-        dispatch (parallel/mesh_exec.run_chain): N hops crossing N
-        predicate shards cost one device program whose only inter-device
-        traffic is the per-hop ICI all-gather of frontier UID blocks —
-        instead of N×hops dispatches (or gRPC round trips on the wire
-        path). Returns False when the shape doesn't qualify; the caller
-        runs the classic loop, byte-identical."""
-        from dgraph_tpu.parallel.mesh_exec import MeshCapacityError
+        attr = cgq.attr
+        rev = attr.startswith("~")
+        pd = self.snap.pred(attr[1:] if rev else attr)
+        csr = (pd.rev_csr if rev else pd.csr) if pd is not None else None
+        if isinstance(csr, OverlayCSR):
+            return fp.REASON_OVERLAY
+        if getattr(csr, "_mesh_deferred", False):
+            return fp.REASON_BUDGET
+        return None
 
-        chain: list[dql.GraphQuery] = []
-        node = c0
-        while self._mesh_chain_ok(node):
-            chain.append(node)
-            if len(node.children) != 1:
+    def _mesh_fused_plan(self, sg: SubGraph, eff: list,
+                         frontier: np.ndarray) -> bool:
+        """Execute the whole physical plan below this level as ONE mesh
+        dispatch (parallel/mesh_exec.run_plan): the expansion chain WITH
+        its pointwise filters (allow-set membership formulas) and per-row
+        pagination windows runs fused; facet reads, value-predicate
+        co-children, count children, and virtual nodes layer host-side on
+        the fused traversal's per-level frontiers (query/fusedplan.py).
+        Returns False when the shape doesn't qualify — the caller runs
+        the classic loop, byte-identical — recording the labeled
+        fallback reason whenever the miss actually cost fusion."""
+        from dgraph_tpu.query import fusedplan as fp
+
+        gq = sg.gq
+        if any(c.expand for c in gq.children):
+            return False        # expand() reshaped eff: classic handles
+        ir = None
+        if self.plan is not None:
+            ir = self.plan.fused_chains.get(id(gq))
+        if ir is None:
+            ir = fp.chain_ir(gq, self.schema)
+        # execution-time narrowing: the IR is AST-shaped; ownership
+        # (sharded vs replicated vs overlay vs residency-deferred)
+        # truncates the chain here
+        hops: list[fp.HopIR] = []
+        csrs: list = []
+        reason = ir.stop_reason if ir.stop_cost else None
+        for hop in ir.hops:
+            csr = self._mesh_hop_csr(hop.gq)
+            if csr is None:
+                r = self._mesh_break_reason(hop.gq)
+                if r is not None and (hops or
+                                      fp._subtree_has_expansion(
+                                          hop.gq, self.schema)):
+                    reason = reason or r
                 break
-            node = node.children[0]
-        if len(chain) < 2:
+            hops.append(hop)
+            csrs.append(csr)
+        if hops:
+            self._mesh_touched = True
+        if len(hops) < 2:
+            if reason is not None:
+                self._mesh_miss(reason)
             return False
-        csrs = [self._mesh_chain_csr(c) for c in chain]
         try:
-            levels = self.gated(
-                lambda: self.mesh.run_chain(csrs, frontier),
-                klass="mesh")
-        except MeshCapacityError:
-            self.mesh.metrics.counter(
-                "dgraph_mesh_fallbacks_total").inc()
+            sets = [fp.resolve_sets(self, hop) for hop in hops]
+        except Exception:
+            # a leaf whose resolution raises (missing index, bad args)
+            # goes classic: the stepped path raises the same typed error
+            # at the same filter — or never reaches it on an empty
+            # frontier, which is exactly the semantics to preserve
+            self._mesh_miss(fp.REASON_FILTER)
             return False
+        levels = self.gated(
+            lambda: self.mesh.run_plan(
+                [(c, h.formula, s, h.first, h.offset)
+                 for c, h, s in zip(csrs, hops, sets)], frontier),
+            klass="mesh")
+        self._mesh_fused += 1
         parent = sg
-        for cgq, (fr, matrix, counts, dest, traversed) in zip(chain, levels):
-            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=fr)
-            child.uid_matrix = matrix
-            child.counts = counts
-            child.dest_uids = dest
-            child.traversed = traversed
+        fr = frontier
+        for i, (hop, csr, hsets) in enumerate(zip(hops, csrs, sets)):
+            _fr_in, traversed, nxt = levels[i]
+            # host replay: pruned uidMatrix rows from the host mirrors
+            # with the SAME allow-sets/windows the device applied —
+            # byte-identical to _apply_child_row_mods by construction
+            matrix, counts, dest, _raw = fp.replay_hop(csr, fr, hop,
+                                                       hsets)
+            fused = SubGraph(gq=hop.gq, attr=hop.gq.attr, src_uids=fr)
+            fused.uid_matrix = matrix
+            fused.counts = counts
+            fused.dest_uids = dest
+            fused.traversed = traversed
+            if hop.facets:
+                rev = hop.attr.startswith("~")
+                pd = self.snap.pred(hop.attr[1:] if rev else hop.attr)
+                fused.facet_matrix = [
+                    [pd.facets.get((int(s_), int(o)), ()) for o in m]
+                    for s_, m in zip(fr, matrix)]
             if self.plan is not None:
-                self.plan.record(cgq, traversed, self.explain)
+                self.plan.record(hop.gq, traversed, self.explain)
             self.traversed_edges += traversed
             if self.traversed_edges > self.edge_budget():
                 raise QueryError("query exceeded edge budget (ErrTooBig)")
-            self._record_child_vars(cgq, child, fr)
-            parent.children.append(child)
-            parent = child
-        # the last chain node's own (non-chain) subtree continues classic
-        if chain[-1].children or chain[-1].cascade:
+            # this level's children in DECLARATION order, the fused hop
+            # attached at its slot with vars recorded at that point —
+            # exactly the classic walk's binding order
+            level_children = eff if parent is sg else parent.gq.children
+            for cgq in level_children:
+                if cgq is hop.gq:
+                    self._record_child_vars(cgq, fused, fr)
+                    parent.children.append(fused)
+                    continue
+                if cgq.is_uid_node or cgq.attr in ("val", "math") or \
+                        cgq.attr.startswith("__agg_"):
+                    vchild = SubGraph(gq=cgq, attr=cgq.attr, src_uids=fr)
+                    self._compute_virtual_child(parent, vchild, fr)
+                    parent.children.append(vchild)
+                    continue
+                co = self._run_child_task(cgq, fr)
+                parent.children.append(co)
+                if cgq.children or cgq.cascade:
+                    self._finish_level(co, is_root=False)
+            parent = fused
+            fr = np.sort(dest)
+            if not np.array_equal(fr, nxt):
+                # defense in depth: the device frontier disagreeing with
+                # the host replay would mean a program bug — the host
+                # mirrors are the truth the classic path serves from
+                raise QueryError("mesh fused frontier diverged")
+        # the last chain hop's own subtree (and @cascade) continues classic
+        if hops[-1].gq.children or hops[-1].gq.cascade:
             self._finish_level(parent, is_root=False)
         return True
 

@@ -105,6 +105,11 @@ class Plan:
         self.root_swap: dict[int, RootSwap] = {}
         self.child_order: dict[int, list[int]] = {}
         self.cutover: dict[int, int] = {}
+        # fusable-step IR (ISSUE 12, query/fusedplan.py): the maximal
+        # mesh-fusable chain below each block level, compiled from the
+        # AST once and cached with the plan — mesh-mode engines consume
+        # it instead of re-walking the tree per query
+        self.fused_chains: dict[int, object] = {}
         self.tree: list[dict] = []
         self.pred_stats: dict[str, dict] = {}   # EXPLAIN stats header
 
@@ -371,6 +376,10 @@ def _plan_block(plan: Plan, gq, snap, schema, metrics, trace,
     if first > 0:
         dest_est = min(dest_est, int(gq.args.get("offset", 0)) + first)
     # -- children ------------------------------------------------------------
+    if gq.recurse is None and gq.shortest is None and gq.children:
+        from dgraph_tpu.query import fusedplan
+
+        plan.fused_chains[id(gq)] = fusedplan.chain_ir(gq, schema)
     children = _plan_children(plan, gq, snap, schema, metrics, trace,
                               max(dest_est, 1))
     return {"block": gq.alias or gq.attr or "q",

@@ -242,16 +242,44 @@ def recurse(ex, sg: SubGraph) -> None:
             _recurse_fused_path(ex, sg, cgq, csr, depth, spec.allow_loop)
             ex._record_uid_var(gq, sg)
             return
-        mesh = getattr(ex, "mesh", None)
-        if mesh is not None and mesh.owns(csr):
-            # MESH FUSED PATH: all levels in one shard_map dispatch, the
-            # per-level frontier exchanged as ICI all-gathered UID blocks
-            # (parallel/mesh_exec.run_recurse) — instead of one mesh (or
-            # gRPC) dispatch per level
-            _mesh_recurse_path(ex, sg, cgq, csr, depth, spec.allow_loop,
-                               mesh)
-            ex._record_uid_var(gq, sg)
-            return
+    # ---- mesh fused path: single uid child, filters compile to allow-set
+    # formulas, value children layer host-side per level (ISSUE 12) ---------
+    mesh = getattr(ex, "mesh", None)
+    if mesh is not None and len(sg.dest_uids) and \
+            any(mesh.owns(_csr_for(c)) for c in uid_children):
+        from dgraph_tpu.query import fusedplan as fp
+
+        cgq = uid_children[0] if len(uid_children) == 1 else None
+        if cgq is None:
+            # multi-predicate recurse dedups edges in DEPTH-FIRST sibling
+            # order (build_level recursion) — inherently sequential, the
+            # one traversal shape the level-synchronous program can't hold
+            ex._mesh_miss(fp.REASON_MULTI_PRED)
+        elif depth > FUSED_MAX_DEPTH:
+            ex._mesh_miss(fp.REASON_DEPTH)
+        elif mesh.owns(_csr_for(cgq)):
+            csr = _csr_for(cgq)
+            formula = None
+            sets: list | None = None
+            ok = True
+            if cgq.filter is not None:
+                try:
+                    formula, leaves = fp.compile_filter(
+                        cgq.filter, ex.schema,
+                        fp._block_child_defines(gq))
+                    sets = [fp.resolve_leaf(ex, s) for s in leaves]
+                except fp.Unfusable as e:
+                    ex._mesh_miss(e.reason)
+                    ok = False
+                except Exception:
+                    ex._mesh_miss(fp.REASON_FILTER)
+                    ok = False
+            if ok:
+                _mesh_recurse_path(ex, sg, cgq, csr, depth,
+                                   spec.allow_loop, mesh, formula, sets,
+                                   val_children)
+                ex._record_uid_var(gq, sg)
+                return
 
     def build_level(frontier: np.ndarray, remaining: int) -> list[SubGraph]:
         nonlocal edges
@@ -342,30 +370,66 @@ def recurse(ex, sg: SubGraph) -> None:
 
 
 def _mesh_recurse_path(ex, sg: SubGraph, cgq, csr, depth: int,
-                       allow_loop: bool, mesh) -> None:
+                       allow_loop: bool, mesh, formula=None, sets=None,
+                       val_children=()) -> None:
     """All levels of a mesh-sharded recurse in ONE device dispatch: the
-    seen-edge vector lives per shard on device across levels and the
-    fresh dest blocks all-gather into the next frontier over ICI
-    (mesh_exec.run_recurse). SubGraph chain built exactly like the
-    stepped wire path's (attr, from, to)-dedup levels (equality-gated
-    by tests/test_mesh_exec.py)."""
-    seeds = np.sort(np.asarray(sg.dest_uids, dtype=np.int64))
+    seen-edge vector lives per shard on device across levels, the fresh
+    dest blocks all-gather into the next frontier over ICI, and the
+    child filter's allow-set formula narrows it device-side
+    (mesh_exec.run_recurse — only replicated frontiers and edge totals
+    come back). The SubGraph chain replays from the HOST mirrors
+    (_expand_dedup, the same vectorized gather the classic small-CSR
+    path runs), so matrices, filter narrowing, and value children are
+    byte-identical to build_level's depth recursion by construction."""
+    seeds = np.asarray(sg.dest_uids, dtype=np.int64)
     levels = ex.gated(lambda: mesh.run_recurse(csr, seeds, depth,
-                                               allow_loop), klass="mesh")
+                                               allow_loop, formula, sets),
+                      klass="mesh")
+    ex._mesh_fused += 1
+    seen = np.zeros(csr.num_edges, dtype=bool)
     attach = sg.children = []
     cum = 0
-    for frontier, matrix, counts, dest, traversed in levels:
-        if len(frontier) == 0:
+    frontier = seeds
+    for lvl in range(depth + 1):
+        fr_sorted = np.sort(frontier)
+        cur: list[SubGraph] = []
+        # value/scalar children appear at every level (build_level's
+        # per-invocation head), including the depth-exhausted tail
+        for vq in val_children:
+            vchild = SubGraph(gq=vq, attr=vq.attr, src_uids=fr_sorted)
+            res = ex._dispatch(TaskQuery(vq.attr, frontier=fr_sorted,
+                                         lang=vq.lang))
+            vchild.value_matrix = res.value_matrix
+            vchild.uid_matrix = res.uid_matrix
+            vchild.counts = res.counts
+            vchild.dest_uids = res.dest_uids
+            cur.append(vchild)
+        child = None
+        if depth - lvl > 0:
+            matrix, total = _expand_dedup(csr, fr_sorted, seen,
+                                          allow_loop)
+            cum += total
+            if cum > ex.edge_budget():
+                raise QueryError("recurse exceeded edge budget (ErrTooBig)")
+            child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=fr_sorted)
+            _set_list_result(child, matrix)
+            child.dest_uids = ex._apply_filter(cgq.filter,
+                                               child.dest_uids)
+            cur.append(child)
+            # cross-check the device program's frontier relay against
+            # the host replay (the host — which evaluates the REAL
+            # filter tree — stays authoritative, so a divergence means
+            # an allow-set resolver gap or a program bug: surfaced as a
+            # counter, never a wrong result)
+            if lvl + 1 < len(levels) and not np.array_equal(
+                    levels[lvl + 1][0], child.dest_uids):
+                mesh.metrics.counter(
+                    "dgraph_mesh_replay_divergence_total").inc()
+        attach.extend(cur)
+        if child is None or not len(child.dest_uids):
             break
-        cum += traversed
-        if cum > ex.edge_budget():
-            raise QueryError("recurse exceeded edge budget (ErrTooBig)")
-        child = SubGraph(gq=cgq, attr=cgq.attr, src_uids=frontier)
-        child.uid_matrix = matrix
-        child.counts = counts
-        child.dest_uids = dest
-        attach.append(child)
         attach = child.children
+        frontier = child.dest_uids
 
 
 def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,

@@ -12,9 +12,14 @@ on TPU the Pallas BFS kernel covers the whole device range
 (ops/pallas_bfs.bfs_dist: the whole hop loop in one dispatch, bit-packed
 distance fetch, host predecessor walk); ops/traversal.sssp edge relaxation
 remains the device path for extreme depths (>= 254 hops) and for non-TPU
-backends/tests. Facet-weighted costs, multi-predicate blocks, child
-filters, and k-shortest keep the exact host path: the expansion there is
-still batched CSR expands per level.
+backends/tests. MESH MODE (ISSUE 12): blocks over mesh-sharded tablets —
+multi-predicate included — run the whole expandOut loop as ONE
+`lax.while_loop` dispatch (mesh_exec.run_bfs) with frontier, visited set,
+and distance vector device-resident between hops; single paths
+reconstruct straight from the distance vector, k-shortest rebuilds the
+level adjacency from it. Facet-weighted costs and child filters keep the
+exact host path: the expansion there is still batched CSR expands per
+level.
 """
 
 from __future__ import annotations
@@ -25,7 +30,7 @@ import numpy as np
 
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import QueryError, SubGraph
-from dgraph_tpu.query.task import TaskQuery, process_task
+from dgraph_tpu.query.task import TaskQuery
 from dgraph_tpu.utils.types import TypeID
 
 
@@ -187,54 +192,163 @@ def _device_shortest(attr: str, csr, src: int, dst: int, max_depth: int):
     return (dist, path[::-1], [attr] * (len(path) - 1))
 
 
-def _mesh_csr(ex, sg: SubGraph):
-    """(attr, mesh-sharded CSR) when the block's expansion can iterate on
-    the mesh: one uid child, no filter/lang/facet cost — the same terms a
-    per-level wire expansion would need host logic for. Works for both
-    single and k-shortest (the adjacency feeds either)."""
+def _mesh_csrs(ex, sg: SubGraph):
+    """[(attr, mesh-sharded CSR)] when the block's whole expansion can run
+    as ONE fused BFS dispatch: every uid child (multi-predicate blocks
+    included — the level union is synchronous) free of filters, lang, and
+    facet cost keys, over tablets this mesh placed. Serves both single
+    and k-shortest (the rebuilt adjacency feeds either). Declines record
+    the labeled fallback reason when a mesh-owned tablet was involved."""
     mesh = getattr(ex, "mesh", None)
-    if mesh is None or len(sg.gq.children) != 1:
+    if mesh is None or not sg.gq.children:
         return None
-    cgq = sg.gq.children[0]
-    if cgq.filter is not None or cgq.lang:
-        return None
-    if cgq.facets is not None and cgq.facets.keys:
-        return None
-    rev = cgq.attr.startswith("~")
-    pd = ex.snap.pred(cgq.attr[1:] if rev else cgq.attr)
-    if pd is None:
-        return None
-    csr = pd.rev_csr if rev else pd.csr
-    if csr is None or not mesh.owns(csr):
-        return None
-    return cgq.attr, csr
+    from dgraph_tpu.query import fusedplan as fp
+
+    csrs = []
+    owned_any = False
+    reason = None
+    for cgq in sg.gq.children:
+        rev = cgq.attr.startswith("~")
+        pd = ex.snap.pred(cgq.attr[1:] if rev else cgq.attr)
+        csr = (pd.rev_csr if rev else pd.csr) if pd is not None else None
+        if csr is not None and mesh.owns(csr):
+            owned_any = True
+        elif csr is not None:
+            reason = reason or ex._mesh_break_reason(cgq) or fp.REASON_SHAPE
+        if cgq.filter is not None:
+            reason = reason or fp.REASON_FILTER
+        elif cgq.lang:
+            reason = reason or fp.REASON_LANG
+        elif cgq.facets is not None and cgq.facets.keys:
+            reason = reason or fp.REASON_FACET
+        csrs.append((cgq.attr, csr))
+    if reason is None and owned_any and \
+            all(c is not None and mesh.owns(c) for _a, c in csrs):
+        return csrs
+    if owned_any and reason is not None:
+        ex._mesh_miss(reason)
+    return None
 
 
-def _mesh_adjacency(ex, sg: SubGraph, attr: str, csr, src: int):
-    """expandOut's level loop (query/shortest.go:134) as mesh collective
-    steps: the frontier AND the visited set stay staged on device between
-    hops (mesh_exec.MeshTraversal) — each level is one dispatch whose only
-    inter-device traffic is the ICI all-gather of frontier UID blocks,
-    instead of one gRPC round trip per level per group. Adjacency/cost
-    semantics identical to _build_adjacency (cost 1.0, all targets
-    recorded, unvisited targets advance the frontier)."""
+def _mesh_shortest_single(ex, sg: SubGraph, csrs, src: int, dst: int):
+    """Single shortest path from ONE fused BFS dispatch, reconstructed
+    straight from the distance vector — no adjacency dict, no host
+    Dijkstra. With unit edge costs (the mesh path rejects facet costs)
+    Dijkstra's prev[x] is exactly the MINIMUM-uid predecessor at
+    dist[x]-1 (all dist-(d-1) nodes pop before any dist-d node, in uid
+    order), and its recorded attr is the FIRST child predicate holding
+    that edge — both derivable from dist + the host CSR mirrors. The
+    program early-exits once the destination's level completes
+    (reference stopExpansion, query/shortest.go): levels beyond
+    dist[dst] cannot shorten the path."""
     spec = sg.gq.shortest
     max_depth = spec.depth if spec.depth > 0 else 64
+    mesh = ex.mesh
+    only = [c for _a, c in csrs]
+    dist, hops, edges = ex.gated(
+        lambda: mesh.run_bfs(only, src, max_depth, ex.edge_budget(),
+                             stop_at=dst),
+        klass="shortest")
+    if edges > ex.edge_budget():
+        raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
+    ex._mesh_fused += 1
+    tgt = mesh.bfs_targets(only)
+    pos = int(np.searchsorted(tgt, dst)) if len(tgt) else 0
+    if not len(tgt) or pos >= len(tgt) or tgt[pos] != dst or \
+            dist[pos] >= int(mesh.BFS_UNREACHED):
+        return None
+    d = int(dist[pos])
+    host = [(attr, csr.host_arrays()) for attr, csr in csrs]
+
+    def _edge_exists(arrs, u: int, t: int) -> bool:
+        subjects, indptr, indices = arrs
+        r = int(np.searchsorted(subjects, u))
+        if r >= len(subjects) or subjects[r] != u:
+            return False
+        row = indices[indptr[r]: indptr[r + 1]]
+        j = int(np.searchsorted(row, t))
+        return j < len(row) and row[j] == t
+
+    path = [dst]
+    attrs: list[str] = []
+    cur = dst
+    for level in range(d - 1, -1, -1):
+        cands = tgt[dist == level].astype(np.int64)
+        if level == 0:
+            cands = np.unique(np.concatenate(
+                [cands, np.asarray([src], dtype=np.int64)]))
+        best = None
+        for _attr, arrs in host:
+            subjects, indptr, indices = arrs
+            rows = np.searchsorted(subjects, cands)
+            rc = np.clip(rows, 0, max(len(subjects) - 1, 0))
+            ok = (len(subjects) > 0) & (subjects[rc] == cands)
+            starts = np.where(ok, indptr[rc], 0).astype(np.int64)
+            deg = np.where(ok, indptr[rc + 1] - starts, 0).astype(np.int64)
+            total = int(deg.sum())
+            if not total:
+                continue
+            offs = np.zeros(len(cands) + 1, dtype=np.int64)
+            np.cumsum(deg, out=offs[1:])
+            flat = np.repeat(starts - offs[:-1], deg) + np.arange(total)
+            hit = indices[flat] == cur
+            if hit.any():
+                seg = np.searchsorted(offs[1:], np.flatnonzero(hit),
+                                      side="right")
+                u = int(cands[seg].min())
+                best = u if best is None else min(best, u)
+        if best is None:
+            return None       # cannot happen for a finite dist
+        # attr = the FIRST child predicate holding the chosen edge (the
+        # first (t, cost, attr) tuple Dijkstra relaxed from adj[u])
+        attr_used = next(a for a, arrs in host
+                         if _edge_exists(arrs, best, cur))
+        path.append(best)
+        attrs.append(attr_used)
+        cur = best
+    return (float(d), path[::-1], attrs[::-1])
+
+
+def _mesh_bfs_adjacency(ex, sg: SubGraph, csrs, src: int):
+    """expandOut's whole level loop (query/shortest.go:134) as ONE
+    `lax.while_loop` dispatch (mesh_exec.run_bfs): frontier, visited set,
+    and distance vector stay device-resident between hops — the 12
+    stepped dispatches (12 gRPC rounds per group on the wire path) become
+    one launch. The host rebuilds the level adjacency from the distance
+    vector and its CSR mirrors: a node expanded at level L holds its full
+    per-predicate rows in child order, exactly what _build_adjacency
+    accretes (cost 1.0, all targets recorded), so Dijkstra / k-shortest
+    see byte-identical inputs."""
+    spec = sg.gq.shortest
+    max_depth = spec.depth if spec.depth > 0 else 64
+    mesh = ex.mesh
+    only = [c for _a, c in csrs]
+    dist, hops, edges = ex.gated(
+        lambda: mesh.run_bfs(only, src, max_depth, ex.edge_budget()),
+        klass="shortest")
+    if edges > ex.edge_budget():
+        raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
+    ex._mesh_fused += 1
+    tgt = mesh.bfs_targets(only)
+    # nodes EXPANDED by the loop: in the frontier of an executed level —
+    # dist L < hops (the last level's fresh targets joined no frontier)
+    reached = tgt[dist < hops].astype(np.int64) if hops else \
+        np.zeros(0, np.int64)
+    uids = np.unique(np.concatenate(
+        [np.asarray([src], dtype=np.int64), reached]))
     adj: dict[int, list[tuple[int, float, str]]] = {}
-    trav = ex.mesh.start_traversal(csr, np.asarray([src], dtype=np.int64))
-    edges = 0
-    for _level in range(max_depth):
-        frontier = trav.frontier
-        if len(frontier) == 0:
-            break
-        matrix, _next, traversed = ex.gated(trav.step, klass="shortest")
-        edges += traversed
-        if edges > ex.edge_budget():
-            raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
-        for u, targets in zip(frontier, matrix):
-            if len(targets):
-                adj.setdefault(int(u), []).extend(
-                    (int(t), 1.0, attr) for t in targets)
+    for attr, csr in csrs:
+        subjects, indptr, indices = csr.host_arrays()
+        rows = np.searchsorted(subjects, uids)
+        rc = np.clip(rows, 0, max(len(subjects) - 1, 0))
+        ok = (len(subjects) > 0) & (subjects[rc] == uids)
+        for i in np.flatnonzero(ok):
+            u = int(uids[i])
+            r = int(rc[i])
+            row = indices[indptr[r]: indptr[r + 1]]
+            if len(row):
+                adj.setdefault(u, []).extend(
+                    (int(t), 1.0, attr) for t in row)
     return adj
 
 
@@ -248,13 +362,16 @@ def shortest_path(ex, sg: SubGraph) -> None:
         sg.paths = [(0.0, [src], [])]
     else:
         dev = _device_csr(ex, sg)
-        mesh = _mesh_csr(ex, sg) if dev is None else None
+        mesh = _mesh_csrs(ex, sg) if dev is None else None
         if dev is not None:
             p = _device_shortest(dev[0], dev[1], src, dst, max_depth)
             sg.paths = [p] if p is not None else []
+        elif mesh is not None and spec.numpaths <= 1:
+            p = _mesh_shortest_single(ex, sg, mesh, src, dst)
+            sg.paths = [p] if p is not None else []
         else:
             if mesh is not None:
-                adj = _mesh_adjacency(ex, sg, mesh[0], mesh[1], src)
+                adj = _mesh_bfs_adjacency(ex, sg, mesh, src)
             else:
                 adj = _build_adjacency(ex, sg, src, dst)
             if spec.numpaths <= 1:

@@ -1,0 +1,300 @@
+"""Whole-plan fused compilation (ISSUE 12): fused-vs-classic byte
+identity across filter/pagination/facet shapes, one-dispatch gates for
+every traversal family, the labeled fallback-reason taxonomy, and the
+golden-corpus fused-coverage ratio the acceptance criteria pin at ≥ 0.9.
+
+Needs the conftest-provided 8-virtual-device CPU mesh (no-op elsewhere,
+same rule as tests/test_mesh_exec.py)."""
+
+import json
+
+import numpy as np
+import pytest
+import jax
+
+from dgraph_tpu.api.server import Node
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the conftest-provided 8-virtual-device CPU mesh")
+
+
+SCHEMA = """
+name: string @index(exact) .
+rating: float @index(float) .
+p0: [uid] .
+p1: [uid] .
+p2: [uid] @reverse .
+follows: [uid] .
+"""
+
+
+def _quads():
+    rng = np.random.default_rng(11)
+    quads = [f'_:n{i} <name> "node{i}" .' for i in range(80)]
+    quads += [f'_:n{i} <rating> "{(i * 13) % 100 / 10}"^^<xs:float> .'
+              for i in range(80)]
+    for i in range(80):
+        for attr, mul, off in (("p0", 3, 1), ("p1", 5, 2), ("p2", 7, 3)):
+            for k in range(3):
+                t = (i * mul + off + k) % 80
+                facet = ' (w=%d)' % (k + 1) if attr == "p0" else ""
+                quads.append(f"_:n{i} <{attr}> _:n{t}{facet} .")
+        for j in sorted(rng.choice(80, size=3, replace=False)):
+            if j != i:
+                quads.append(f"_:n{i} <follows> _:n{j} .")
+    return "\n".join(quads)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """(plain node, mesh node) over an identical graph — task/result
+    caches disabled so every query reaches the dispatch seam."""
+    nodes = []
+    for mesh in (0, 8):
+        n = Node(mesh_devices=mesh, mesh_min_edges=1)
+        n.alter(schema_text=SCHEMA)
+        n.mutate(set_nquads=_quads(), commit_now=True)
+        n.task_cache = n.result_cache = None
+        nodes.append(n)
+    return nodes
+
+
+def _same(plain, mesh, q):
+    a, _ = plain.query(q)
+    b, _ = mesh.query(q)
+    assert json.dumps(a, sort_keys=True, default=str) == \
+        json.dumps(b, sort_keys=True, default=str), q
+
+
+# ---------------------------------------------------------------------------
+# fused shapes: byte identity + ONE dispatch
+# ---------------------------------------------------------------------------
+
+FUSED_BATTERY = [
+    # filters mid-chain — the PR-6 bail-out shapes, now fused
+    '{ q(func: eq(name, "node3")) { p0 @filter(ge(rating, 3.0)) '
+    '{ p1 @filter(lt(rating, 8.0)) { p2 } } } }',
+    '{ q(func: eq(name, "node3")) { p0 @filter(uid(0x1,0x2,0x3,0x10)) '
+    '{ p1 { p2 } } } }',
+    '{ q(func: eq(name, "node3")) { p0 @filter(NOT eq(name, "node10")) '
+    '{ p1 @filter(has(rating) AND ge(rating, 1.0)) { p2 } } } }',
+    '{ q(func: eq(name, "node3")) { p0 @filter(ge(count(p1), 3)) '
+    '{ p1 { p2 } } } }',
+    '{ q(func: eq(name, "node3")) { p0 @filter(le(count(p1), 0)) '
+    '{ p1 { p2 } } } }',
+    # pagination mid-chain (incl. negative first)
+    '{ q(func: eq(name, "node3")) { p0 (first: 2) '
+    '{ p1 (first: 1, offset: 1) { p2 } } } }',
+    '{ q(func: eq(name, "node3")) { p0 (first: -2) { p1 { p2 } } } }',
+    '{ q(func: eq(name, "node3")) { p0 @filter(ge(rating, 2.0)) '
+    '(first: 2, offset: 1) { p1 { p2 } } } }',
+    # facet READS ride the fused chain (host attach)
+    '{ q(func: eq(name, "node3")) { p0 @facets(w) { p1 { p2 } } } }',
+    # value / count co-children at every level
+    '{ q(func: eq(name, "node3")) { name p0 { name rating '
+    'p1 { p2 { name } } } } }',
+    '{ q(func: eq(name, "node3")) { p0 { count(p1) p1 { p2 } } } }',
+    # var capture on a chain node, consumed by a later block
+    '{ q(func: eq(name, "node3")) { p0 { v as p1 { p2 } } } '
+    ' r(func: uid(v), first: 3) { name } }',
+    # reverse edges + order args (child order is presentation-only)
+    '{ q(func: eq(name, "node5")) { p2 @filter(ge(rating, 1.0)) '
+    '{ ~p2 } } }',
+    '{ q(func: eq(name, "node3")) { p0 (orderasc: rating) '
+    '{ p1 { p2 } } } }',
+]
+
+
+def test_fused_battery_byte_identical_one_dispatch(pair):
+    plain, mesh = pair
+    c = mesh.metrics.counter("dgraph_mesh_dispatches_total")
+    for q in FUSED_BATTERY:
+        a, _ = plain.query(q)
+        d0 = c.value
+        b, _ = mesh.query(q)
+        assert c.value - d0 == 1, f"not one dispatch: {q}"
+        assert json.dumps(a, sort_keys=True, default=str) == \
+            json.dumps(b, sort_keys=True, default=str), q
+
+
+def test_fuzz_grid_filter_pagination_facets(pair):
+    """Cartesian fuzz: filter × pagination × facet-read combos on a
+    2-hop chain, every combination byte-identical fused vs classic."""
+    plain, mesh = pair
+    filters = ["", "@filter(ge(rating, 2.0))",
+               "@filter(uid(0x2, 0x5, 0x9, 0x11))",
+               "@filter(NOT le(rating, 4.0))",
+               "@filter(eq(count(p2), 3) OR ge(rating, 8.0))"]
+    pags = ["", "(first: 2)", "(first: 2, offset: 1)", "(first: -1)"]
+    facets = ["", "@facets(w)"]
+    for f in filters:
+        for p in pags:
+            for fc in facets:
+                q = ('{ q(func: eq(name, "node7")) { p0 %s %s %s '
+                     '{ p1 { uid } } } }' % (fc, f, p))
+                _same(plain, mesh, q)
+
+
+def test_recurse_filter_and_val_children(pair):
+    plain, mesh = pair
+    c = mesh.metrics.counter("dgraph_mesh_dispatches_total")
+    for q in [
+        '{ q(func: eq(name, "node1")) @recurse(depth: 3) '
+        '{ name follows @filter(ge(rating, 1.0)) } }',
+        '{ q(func: eq(name, "node1")) @recurse(depth: 4) '
+        '{ rating follows } }',
+        '{ q(func: eq(name, "node1")) @recurse(depth: 3, loop: true) '
+        '{ follows } }',
+    ]:
+        a, _ = plain.query(q)
+        d0 = c.value
+        b, _ = mesh.query(q)
+        assert c.value - d0 == 1, f"not one dispatch: {q}"
+        assert json.dumps(a, sort_keys=True, default=str) == \
+            json.dumps(b, sort_keys=True, default=str), q
+
+
+def test_shortest_one_dispatch_all_variants(pair):
+    """Shortest path — single, multi-predicate, k-shortest — runs the
+    whole expandOut loop as ONE while_loop dispatch (12 before)."""
+    plain, mesh = pair
+    c = mesh.metrics.counter("dgraph_mesh_dispatches_total")
+    for q in [
+        '{ p as shortest(from: 0x1, to: 0x30) { follows } '
+        ' r(func: uid(p)) { uid } }',
+        '{ p as shortest(from: 0x1, to: 0x30) { follows p0 } '
+        ' r(func: uid(p)) { uid } }',
+        '{ p as shortest(from: 0x1, to: 0x30, numpaths: 2) { follows } '
+        ' r(func: uid(p)) { uid } }',
+        '{ p as shortest(from: 0x1, to: 0x999) { follows } '
+        ' r(func: uid(p)) { uid } }',     # unreachable endpoint
+    ]:
+        a, _ = plain.query(q)
+        d0 = c.value
+        b, _ = mesh.query(q)
+        assert c.value - d0 == 1, f"not one dispatch: {q}"
+        assert json.dumps(a, sort_keys=True, default=str) == \
+            json.dumps(b, sort_keys=True, default=str), q
+
+
+# ---------------------------------------------------------------------------
+# fallback reasons: enumerable coverage gaps
+# ---------------------------------------------------------------------------
+
+def _reasons(mesh):
+    return mesh.metrics.keyed("dgraph_mesh_fallbacks_total",
+                              labels=("reason",)).snapshot()
+
+
+def test_facet_filter_falls_back_labeled(pair):
+    plain, mesh = pair
+    q = ('{ q(func: eq(name, "node3")) { p0 @facets(eq(w, 1)) '
+         '{ p1 { p2 } } } }')
+    before = _reasons(mesh).get("facet", 0)
+    _same(plain, mesh, q)
+    assert _reasons(mesh).get("facet", 0) > before
+
+
+def test_var_define_read_same_block_falls_back(pair):
+    plain, mesh = pair
+    # x binds at the p1 level and a deeper filter reads it — classic's
+    # depth-first binding order is load-bearing, so the block stays
+    # classic (reason=var) and stays byte-identical
+    q = ('{ q(func: eq(name, "node3")) { p0 { x as p1 '
+         '{ p2 @filter(uid(x)) } } } }')
+    before = _reasons(mesh).get("var", 0)
+    _same(plain, mesh, q)
+    assert _reasons(mesh).get("var", 0) > before
+
+
+def test_multi_pred_recurse_falls_back_labeled(pair):
+    plain, mesh = pair
+    q = ('{ q(func: eq(name, "node1")) @recurse(depth: 2) '
+         '{ follows p0 } }')
+    before = _reasons(mesh).get("multi_pred", 0)
+    _same(plain, mesh, q)
+    assert _reasons(mesh).get("multi_pred", 0) > before
+
+
+def test_overlay_falls_back_labeled_and_fresh():
+    """A commit lands as a delta overlay: the chain bails (reason=
+    overlay) but the write is visible immediately and byte-identical."""
+    n = Node(mesh_devices=8, mesh_min_edges=1)
+    n.alter(schema_text=SCHEMA)
+    n.mutate(set_nquads=_quads(), commit_now=True)
+    n.task_cache = n.result_cache = None
+    q = '{ q(func: uid(0x1)) { p0 { uid p1 { uid } } } }'
+    n.query(q)
+    n.mutate(set_nquads="<0x1> <p0> <0x4f> .", commit_now=True)
+    out, _ = n.query(q)
+    uids = {x["uid"] for x in out["q"][0]["p0"]}
+    assert "0x4f" in uids
+    assert _reasons(n).get("overlay", 0) >= 1
+
+
+def test_coverage_ratio_on_golden_corpus():
+    """The acceptance gate: ≥ 90% of golden-corpus queries that touch
+    mesh-owned tablets run their traversals fully fused."""
+    from tests.test_golden import QUERIES, SCHEMA as GSCHEMA, _dataset
+
+    n = Node(mesh_devices=8, mesh_min_edges=1)
+    n.alter(schema_text=GSCHEMA)
+    n.mutate(set_nquads=_dataset(), commit_now=True)
+    for _name, q in QUERIES:
+        n.query(q)
+    fused = n.metrics.counter("dgraph_mesh_fused_queries_total").value
+    unfused = n.metrics.counter(
+        "dgraph_mesh_unfused_queries_total").value
+    assert fused + unfused > 0, "corpus never touched a mesh tablet"
+    ratio = fused / (fused + unfused)
+    assert ratio >= 0.9, (
+        f"fused coverage {ratio:.2f} < 0.9 "
+        f"(reasons: {_reasons(n)})")
+
+
+# ---------------------------------------------------------------------------
+# surfaces
+# ---------------------------------------------------------------------------
+
+def test_debug_metrics_mesh_section(pair):
+    from dgraph_tpu.api.http import _serving_metrics
+
+    _plain, mesh = pair
+    mesh.query('{ q(func: eq(name, "node3")) { p0 { p1 { p2 } } } }')
+    m = _serving_metrics(mesh)["mesh"]
+    assert m["enabled"] and m["devices"] == 8
+    assert m["dispatches"] >= 1 and m["fused_queries"] >= 1
+    assert 0.0 <= m["fused_coverage_ratio"] <= 1.0
+    assert isinstance(m["fallbacks"], dict)
+
+
+def test_prom_reason_labels_parse(pair):
+    from dgraph_tpu.obs import prom
+
+    plain, mesh = pair
+    # force at least one labeled fallback then round-trip /metrics
+    _same(plain, mesh,
+          '{ q(func: eq(name, "node3")) { p0 @facets(eq(w, 1)) '
+          '{ p1 { p2 } } } }')
+    text = prom.render(mesh.metrics)
+    series = prom.parse(text)
+    labeled = [k for k in series
+               if k.startswith("dgraph_mesh_fallbacks_total")]
+    assert labeled, "reason-labeled fallback series missing"
+    assert 'reason="facet"' in text
+
+
+def test_plan_cache_carries_fused_ir(pair):
+    """The planner attaches the chain IR to cached plans: replaying the
+    same query hits the plan cache and still fuses (one dispatch)."""
+    _plain, mesh = pair
+    q = '{ q(func: eq(name, "node9")) { p0 { p1 { p2 } } } }'
+    c = mesh.metrics.counter("dgraph_mesh_dispatches_total")
+    mesh.query(q)
+    hits0 = mesh.metrics.counter("dgraph_planner_cache_hits_total").value
+    d0 = c.value
+    mesh.query(q)
+    assert c.value - d0 == 1
+    assert mesh.metrics.counter(
+        "dgraph_planner_cache_hits_total").value > hits0
