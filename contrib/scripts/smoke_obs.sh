@@ -1,0 +1,155 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a CPU-only end-to-end cost-ledger /
+# fleet-metrics check (ISSUE 13).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1
+# to skip when the full suite already ran in an earlier CI stage).
+# Step 2 stands up a 2-group wire cluster (zero + 2 registered workers +
+# ClusterClient) and asserts:
+#   * a cross-shard query produces ONE merged cost record whose per-group
+#     sub-records arrived over ServeTask trailing metadata;
+#   * the Zero-federated /metrics/fleet exposition parses and its
+#     histogram _sum/_count equal the sum of the per-node scrapes
+#     (merge exactness — fixed buckets);
+#   * a latency/cost histogram exemplar on an embedded node's /metrics
+#     round-trips to a servable trace at /debug/traces/<id>, and
+#     /debug/top ranks the executed shape.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== cost-ledger / fleet-metrics smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import random
+import threading
+import urllib.request
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import Zero
+from dgraph_tpu.coord.zero_service import (ZeroClient, fleet_scrape,
+                                           serve_zero, serve_zero_http,
+                                           ZeroOps)
+from dgraph_tpu.obs import prom
+from dgraph_tpu.parallel.client import ClusterClient
+from dgraph_tpu.parallel.remote import serve_worker
+from dgraph_tpu.query import task as taskmod
+from dgraph_tpu.storage.store import Store
+from dgraph_tpu.utils.schema import parse_schema
+
+taskmod.HOST_EXPAND_MAX = 0          # force real device dispatches
+
+SCHEMA = ("name: string @index(exact) .\n"
+          "follows: [uid] @reverse .")
+
+# -- 2-group wire cluster, workers REGISTERED with zero --------------------
+zero = Zero(2)
+zero.move_tablet("name", 0)
+zero.move_tablet("follows", 1)
+zsrv, zport, zsvc = serve_zero(zero, "localhost:0")
+workers = []
+for _g in range(2):
+    s = Store()
+    for e in parse_schema(SCHEMA):
+        s.set_schema(e)
+    workers.append(serve_worker(s, "localhost:0"))
+zc = ZeroClient(f"localhost:{zport}")
+for g in range(2):
+    zc.connect(f"localhost:{workers[g][1]}", g)
+zc.close()
+client = ClusterClient(
+    f"localhost:{zport}",
+    {g: [f"localhost:{workers[g][1]}"] for g in range(2)},
+    span_sample=1.0, trace_rng=random.Random(9))
+client.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                         '_:a <follows> _:b .')
+out = client.query('{ q(func: eq(name, "ann")) { name follows { name } } }')
+assert out["q"][0]["follows"][0]["name"] == "bob", out
+
+# one merged cost record: both groups shipped sub-records
+rec = client.cost_book.last()
+addrs = {f"localhost:{workers[g][1]}" for g in range(2)}
+assert set(rec["groups"]) == addrs, rec["groups"].keys()
+assert rec["total"]["edges"] == 1, rec["total"]
+assert rec["total"]["device_ms"] > 0
+print(f"  merged record: edges={rec['total']['edges']} "
+      f"device_ms={rec['total']['device_ms']:.2f} "
+      f"groups={len(rec['groups'])}")
+
+# fleet merge exactness over the zero HTTP surface
+httpd, hport = serve_zero_http(zsvc, ZeroOps(zsvc), "127.0.0.1", 0)
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{hport}/metrics/fleet") as r:
+    fleet_text = r.read().decode()
+fleet = prom.parse(fleet_text)
+fl = fleet_scrape(zsvc)
+assert len(fl["nodes"]) == 2, fl["unreachable"]
+per = list(fl["nodes"].values())
+for hname, h in fl["merged"]["histograms"].items():
+    want = sum(p["histograms"][hname]["count"] for p in per
+               if hname in p["histograms"])
+    assert h["count"] == want, (hname, h["count"], want)
+k = "dgraph_task_cache_misses_total"
+assert fl["merged"]["counters"][k] == sum(p["counters"][k] for p in per)
+print(f"  /metrics/fleet: {len(fleet)} series, "
+      f"{len(fl['nodes'])} nodes merged exactly")
+httpd.shutdown()
+client.close()
+for w, _p in workers:
+    w.stop(0)
+zsrv.stop(0)
+
+# -- embedded node: exemplar round-trip + /debug/top -----------------------
+node = Node(span_sample=1.0, trace_rng=random.Random(4))
+node.alter(schema_text=SCHEMA)
+node.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                       '_:a <follows> _:b .', commit_now=True)
+srv = make_server(node, "127.0.0.1", 0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{srv.server_address[1]}"
+node.query('{ q(func: eq(name, "ann")) { name follows { name } } }')
+# exemplars are served only under content negotiation (OpenMetrics);
+# the un-negotiated scrape must parse as classic 0.0.4 with none
+with urllib.request.urlopen(base + "/metrics") as r:
+    assert "# {trace_id=" not in r.read().decode()
+req = urllib.request.Request(
+    base + "/metrics",
+    headers={"Accept": "application/openmetrics-text; version=1.0.0"})
+with urllib.request.urlopen(req) as r:
+    series = prom.parse(r.read().decode())
+exemplars = [lbl["__exemplar__"] for name, samples in series.items()
+             if name.endswith("_bucket")
+             for lbl, _v in samples if lbl.get("__exemplar__")]
+assert exemplars, "no exemplar on any histogram bucket"
+tid = exemplars[0]
+with urllib.request.urlopen(base + f"/debug/traces/{tid}") as r:
+    ct = json.loads(r.read())
+assert ct["otherData"]["trace_id"] == tid
+with urllib.request.urlopen(base + "/debug/top") as r:
+    top = json.loads(r.read())
+assert top["top"] and top["top"][0]["device_ms"] >= 0
+print(f"  exemplar {tid} resolves; /debug/top ranks "
+      f"{len(top['top'])} shapes")
+srv.shutdown()
+node.close()
+print("cost-ledger smoke OK")
+PY
+echo "smoke_obs OK"

@@ -49,7 +49,9 @@ def cmd_serve(args) -> int:
                 vector_centroids=args.vector_centroids,
                 vector_ivf_min_rows=args.vector_ivf_min_rows,
                 device_budget_mb=args.device_budget_mb,
-                residency_pin=args.residency_pin)
+                residency_pin=args.residency_pin,
+                cost_ledger=not args.no_cost_ledger,
+                cost_regression_factor=args.cost_regression_factor)
     if args.faults or args.faults_seed is not None:
         from dgraph_tpu.utils import faults as faults_mod
 
@@ -183,7 +185,8 @@ def cmd_worker(args) -> int:
                                 advertise_host=args.advertise_host,
                                 batching=not args.no_batch,
                                 batch_window_ms=args.batch_window_ms,
-                                batch_max=args.batch_max)
+                                batch_max=args.batch_max,
+                                cost_ledger=not args.no_cost_ledger)
     if args.zero:
         import threading
 
@@ -405,6 +408,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "(plan + span tree; 0 disables)")
     sp.add_argument("--slow_query_log", default=None,
                     help="also append slow-query entries to this JSONL file")
+    sp.add_argument("--no_cost_ledger", action="store_true",
+                    help="disable the per-request cost ledger (/debug/top "
+                         "profiler, dgraph_query_cost_* histograms, "
+                         "regression flags; <2%% overhead armed)")
+    sp.add_argument("--cost_regression_factor", type=float, default=4.0,
+                    help="flag a query into /debug/slow when its device "
+                         "cost exceeds this multiple of its plan-shape's "
+                         "EWMA baseline (needs 8 warmup samples)")
     sp.add_argument("--plan_cache", type=int, default=256,
                     help="parsed-plan cache entries (0 disables)")
     sp.add_argument("--task_cache_mb", type=int, default=64,
@@ -552,6 +563,10 @@ def build_parser() -> argparse.ArgumentParser:
     wp.add_argument("--no_batch", action="store_true",
                     help="disable batched multi-query device execution "
                          "(exact per-task dispatch)")
+    wp.add_argument("--no_cost_ledger", action="store_true",
+                    help="disable per-RPC cost accounting + the cost "
+                         "record shipped back in ServeTask trailing "
+                         "metadata")
     wp.set_defaults(fn=cmd_worker)
 
     zp = sub.add_parser("zero", help="run the cluster coordinator process")

@@ -289,8 +289,25 @@ def _serving_metrics(node: Node) -> dict:
         # dgraph_tablet_load{pred,group,stat} series on /metrics
         # independently of any controller's decisions
         "tablet_load": node.tablet_book.snapshot(),
+        # query cost ledger (ISSUE 13, obs/costs.py): records admitted to
+        # the /debug/top window, regressions flagged against the
+        # per-shape EWMA baselines, and the quantile view of the cost
+        # distributions (the ring percentiles live HERE — /metrics
+        # carries the aggregatable le-bucket histograms instead)
+        "costs": {
+            "enabled": node.cost_ledger,
+            "records": c("dgraph_cost_records_total"),
+            "in_window": len(node.cost_book),
+            "regressions_flagged": c("dgraph_cost_regressions_total"),
+            "regression_factor": node.cost_book.regression_factor,
+            "device_ms": m.histogram(
+                "dgraph_query_cost_device_ms").snapshot(),
+            "edges": m.histogram("dgraph_query_cost_edges").snapshot(),
+            "bytes": m.histogram("dgraph_query_cost_bytes").snapshot(),
+        },
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),
+                 "meter_dropped": m.meter(f"http_{ep}").dropped,
                  "latency": m.histogram(
                      f"dgraph_http_{ep}_latency_s").snapshot()}
             for ep in ("query", "mutate", "commit", "abort", "alter")
@@ -343,7 +360,12 @@ class _Handler(BaseHTTPRequestHandler):
         "/debug/traces": "distributed span traces index (?n=32)",
         "/debug/traces/<trace_id>": "one trace as Chrome trace-event JSON "
                                     "(load in Perfetto / chrome://tracing)",
-        "/debug/slow": "slow-query log ring (?n=32)",
+        "/debug/slow": "slow-query log ring (?n=32; cost regressions "
+                       "flagged by the ledger land here too)",
+        "/debug/top": "live cost profiler: rank plan shapes / predicates "
+                      "/ endpoints by device ms, bytes, or edges over a "
+                      "sliding window (?window=60&by=device_ms&"
+                      "group=shape&n=20)",
         "/debug/faults": "fault-injection registry (GET snapshot; POST "
                          '{"install": {...}} / {"spec": "..."} / '
                          '{"clear": true} / {"seed": N} — chaos tests)',
@@ -357,12 +379,18 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/state":
             self._send(200, json.dumps(self.node.state()).encode())
         elif path == "/metrics":
-            # Prometheus text exposition of the whole Registry (counters,
-            # summaries, labeled gauges) — scrape this endpoint
+            # Prometheus exposition of the whole Registry. Trace
+            # exemplars are only legal in OpenMetrics — classic
+            # text-format parsers reject the '# {...}' suffix and would
+            # drop the whole scrape — so they render only when the
+            # scraper negotiates via Accept (Prometheus does when
+            # exemplar scraping is on; so do Grafana agents)
             from dgraph_tpu.obs import prom
 
-            self._send(200, prom.render(self.node.metrics).encode(),
-                       ctype="text/plain; version=0.0.4; charset=utf-8")
+            body, ctype = prom.negotiated(
+                self.headers.get("Accept"),
+                lambda ex: prom.render(self.node.metrics, exemplars=ex))
+            self._send(200, body, ctype=ctype)
         elif path == "/debug":
             self._send(200, json.dumps(
                 {"endpoints": self._DEBUG_INDEX}).encode())
@@ -398,6 +426,13 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self._qs().get("n", "32"))
             self._send(200, json.dumps(self.node.slow_log.recent(n),
                                        default=str).encode())
+        elif path == "/debug/top":
+            qs = self._qs()
+            self._send(200, json.dumps(self.node.cost_book.top(
+                window_s=float(qs.get("window", "60")),
+                by=qs.get("by", "device_ms"),
+                group=qs.get("group", "shape"),
+                n=int(qs.get("n", "20"))), default=str).encode())
         elif path == "/debug/faults":
             self._send(200, json.dumps(faults.GLOBAL.snapshot()).encode())
         elif path in ("", "/ui"):

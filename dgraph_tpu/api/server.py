@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass, field
 
 from dgraph_tpu.coord.zero import TxnConflict, Zero
-from dgraph_tpu.obs import otrace
+from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.obs.slowlog import SlowQueryLog
 from dgraph_tpu.query import dql, rdf
 from dgraph_tpu.query import mutation as mut
@@ -100,7 +100,9 @@ class Node:
                  batch_window_ms: float = 2.0,
                  batch_max: int = 16,
                  device_budget_mb: int = 0,
-                 residency_pin: str = "") -> None:
+                 residency_pin: str = "",
+                 cost_ledger: bool = True,
+                 cost_regression_factor: float = 4.0) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -242,6 +244,17 @@ class Node:
         from dgraph_tpu.coord.placement import TabletLoadBook
 
         self.tablet_book = TabletLoadBook(self.metrics, group=0)
+        # per-request cost ledger + /debug/top profiler (ISSUE 13,
+        # obs/costs.py): every query assembles one resource cost record
+        # (device-kernel ms, transfer bytes, traversed edges, cache/batch/
+        # shed outcomes, per-predicate breakdown) which feeds the
+        # aggregatable dgraph_query_cost_* histograms (with trace
+        # exemplars) and the CostBook's sliding /debug/top window with
+        # per-shape EWMA regression baselines. --no_cost_ledger restores
+        # the unmeasured path (bench `obs` gates the armed overhead <2%).
+        self.cost_ledger = bool(cost_ledger)
+        self.cost_book = costs.CostBook(
+            regression_factor=cost_regression_factor)
 
     def set_memory_budget(self, budget_bytes: int) -> None:
         """Install/retarget the memory budget and ensure the background
@@ -552,8 +565,13 @@ class Node:
         m.meter("query").mark()
         t0 = time.perf_counter()
         err = ""
+        # per-request cost ledger: the plan-shape key is the DQL text —
+        # exactly what qcache.plan_key keys on — so /debug/top aggregates
+        # replays of one shape across variable bindings
+        lg = costs.CostLedger(endpoint="query", shape=q) \
+            if self.cost_ledger else None
         try:
-          with sp, self._deadline_scope(timeout_ms):
+          with sp, self._deadline_scope(timeout_ms), costs.scope(lg):
             req = self._parse(q, variables)
             tr.printf("parsed: %d query blocks", len(req.queries))
             if req.upsert is not None:
@@ -597,6 +615,7 @@ class Node:
                     if cached is not None:
                         tr.printf("result cache hit")
                         sp.set(result_cache="hit")
+                        costs.note("result_cache_hit")
                         return cached, TxnContext(start_ts=read_ts)
             # cost-based plan (order decisions only): cached alongside the
             # AST, keyed on the per-predicate stats tokens of the plan's
@@ -668,8 +687,55 @@ class Node:
         finally:
             m.counter("dgraph_pending_queries_total").dec()
             m.histogram("dgraph_query_latency_s").observe(
-                time.perf_counter() - t0)
+                time.perf_counter() - t0,
+                exemplar=sp.trace_id or None)
+            self._finish_cost(lg, sp)
             self.traces.finish(tr, error=err)
+
+    def _finish_cost(self, lg, sp) -> None:
+        """Close one request's cost ledger: observe the aggregatable
+        dgraph_query_cost_* histograms (exemplar = the request's sampled
+        trace id, resolvable at /debug/traces/<id>), admit the record to
+        the /debug/top window, and route a flagged cost regression into
+        the slow-query ring — even when the query finished UNDER
+        --slow_query_ms (that is the point: a shape that regressed from
+        2ms to 40ms never crosses a 500ms threshold)."""
+        if lg is None:
+            return
+        m = self.metrics
+        if not lg.tasks and lg.device_ms == 0.0 and not lg.groups:
+            # trivial record (whole-result cache hit, schema request,
+            # parse error): nothing executed — skip record assembly and
+            # the cost observations entirely. This keeps the armed warm
+            # path within the <2% bench `obs` gate AND keeps zero-cost
+            # replays from diluting the cost distributions and the
+            # per-shape EWMA baselines into flagging every real
+            # execution as a regression.
+            return
+        # counted AFTER the trivial skip: the counter means "records
+        # admitted to the cost surfaces", matching /debug/metrics
+        m.counter("dgraph_cost_records_total").inc()
+        lg.finish()
+        rec = lg.to_dict()
+        total = rec["total"]
+        tid = sp.trace_id if sp else ""
+        ex = tid or None
+        m.histogram("dgraph_query_cost_device_ms").observe(
+            float(total["device_ms"]), exemplar=ex)
+        m.histogram("dgraph_query_cost_edges").observe(
+            float(total["edges"]), exemplar=ex)
+        m.histogram("dgraph_query_cost_bytes").observe(
+            float(total["h2d"] + total["d2h"]), exemplar=ex)
+        flag = self.cost_book.record(lg.shape, lg.endpoint, tid, rec)
+        if flag is not None:
+            m.counter("dgraph_cost_regressions_total").inc()
+            self.slow_log.record({
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
+                "root": "cost_regression",
+                "trace_id": tid,
+                "query": lg.shape[:2000],
+                "elapsed_ms": total["wall_ms"],
+                **flag})
 
     def upsert(self, q: str, mutations: list[dict],
                variables: dict | None = None, start_ts: int | None = None,
@@ -850,7 +916,8 @@ class Node:
         finally:
             m.counter("dgraph_active_mutations_total").dec()
             m.histogram("dgraph_mutation_latency_s").observe(
-                time.perf_counter() - t0)
+                time.perf_counter() - t0,
+                exemplar=sp.trace_id or None)
             self.traces.finish(tr, error=err)
 
     def run_request(self, q: str, variables: dict | None = None,

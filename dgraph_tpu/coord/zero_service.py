@@ -895,6 +895,52 @@ class ZeroOps:
         return False
 
 
+def fleet_scrape(svc: ZeroService) -> dict:
+    """Poll every registered worker's Status for its shipped metric
+    snapshot (StatusResponse.metrics_json — the same probe that carries
+    the placement load reports) and return
+    {"nodes": {addr: export}, "merged": merged, "unreachable": [...]}.
+    Histograms merge exactly (fixed buckets, utils/metrics.merge_exports);
+    counters and keyed gauges sum."""
+    from concurrent import futures as _futures
+
+    from ..parallel.remote import RemoteWorker
+    from ..utils.metrics import merge_exports
+
+    with svc._lock:
+        addrs = sorted({a for addrs in svc._members.values()
+                        for a in addrs})
+
+    def poll(a: str):
+        rw = RemoteWorker(a)
+        try:
+            st = rw.status(timeout=2.0)
+            return a, json.loads(st.metrics_json or "{}")
+        except Exception:
+            return a, None               # RPC failed: truly unreachable
+        finally:
+            rw.close()
+
+    nodes: dict[str, dict] = {}
+    unreachable: list[str] = []
+    if addrs:
+        # concurrent polls: a partially-down fleet must not push the
+        # scrape past Prometheus's timeout (serial 2s-per-dead-worker
+        # would — and a down fleet is exactly when the view matters)
+        with _futures.ThreadPoolExecutor(
+                max_workers=min(len(addrs), 16)) as pool:
+            for a, snap in pool.map(poll, addrs):
+                if snap is None:
+                    unreachable.append(a)
+                elif snap:
+                    nodes[a] = snap
+                # else: reachable but no snapshot shipped (older binary
+                # mid rolling upgrade) — NOT unreachable, just absent
+    return {"nodes": nodes,
+            "merged": merge_exports(list(nodes.values())),
+            "unreachable": unreachable}
+
+
 def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
                     port: int = 0, controller=None):
     """Zero's ops HTTP endpoints (dgraph/cmd/zero/http.go:38-130):
@@ -902,7 +948,11 @@ def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
     GET /removeNode?group=N&addr=A, plus the placement surface —
     GET /placement (controller decision log + load book + config),
     GET /addReplica?tablet=X&group=N, GET /dropReplica?tablet=X&group=N,
-    GET /shipReplica?tablet=X&group=N. Returns (server, bound_port)."""
+    GET /shipReplica?tablet=X&group=N — and the fleet metrics surface
+    (ISSUE 13): GET /metrics/fleet (one Prometheus exposition summing/
+    merging every worker's scrape — histograms merge exactly because
+    buckets are fixed) and GET /debug/fleet (the per-node + merged JSON).
+    Returns (server, bound_port)."""
     import http.server
     import urllib.parse
 
@@ -942,6 +992,21 @@ def serve_zero_http(svc: ZeroService, ops: ZeroOps, host: str = "127.0.0.1",
                 elif u.path == "/shipReplica":
                     self._reply(200, ops.ship_replica_delta(
                         q["tablet"][0], int(q["group"][0])))
+                elif u.path == "/metrics/fleet":
+                    from ..obs import prom as _prom
+
+                    merged = fleet_scrape(svc)["merged"]
+                    body, ctype = _prom.negotiated(
+                        self.headers.get("Accept"),
+                        lambda ex: _prom.render_export(merged,
+                                                       exemplars=ex))
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif u.path == "/debug/fleet":
+                    self._reply(200, fleet_scrape(svc))
                 elif u.path == "/placement":
                     if controller is None:
                         self._reply(200, {"enabled": False,

@@ -6,6 +6,13 @@ cardinality/cache/device attrs), and — when the planner ran — the plan
 summary with estimated cardinalities. Entries live in a bounded ring
 (`/debug/slow`) and optionally append to a JSONL file for offline
 digestion (one JSON object per line; rotation is the operator's job).
+
+The ring is also the landing zone for COST REGRESSIONS (ISSUE 13): the
+cost ledger flags a query whose device cost exceeds k x its plan-shape's
+EWMA baseline via record() directly — bypassing the threshold gate on
+purpose, because a 2ms shape regressing to 40ms never crosses a 500ms
+--slow_query_ms. Those entries carry root="cost_regression" plus
+device_ms/baseline_ms/factor (obs/costs.CostBook).
 """
 
 from __future__ import annotations

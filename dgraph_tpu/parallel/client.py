@@ -15,7 +15,7 @@ import time
 
 from ..coord.zero import TxnConflict
 from ..coord.zero_service import ZeroClient
-from ..obs import otrace
+from ..obs import costs, otrace
 from ..query import dql
 from ..query import mutation as mut
 from ..query import rdf
@@ -96,7 +96,8 @@ class ClusterClient:
                  span_sample: float = 0.0, trace_rng=None,
                  default_timeout_ms: float = 0.0,
                  degraded_reads: bool = True,
-                 retry_rng=None) -> None:
+                 retry_rng=None,
+                 cost_ledger: bool = True) -> None:
         """groups: group id -> replica worker addresses (leader discovered
         via Status polling, re-discovered on failover). Each group is a
         HedgedReplicas set: reads hedge to a second replica after a grace
@@ -142,6 +143,13 @@ class ClusterClient:
         # back over RPC trailing metadata) in tracer.sink
         self.tracer = otrace.Tracer(fraction=span_sample, proc="client",
                                     rng=trace_rng)
+        # cost ledger (ISSUE 13): the querying CLIENT is the root that
+        # assembles ONE cluster-wide cost record per query — each
+        # worker's charges ship back in ServeTask trailing metadata and
+        # graft under the record's per-group map. The client's CostBook
+        # powers the same /debug/top-style ranking client-side.
+        self.cost_ledger = bool(cost_ledger)
+        self.cost_book = costs.CostBook()
 
     def _scope(self, timeout_ms: float | None):
         """Deadline scope for one request: explicit timeout_ms beats the
@@ -314,13 +322,27 @@ class ClusterClient:
         transport = transport_errors()
         qtitle = q.strip().splitlines()[0][:120] if q.strip() else ""
         self.last_degraded = None
+        lg = costs.CostLedger(endpoint="query", shape=q) \
+            if self.cost_ledger else None
         with self._scope(timeout_ms), \
                 self.tracer.root("query", kind="client",
-                                 attrs={"query": qtitle}):
+                                 attrs={"query": qtitle}) as sp, \
+                costs.scope(lg):
             try:
                 for attempt in (0, 1):
                     try:
-                        return self._query_once(q, variables)
+                        out = self._query_once(q, variables)
+                        if lg is not None and (
+                                lg.tasks or lg.device_ms > 0
+                                or lg.groups):
+                            # trivial (all-cache) replays skip record
+                            # assembly — same fast path as Node.query
+                            lg.finish()
+                            self.cost_book.record(
+                                q, "query",
+                                sp.trace_id if sp else "",
+                                lg.to_dict())
+                        return out
                     except DeadlineExceeded:
                         raise
                     except transport as e:

@@ -25,7 +25,7 @@ try:
 except ImportError:              # pragma: no cover
     grpc = None
 
-from ..obs import otrace
+from ..obs import costs, otrace
 from ..protos import internal_pb2 as ipb
 from ..utils import deadline as dl
 from ..utils import faults
@@ -185,7 +185,8 @@ class WorkerService:
     SHIP_BUFFER = 4096       # catch-up window (records) for lagging peers
 
     def __init__(self, store, batching: bool = True,
-                 batch_window_ms: float = 2.0, batch_max: int = 16) -> None:
+                 batch_window_ms: float = 2.0, batch_max: int = 16,
+                 cost_ledger: bool = True) -> None:
         import collections
         import os
         import threading
@@ -197,6 +198,9 @@ class WorkerService:
 
         self.store = store
         self.metrics = metrics_mod.Registry()
+        # per-RPC cost ledger shipping (ISSUE 13): off = serve_task
+        # measures nothing and ships nothing (worker --no_cost_ledger)
+        self.cost_ledger = bool(cost_ledger)
         # joins traces propagated over ServeTask metadata; collected spans
         # ship BACK to the caller in trailing metadata (obs/otrace.py), so
         # the query node assembles one tree — proc is refined to the bound
@@ -308,7 +312,14 @@ class WorkerService:
         Deadline continuation rides the same metadata channel: the
         caller's remaining budget (utils/deadline WIRE_KEY) installs a
         server-side deadline scope so every wait this handler performs —
-        the applied-watermark gate above all — is bounded by it."""
+        the applied-watermark gate above all — is bounded by it.
+
+        Cost continuation (ISSUE 13) rides it too: this group's resource
+        charges for the task — device-kernel ms, transfer bytes, edges,
+        cache/batch outcomes — accumulate on a per-RPC CostLedger and
+        ship back in trailing metadata (obs/costs.WIRE_KEY) next to the
+        spans, so the querying node assembles ONE cluster-wide cost
+        record with per-group sub-records."""
         wire = None
         budget = None
         if context is not None:
@@ -317,23 +328,42 @@ class WorkerService:
                 if k == otrace.WIRE_KEY:
                     wire = v
             budget = dl.from_metadata(md)
+        lg = costs.CostLedger(endpoint="serve_task") \
+            if self.cost_ledger else None
         if not wire:
-            with dl.scope(budget):
-                return self._serve_task_inner(msg, context)
+            try:
+                with dl.scope(budget), costs.scope(lg):
+                    return self._serve_task_inner(msg, context)
+            finally:
+                self._ship_trailing(context, None, lg)
         sp = self.tracer.join(wire, "serve_task",
                               attrs={"attr": msg.attr,
                                      "addr": self.advertise_addr})
         try:
-            with sp, dl.scope(budget):
+            with sp, dl.scope(budget), costs.scope(lg):
                 return self._serve_task_inner(msg, context)
         finally:
+            self._ship_trailing(context, sp, lg)
+
+    def _ship_trailing(self, context, sp, lg) -> None:
+        """Attach the collected spans + the cost record as trailing
+        metadata. An aborted RPC cannot carry trailing metadata: the
+        payloads drop but the span buffer drains either way (no leak)."""
+        md = []
+        if sp is not None:
             spans = self.tracer.take(sp.trace_id)
             if spans:
-                try:
-                    context.set_trailing_metadata(
-                        ((otrace.SPANS_KEY, otrace.encode_spans(spans)),))
-                except Exception:
-                    pass     # context already terminated (abort path)
+                md.append((otrace.SPANS_KEY, otrace.encode_spans(spans)))
+        if lg is not None:
+            lg.finish()
+            md.append((costs.WIRE_KEY, lg.to_wire()))
+        if context is None or not md:
+            return
+        try:
+            context.set_trailing_metadata(tuple(md))
+        except Exception:
+            # context already terminated (abort path)
+            self.metrics.counter("dgraph_cost_ship_failures_total").inc()
 
     def tablet_load_snapshot(self) -> dict:
         return self.tablet_book.snapshot()
@@ -421,7 +451,17 @@ class WorkerService:
         run = solo if self.batcher is None else (
             lambda tq: self.batcher.dispatch(
                 snap, self.store.schema, tq, solo))
-        res = self.task_cache.dispatch(task_token(snap, q), q, run)
+        lg = costs.current()
+        if lg is None:
+            res = self.task_cache.dispatch(task_token(snap, q), q, run)
+        else:
+            # the per-RPC ledger (serve_task): kernel charges below
+            # attribute to this task's predicate; the task's traversed
+            # edges land on its per-predicate row
+            with lg.task(attr):
+                res = self.task_cache.dispatch(task_token(snap, q), q,
+                                               run)
+            lg.add_task(attr, int(res.traversed_edges))
         if msg.replica_read and attr not in self.store.predicates():
             # the controller dropped this replica mid-request: the answer
             # may have been computed over an already-deleted tablet — a
@@ -904,7 +944,25 @@ class WorkerService:
             tablet_sizes_json=cached[2],
             # live, not TTL-cached: load moves far faster than sizes and
             # the snapshot is one locked dict copy
-            tablet_load_json=json.dumps(self.tablet_load_snapshot()))
+            tablet_load_json=json.dumps(self.tablet_load_snapshot()),
+            # compact mergeable metric snapshot on the existing
+            # Status/load-report path (ISSUE 13): Zero's fleet
+            # aggregator sums counters and merges the fixed-bucket
+            # histograms EXACTLY across the cluster (/metrics/fleet).
+            # TTL-cached: Status doubles as the 2s-per-client health
+            # echo and leader probe — a full registry export + JSON
+            # encode per echo is pure waste on that hot path (the fleet
+            # scrape cadence is 15s; 1s staleness is invisible to it)
+            metrics_json=self._metrics_export_json(now))
+
+    _METRICS_TTL = 1.0
+
+    def _metrics_export_json(self, now: float) -> str:
+        cached = getattr(self, "_metrics_cache", None)
+        if cached is None or now - cached[0] > self._METRICS_TTL:
+            cached = (now, json.dumps(self.metrics.export()))
+            self._metrics_cache = cached
+        return cached[1]
 
     # -- distributed sort + schema (worker/sort.go:50, worker/schema.go:160) --
 
@@ -1150,7 +1208,8 @@ class WorkerService:
 def serve_worker(store, addr: str = "localhost:0",
                  max_workers: int = 8, advertise_host: str | None = None,
                  elections: bool = False, batching: bool = True,
-                 batch_window_ms: float = 2.0, batch_max: int = 16):
+                 batch_window_ms: float = 2.0, batch_max: int = 16,
+                 cost_ledger: bool = True):
     """Start a Worker gRPC server for one group's store; returns
     (server, bound_port). advertise_host overrides the callback host
     followers use for FetchState — required when binding a wildcard
@@ -1160,7 +1219,7 @@ def serve_worker(store, addr: str = "localhost:0",
     Node's batched-dispatch knobs for the worker's own device path."""
     svc = WorkerService(store, batching=batching,
                         batch_window_ms=batch_window_ms,
-                        batch_max=batch_max)
+                        batch_max=batch_max, cost_ledger=cost_ledger)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((svc.handler(),))
@@ -1332,11 +1391,19 @@ class RemoteWorker:
             md.append(ddl)
             timeout = dl.clamp(None)
         sp = otrace.current()
-        if sp is None:
+        lg = costs.current()
+        if sp is None and lg is None:
             if not md:
                 return decode_result(self._serve(msg))
             return decode_result(self._serve(msg, metadata=tuple(md),
                                              timeout=timeout))
+        if sp is None:
+            # cost ledger armed without a sampled trace: with_call so the
+            # worker's shipped cost record is readable from the trailer
+            resp, call = self._serve.with_call(
+                msg, metadata=tuple(md) or None, timeout=timeout)
+            self._merge_cost(lg, call)
+            return decode_result(resp)
         # propagate the span context; the worker's spans ride back in
         # trailing metadata and graft into this trace's buffer
         with sp.tracer.start("rpc:ServeTask", parent=sp, kind="client",
@@ -1348,7 +1415,17 @@ class RemoteWorker:
             for k, v in call.trailing_metadata() or ():
                 if k == otrace.SPANS_KEY:
                     rsp.tracer.add_remote(otrace.decode_spans(v))
+            self._merge_cost(lg, call)
             return decode_result(resp)
+
+    def _merge_cost(self, lg, call) -> None:
+        """Graft the worker's shipped cost record (trailing metadata)
+        under the caller's ledger, keyed by this worker's address."""
+        if lg is None:
+            return
+        for k, v in call.trailing_metadata() or ():
+            if k == costs.WIRE_KEY:
+                lg.merge_remote(self.addr, costs.CostLedger.from_wire(v))
 
     def membership(self) -> ipb.MembershipResponse:
         return self._membership(ipb.MembershipRequest())

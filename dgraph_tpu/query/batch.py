@@ -50,7 +50,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from dgraph_tpu.obs import otrace
+from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.utils import deadline as dl
 
 
@@ -197,13 +197,18 @@ def _classify_vector(snap, schema, q):
 # ---------------------------------------------------------------------------
 
 class _Entry:
-    __slots__ = ("work", "solo", "dl", "event", "result", "error",
+    __slots__ = ("work", "solo", "dl", "lg", "event", "result", "error",
                  "batch_size")
 
     def __init__(self, work, solo=None) -> None:
         self.work = work
         self.solo = solo        # zero-arg solo execution (1-entry batches)
         self.dl = dl.current()  # the submitting caller's deadline
+        # the submitting caller's cost ledger: a batched kernel acts for
+        # SEVERAL requests, so its cost is apportioned to the members'
+        # ledgers by slot size (obs/costs.py) — the follower thread is
+        # parked inside its task scope, so attr attribution stays exact
+        self.lg = costs.current()
         self.event = threading.Event()
         self.result: Any = None
         self.error: BaseException | None = None
@@ -262,6 +267,27 @@ class DeviceBatcher:
             return self.gate.run(fn, klass=klass)
         return fn()
 
+    def _timed_gate_run(self, fn: Callable, klass: str):
+        """(result, kernel ms) of one gated batched launch — with the
+        leader's gate QUEUE wait subtracted (it is booked as
+        gate_wait_ms; double-counting it as device ms would flag every
+        shape as regressed whenever the gate is contended). Runs inside
+        a kernel window so the gate's injected-fault charges — already
+        inside dt, which _charge apportions to every member — are not
+        ALSO booked on the leader's ledger."""
+        lg = costs.current()
+        if lg is None:
+            t0 = time.perf_counter()
+            out = self._gate_run(fn, klass)
+            return out, (time.perf_counter() - t0) * 1e3
+        with lg.kernel_window():
+            gw0 = lg.gate_wait_ms
+            t0 = time.perf_counter()
+            out = self._gate_run(fn, klass)
+            dt = (time.perf_counter() - t0) * 1e3
+            dt = max(dt - (lg.gate_wait_ms - gw0), 0.0)
+        return out, dt
+
     def _busy(self) -> bool:
         if self.gate is not None:
             return self.gate.busy()
@@ -279,8 +305,30 @@ class DeviceBatcher:
             self._bypass.inc()
             otrace.event("batch_bypass", kind=kind,
                          remaining_ms=round(rem * 1000, 1))
+            costs.note("batch_bypass")
             return True
         return False
+
+    @staticmethod
+    def _charge(entries: list[_Entry], kernel: str, dt_ms: float,
+                weights: list[float] | None = None,
+                h2d: int = 0, d2h: int = 0) -> None:
+        """Apportion one batched kernel's wall ms + transfer bytes to the
+        members' ledgers by slot weight (frontier degree sum for expand,
+        equal split otherwise)."""
+        n = len(entries)
+        total_w = sum(weights) if weights else float(n)
+        if total_w <= 0:
+            total_w = float(n)
+            weights = None
+        for i, en in enumerate(entries):
+            if en.lg is None:
+                continue
+            frac = (weights[i] / total_w) if weights else 1.0 / n
+            en.lg.add_kernel(kernel, dt_ms * frac,
+                             h2d=int(h2d * frac), d2h=int(d2h * frac))
+            if n > 1:
+                en.lg.note("batched")
 
     def _submit(self, key: tuple, kind: str, work,
                 runner: Callable[[list[_Entry]], None], solo=None):
@@ -470,7 +518,11 @@ class DeviceBatcher:
         try:
             with otrace.span("device_kernel", kernel="batch.expand",
                              need=total, batch=nbatch) as sp:
-                targets = self._gate_run(kernel, "expand")
+                targets, dt_ms = self._timed_gate_run(kernel, "expand")
+                self._charge(entries, "batch.expand", dt_ms,
+                             weights=[float(e.work.need) for e in entries],
+                             h2d=int(rows_cat.nbytes),
+                             d2h=int(targets.nbytes))
                 if sp:
                     sp.set(edges=total,
                            transfer_h2d_bytes=int(rows_cat.nbytes),
@@ -545,7 +597,11 @@ class DeviceBatcher:
         try:
             with otrace.span("device_kernel", kernel="batch.vector_topk",
                              rows=int(vi.n), k=kprime, batch=nbatch) as sp:
-                nd_h, rows_h = self._gate_run(kernel, "vector")
+                (nd_h, rows_h), dt_ms = self._timed_gate_run(kernel,
+                                                             "vector")
+                self._charge(entries, "batch.vector_topk", dt_ms,
+                             h2d=int(Q.nbytes),
+                             d2h=int(nd_h.nbytes + rows_h.nbytes))
                 if sp:
                     sp.set(transfer_h2d_bytes=int(Q.nbytes),
                            transfer_d2h_bytes=int(
@@ -613,6 +669,8 @@ class DeviceBatcher:
 
         with otrace.span("device_kernel", kernel="batch.recurse",
                          depth=depth, batch=nbatch):
-            masks_p, trav, fresh = self._gate_run(kernel, "recurse")
+            (masks_p, trav, fresh), dt_ms = self._timed_gate_run(
+                kernel, "recurse")
+            self._charge(entries, "batch.recurse", dt_ms)
         for i, e in enumerate(entries):
             e.result = (masks_p[i], trav[i], fresh[i])

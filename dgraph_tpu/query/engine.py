@@ -24,7 +24,7 @@ from typing import Any
 
 import numpy as np
 
-from dgraph_tpu.obs import otrace
+from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.ops import uidset as us
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.task import (TaskError, TaskQuery, process_task,
@@ -192,22 +192,37 @@ class Executor:
         The per-task deadline check lives here too: a budgeted multi-hop
         query gives up BETWEEN tasks the moment its budget runs out (typed
         DeadlineExceeded) — even when every remaining task would be a
-        cache hit — instead of finishing work nobody is waiting for."""
-        from dgraph_tpu.obs import otrace
+        cache hit — instead of finishing work nobody is waiting for.
+
+        The cost ledger (obs/costs.py) attributes here as well: the task's
+        predicate scopes every device-kernel charge below (cache tiers,
+        gate, batcher all run inside), and the task's traversed edges land
+        on the per-predicate row — one contextvar read when unarmed."""
+        from dgraph_tpu.obs import costs, otrace
         from dgraph_tpu.utils import deadline as _dl
+
+        def run_ledgered(q):
+            lg = costs.current()
+            if lg is None:
+                return inner(q)
+            attr = q.attr[1:] if q.attr.startswith("~") else q.attr
+            with lg.task(attr):
+                res = inner(q)
+            lg.add_task(attr, int(res.traversed_edges))
+            return res
 
         def traced(q):
             if _dl.current() is not None:      # unbudgeted: zero cost
                 _dl.check(f"task:{q.attr}")
             if otrace.current() is None:
-                return inner(q)
+                return run_ledgered(q)
             attrs = {"attr": q.attr}
             if q.func is not None:
                 attrs["func"] = q.func[0]
             if q.frontier is not None:
                 attrs["frontier"] = int(len(q.frontier))
             with otrace.span("task:" + q.attr, **attrs) as sp:
-                res = inner(q)
+                res = run_ledgered(q)
                 sp.set(dest=int(len(res.dest_uids)),
                        edges=int(res.traversed_edges))
                 return res
@@ -612,7 +627,9 @@ class Executor:
             mcap = 8
             dr = jnp.full((mcap,), int(mat.shape[0]), jnp.int32)
             with otrace.span("device_kernel", kernel="vector.ann_expand",
-                             rows=int(vi.n), k=kprime, ecap=ecap) as sp:
+                             rows=int(vi.n), k=kprime, ecap=ecap) as sp, \
+                    costs.kernel("vector.ann_expand",
+                                 attr=gq.func.attr) as ck:
                 nd, uids, res = self.gated(lambda: vops.ann_expand(
                     mat, norms, jnp.asarray(vec), jnp.int32(vi.n), dr,
                     subs_dev, csr.subjects, csr.indptr, csr.indices,
@@ -622,11 +639,12 @@ class Executor:
                 uids_h = np.asarray(uids).astype(np.int64)
                 counts_h = np.asarray(res.counts)[:kprime]
                 targets_h = np.asarray(res.targets)
+                d2h = int(nd_h.nbytes + uids_h.nbytes
+                          + counts_h.nbytes + targets_h.nbytes)
+                ck.set(d2h=d2h)
                 if sp:
                     sp.set(edges=int(res.total),
-                           transfer_d2h_bytes=int(
-                               nd_h.nbytes + uids_h.nbytes
-                               + counts_h.nbytes + targets_h.nbytes))
+                           transfer_d2h_bytes=d2h)
         except FaultError:
             # injected residency.h2d_upload fault before any result state
             # was written: the classic stepped path (which falls back to
@@ -682,6 +700,13 @@ class Executor:
         child.traversed = traversed
         if self.plan is not None:
             self.plan.record(cgq, traversed, self.explain)
+        lg = costs.current()
+        if lg is not None:
+            # fused child bypassed _dispatch; normalize like every other
+            # attribution site (the fusable check rejects reverse attrs
+            # today, but the stripping must not depend on that)
+            a = cgq.attr
+            lg.add_task(a[1:] if a.startswith("~") else a, traversed)
         self.traversed_edges += traversed
         if self.traversed_edges > self.edge_budget():
             raise QueryError("query exceeded edge budget (ErrTooBig)")
@@ -783,11 +808,23 @@ class Executor:
             # frontier, which is exactly the semantics to preserve
             self._mesh_miss(fp.REASON_FILTER)
             return False
-        levels = self.gated(
-            lambda: self.mesh.run_plan(
-                [(c, h.formula, s, h.first, h.offset)
-                 for c, h, s in zip(csrs, hops, sets)], frontier),
-            klass="mesh")
+        with costs.kernel("mesh.plan") as ck:
+            levels = self.gated(
+                lambda: self.mesh.run_plan(
+                    [(c, h.formula, s, h.first, h.offset)
+                     for c, h, s in zip(csrs, hops, sets)], frontier),
+                klass="mesh")
+        lg = costs.current()
+        if lg is not None and ck.ms > 0:
+            # ONE launch traversed every hop: apportion its device ms to
+            # the per-predicate rows by each hop's traversed edges, so
+            # /debug/top?group=pred points at the tablet actually burning
+            # the device instead of whichever predicate led the chain
+            trav = [max(int(lv[1]), 0) for lv in levels[: len(hops)]]
+            tot = float(sum(trav))
+            for hop, t in zip(hops, trav):
+                frac = (t / tot) if tot > 0 else 1.0 / len(hops)
+                lg.attribute_pred_ms(hop.gq.attr, ck.ms * frac)
         self._mesh_fused += 1
         parent = sg
         fr = frontier
@@ -811,6 +848,12 @@ class Executor:
                     for s_, m in zip(fr, matrix)]
             if self.plan is not None:
                 self.plan.record(hop.gq, traversed, self.explain)
+            lg = costs.current()
+            if lg is not None:
+                # fused hops bypass _dispatch: attribute their traversed
+                # edges to the hop's predicate here instead
+                a = hop.gq.attr
+                lg.add_task(a[1:] if a.startswith("~") else a, traversed)
             self.traversed_edges += traversed
             if self.traversed_edges > self.edge_budget():
                 raise QueryError("query exceeded edge budget (ErrTooBig)")

@@ -42,7 +42,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from dgraph_tpu.obs import otrace
+from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.query.task import TaskQuery, TaskResult
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import faults
@@ -311,16 +311,19 @@ class TaskResultCache(_ByteLRU):
                 res = self._get_locked(fk)
                 if res is not None:
                     otrace.event("task_cache", outcome="hit")
+                    costs.note("task_cache_hit")
                     return copy_result(res)
                 fl = self._flights.get(fk)
                 if fl is None:
                     fl = self._flights[fk] = _Flight()
                     self._misses.inc()
                     otrace.event("task_cache", outcome="miss")
+                    costs.note("task_cache_miss")
                     break                       # we are the flight leader
             # follower: wait for the leader's result outside the lock
             self._coalesced.inc()
             otrace.event("task_cache", outcome="coalesced")
+            costs.note("task_cache_coalesced")
             fl.event.wait()
             if fl.error is not None:
                 raise fl.error
@@ -416,7 +419,9 @@ class DispatchGate:
         self._waits.inc()
         rem = dl.remaining()
         if rem is None:
+            t0 = time.perf_counter()
             self._sem.acquire()
+            costs.add_gate_wait((time.perf_counter() - t0) * 1e3)
             return
         # shed before queueing: a request whose remaining budget cannot
         # cover even one expected device step would only occupy a queue
@@ -431,6 +436,7 @@ class DispatchGate:
             otrace.event("shed", where="dispatch_gate", klass=klass or "",
                          remaining_ms=round(rem * 1000, 1),
                          expected_step_ms=round(est * 1000, 1))
+            costs.note("shed")
             raise ResourceExhausted(
                 f"shed: remaining budget {rem * 1000:.0f}ms < expected "
                 f"{klass or 'device'} step {est * 1000:.0f}ms")
@@ -443,20 +449,35 @@ class DispatchGate:
         if queued is not None:
             self._shed.inc()
             otrace.event("shed", where="dispatch_gate", queue=queued)
+            costs.note("shed")
             raise ResourceExhausted(
                 f"shed: dispatch queue full ({queued} waiting)")
+        t0 = time.perf_counter()
         try:
             ok = self._sem.acquire(timeout=rem)
         finally:
             with self._wlock:
                 self._waiting -= 1
+            costs.add_gate_wait((time.perf_counter() - t0) * 1e3)
         if not ok:
             otrace.event("deadline", where="dispatch_gate")
             raise DeadlineExceeded(
                 f"dispatch gate: no slot within {rem * 1000:.0f}ms budget")
 
     def run(self, fn, klass: str | None = None):
+        tf = time.perf_counter()
         faults.fire("device.dispatch", m=self.metrics)
+        df = time.perf_counter() - tf
+        if df > 1e-4:
+            # an injected submission-latency fault IS device cost the
+            # request paid: charge it to the ledger so /debug/top's
+            # per-shape EWMA baseline flags the regressed shape even when
+            # the query stays under --slow_query_ms (ISSUE 13). Skipped
+            # inside an open kernel-timer window (recurse/mesh/shortest
+            # sites bracket this call) — the timer already counts it.
+            lg = costs.current()
+            if lg is not None and not lg.in_kernel():
+                lg.add_kernel("device.dispatch", df * 1e3)
         self._acquire(klass)
         self._inflight.inc()
         t0 = time.perf_counter()
@@ -466,6 +487,11 @@ class DispatchGate:
             # serialized by the gate exactly like real device occupancy —
             # device.dispatch above models pre-gate submission latency
             faults.fire("device.step", m=self.metrics)
+            ds = time.perf_counter() - t0
+            if ds > 1e-4:
+                lg = costs.current()
+                if lg is not None and not lg.in_kernel():
+                    lg.add_kernel("device.step", ds * 1e3)
             return fn()
         finally:
             dt = time.perf_counter() - t0

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dgraph_tpu.obs import costs
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery, process_task
@@ -309,12 +310,14 @@ def recurse(ex, sg: SubGraph) -> None:
                 fmask = _seeds_mask(frontier, g.num_nodes)
                 # the device step runs through the dispatch gate: N
                 # concurrent recurse queries pipeline instead of thrashing
-                dest_words, trav, seen2, fresh = ex.gated(
-                    lambda: pb.recurse_step(
-                        g.in_src_pad, g.in_iptr_rank, g.subjects,
-                        g.in_subjects, fmask, st["seen"], chunks=g.chunks,
-                        num_nodes=g.num_nodes, allow_loop=spec.allow_loop),
-                    klass="recurse")
+                with costs.kernel("pb.recurse_step", attr=cgq.attr):
+                    dest_words, trav, seen2, fresh = ex.gated(
+                        lambda: pb.recurse_step(
+                            g.in_src_pad, g.in_iptr_rank, g.subjects,
+                            g.in_subjects, fmask, st["seen"],
+                            chunks=g.chunks, num_nodes=g.num_nodes,
+                            allow_loop=spec.allow_loop),
+                        klass="recurse")
                 st["seen"] = seen2
                 dest_words_h, trav_h = jax.device_get((dest_words, trav))
                 edges += int(trav_h)
@@ -382,9 +385,11 @@ def _mesh_recurse_path(ex, sg: SubGraph, cgq, csr, depth: int,
     path runs), so matrices, filter narrowing, and value children are
     byte-identical to build_level's depth recursion by construction."""
     seeds = np.asarray(sg.dest_uids, dtype=np.int64)
-    levels = ex.gated(lambda: mesh.run_recurse(csr, seeds, depth,
-                                               allow_loop, formula, sets),
-                      klass="mesh")
+    with costs.kernel("mesh.recurse", attr=cgq.attr):
+        levels = ex.gated(lambda: mesh.run_recurse(csr, seeds, depth,
+                                                   allow_loop, formula,
+                                                   sets),
+                          klass="mesh")
     ex._mesh_fused += 1
     seen = np.zeros(csr.num_edges, dtype=bool)
     attach = sg.children = []
@@ -445,13 +450,16 @@ def _recurse_fused_path(ex, sg: SubGraph, cgq, csr, depth: int,
     # batched-dispatch seam (query/batch.py): compatible concurrent
     # traversals stack their seed masks into one multi-source dispatch;
     # without a batcher this is exactly the old gated solo call
+    def _solo_fused():
+        with costs.kernel("pb.recurse_fused", attr=cgq.attr):
+            return pb.recurse_fused(
+                g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
+                g.in_subjects, seeds_mask,
+                depth=depth, chunks=g.chunks, chunks_d=g.chunks_d,
+                allow_loop=allow_loop)
+
     masks_p, trav, fresh = ex.batched_recurse(
-        g, seeds_mask, depth, allow_loop,
-        lambda: pb.recurse_fused(
-            g.in_src_pad, g.in_src_pad_d, g.in_iptr_rank, g.subjects,
-            g.in_subjects, seeds_mask,
-            depth=depth, chunks=g.chunks, chunks_d=g.chunks_d,
-            allow_loop=allow_loop))
+        g, seeds_mask, depth, allow_loop, _solo_fused)
     # ONE relay round-trip for the whole traversal, bit-packed in DST-RANK
     # space (fresh flags stay on device until a lazy uidMatrix
     # materialization needs them); host maps ranks -> uids

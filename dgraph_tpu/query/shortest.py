@@ -28,6 +28,7 @@ import heapq
 
 import numpy as np
 
+from dgraph_tpu.obs import costs
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import QueryError, SubGraph
 from dgraph_tpu.query.task import TaskQuery
@@ -245,10 +246,11 @@ def _mesh_shortest_single(ex, sg: SubGraph, csrs, src: int, dst: int):
     max_depth = spec.depth if spec.depth > 0 else 64
     mesh = ex.mesh
     only = [c for _a, c in csrs]
-    dist, hops, edges = ex.gated(
-        lambda: mesh.run_bfs(only, src, max_depth, ex.edge_budget(),
-                             stop_at=dst),
-        klass="shortest")
+    with costs.kernel("mesh.bfs"):
+        dist, hops, edges = ex.gated(
+            lambda: mesh.run_bfs(only, src, max_depth, ex.edge_budget(),
+                                 stop_at=dst),
+            klass="shortest")
     if edges > ex.edge_budget():
         raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
     ex._mesh_fused += 1
@@ -323,9 +325,10 @@ def _mesh_bfs_adjacency(ex, sg: SubGraph, csrs, src: int):
     max_depth = spec.depth if spec.depth > 0 else 64
     mesh = ex.mesh
     only = [c for _a, c in csrs]
-    dist, hops, edges = ex.gated(
-        lambda: mesh.run_bfs(only, src, max_depth, ex.edge_budget()),
-        klass="shortest")
+    with costs.kernel("mesh.bfs"):
+        dist, hops, edges = ex.gated(
+            lambda: mesh.run_bfs(only, src, max_depth, ex.edge_budget()),
+            klass="shortest")
     if edges > ex.edge_budget():
         raise QueryError("shortest path exceeded edge budget (ErrTooBig)")
     ex._mesh_fused += 1

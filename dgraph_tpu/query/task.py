@@ -29,7 +29,7 @@ from dataclasses import dataclass, field
 import numpy as np
 import jax.numpy as jnp
 
-from dgraph_tpu.obs import otrace
+from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.ops import csr as csrops
 from dgraph_tpu.ops import uidset as us
 from dgraph_tpu.storage.csr_build import GraphSnapshot, PredCSR, PredData, TokenIndex
@@ -190,7 +190,8 @@ def _expand_overlay(ov, uids: np.ndarray,
         try:
             with otrace.span("device_kernel", kernel="csr.expand_masked",
                              need=need_base,
-                             cutover=int(cutover or HOST_EXPAND_MAX)) as sp:
+                             cutover=int(cutover or HOST_EXPAND_MAX)) as sp, \
+                    costs.kernel("csr.expand_masked") as ck:
                 res = csrops.expand_masked(base.indptr, base.indices,
                                            jnp.asarray(rb), ro >= 0,
                                            out_cap=cap)
@@ -199,6 +200,7 @@ def _expand_overlay(ov, uids: np.ndarray,
                     # not wherever the lazy value is first read
                     res.targets.block_until_ready()
                 targets_dev = np.asarray(res.targets)  # one D2H, shared
+                ck.set(h2d=int(rb.nbytes), d2h=int(targets_dev.nbytes))
                 if sp:
                     sp.set(edges=need_base,
                            transfer_h2d_bytes=int(rb.nbytes),
@@ -270,7 +272,8 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
                 with otrace.span("device_kernel", kernel="csr.expand",
                                  need=need,
                                  cutover=int(cutover
-                                             or HOST_EXPAND_MAX)) as sp:
+                                             or HOST_EXPAND_MAX)) as sp, \
+                        costs.kernel("csr.expand") as ck:
                     res = csrops.expand(csr.indptr, csr.indices,
                                         jnp.asarray(rows), out_cap=cap)
                     total = int(res.total)   # device sync point
@@ -279,6 +282,8 @@ def _expand_csr(csr: PredCSR, uids: np.ndarray, first: int = 0,
                                             jnp.asarray(rows),
                                             out_cap=total)
                     targets_dev = np.asarray(res.targets)
+                    ck.set(h2d=int(rows.nbytes),
+                           d2h=int(targets_dev.nbytes))
                     if sp:
                         sp.set(edges=total,
                                transfer_h2d_bytes=int(rows.nbytes),
@@ -335,6 +340,7 @@ def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
         return np.unique(np.concatenate(parts)) if parts \
             else np.zeros(0, np.int64)
 
+    costs.add_rows(total)
     if total <= HOST_EXPAND_MAX or _tier_prefer_host(ti):
         return host_union()
     from dgraph_tpu.utils.faults import FaultError
@@ -343,10 +349,12 @@ def _index_uids_for_rows(ti: TokenIndex, rows: list[int]) -> np.ndarray:
     cap = int(indptr_h[-1]) or 1
     try:
         with otrace.span("device_kernel", kernel="csr.expand_dest",
-                         need=total, rows=len(rows)) as sp:
+                         need=total, rows=len(rows)) as sp, \
+                costs.kernel("csr.expand_dest") as ck:
             dest, _total = csrops.expand_dest(ti.indptr, ti.uids, rows_arr,
                                               out_cap=cap)
             out = us.to_numpy(dest).astype(np.int64)
+            ck.set(d2h=int(out.nbytes))
             if sp:
                 sp.set(edges=int(len(out)),
                        transfer_d2h_bytes=int(out.nbytes))
@@ -491,6 +499,7 @@ def process_task(snap: GraphSnapshot, q: TaskQuery,
     # vectorized presence over the device-aligned value table: one
     # searchsorted instead of a dict probe per frontier uid
     # (handleValuePostings' per-uid posting fetch, worker/task.go:319)
+    costs.add_rows(len(frontier))      # value rows scanned host-side
     if pd.value_subjects_host is not None:
         vsub = pd.value_subjects_host
         pos = np.searchsorted(vsub, frontier)

@@ -245,6 +245,9 @@ class ResidencyManager:
         return self.budget <= 0 or int(nbytes) <= self.budget
 
     def note_cold_serve(self) -> None:
+        from dgraph_tpu.obs import costs
+
+        costs.note("cold_serve")
         self._c_cold.inc()
 
     def before_upload(self, owner) -> None:
@@ -588,6 +591,18 @@ def ensure_device(owner, cache_attr: str, build, prefetch: bool = False):
             dev = build()
             setattr(owner, cache_attr, dev)
             mgr.after_upload(owner, prefetch=prefetch)
+            if not prefetch:
+                # warm->HBM upload at SERVE time: the querying request
+                # paid the transfer — charge its cost ledger (prefetch
+                # uploads are the node's background work, not the
+                # query's)
+                from dgraph_tpu.obs import costs
+
+                try:
+                    costs.add_upload(int(owner.device_nbytes()))
+                    costs.note("residency_upload")
+                except Exception:
+                    pass       # accounting must never fail an upload
     return dev
 
 

@@ -10,6 +10,7 @@ rather than maintaining buckets (the /debug surface is low-QPS)."""
 
 from __future__ import annotations
 
+import bisect
 import random
 import threading
 import time
@@ -40,22 +41,77 @@ class Counter:
         return self._v
 
 
+def exp_buckets(start: float, factor: float, count: int) -> tuple:
+    """Exponential bucket upper bounds: start * factor**i, i in [0, count).
+    FIXED bounds are the whole point (ISSUE 13): histograms with identical
+    bounds merge EXACTLY across nodes and over time — sum the per-bucket
+    counts, _sum, and _count — which ring-sample quantiles never can."""
+    out = []
+    v = float(start)
+    for _ in range(max(int(count), 1)):
+        out.append(v)
+        v *= factor
+    return tuple(out)
+
+
+# shared default bucket schemes, picked by metric-name suffix so every
+# node exposes the same bounds for the same metric (merge exactness)
+BUCKETS_SECONDS = exp_buckets(0.0005, 2.0, 16)       # 0.5ms .. ~16s
+BUCKETS_MS = exp_buckets(0.05, 2.0, 18)              # 0.05ms .. ~6.5s
+BUCKETS_BYTES = exp_buckets(256, 4.0, 14)            # 256B .. ~17GB
+BUCKETS_COUNT = exp_buckets(1, 4.0, 16)              # 1 .. ~1e9
+
+
+def default_buckets(name: str) -> tuple:
+    if name.endswith("_s"):
+        return BUCKETS_SECONDS
+    if name.endswith("_ms"):
+        return BUCKETS_MS
+    if name.endswith("_bytes"):
+        return BUCKETS_BYTES
+    return BUCKETS_COUNT
+
+
 class Histogram:
-    """Bounded ring of recent samples; percentiles computed on read."""
+    """Fixed-bucket cumulative histogram + a bounded ring of recent
+    samples.
 
-    __slots__ = ("_ring", "_lock", "count", "total")
+    The buckets (`le` upper bounds, +Inf implicit) are the Prometheus
+    exposition and the fleet-merge unit: identical bounds merge exactly
+    across nodes (obs/prom.py renders them, Registry.export ships them).
+    The ring keeps the /debug/metrics percentile readout (quantiles are
+    NOT on /metrics anymore — they cannot be aggregated).
 
-    def __init__(self, cap: int = 2048) -> None:
+    Each bucket keeps at most one trace EXEMPLAR — the most recent
+    observation that carried a sampled trace id — rendered in OpenMetrics
+    `# {trace_id="..."} value ts` syntax so an operator can jump from a
+    latency bucket straight to the trace that landed in it."""
+
+    __slots__ = ("_ring", "_lock", "count", "total", "bounds",
+                 "bucket_counts", "exemplars")
+
+    def __init__(self, cap: int = 2048, buckets: tuple | None = None) -> None:
         self._ring: deque[float] = deque(maxlen=cap)
         self._lock = threading.Lock()
         self.count = 0
         self.total = 0.0
+        self.bounds: tuple = tuple(buckets) if buckets else BUCKETS_COUNT
+        self.bucket_counts = [0] * (len(self.bounds) + 1)
+        # per-bucket (trace_id, value, unix_ts) — newest sampled wins
+        self.exemplars: list[tuple | None] = [None] * (len(self.bounds) + 1)
 
-    def observe(self, v: float) -> None:
+    def _bucket_of(self, v: float) -> int:
+        return bisect.bisect_left(self.bounds, v)
+
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        b = self._bucket_of(v)
         with self._lock:
             self._ring.append(v)
             self.count += 1
             self.total += v
+            self.bucket_counts[b] += 1
+            if exemplar:
+                self.exemplars[b] = (exemplar, v, time.time())
 
     def snapshot(self) -> dict:
         """count is lifetime; mean and percentiles all describe the same
@@ -71,6 +127,16 @@ class Histogram:
                 "p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99),
                 "max": vals[-1]}
 
+    def export(self) -> dict:
+        """Mergeable state: bounds + per-bucket counts + sum/count (+ the
+        exemplars, which a merge keeps newest-first per bucket)."""
+        with self._lock:
+            return {"bounds": list(self.bounds),
+                    "counts": list(self.bucket_counts),
+                    "sum": self.total, "count": self.count,
+                    "exemplars": [list(e) if e else None
+                                  for e in self.exemplars]}
+
 
 class Meter:
     """Sliding-window event rate (per-endpoint QPS for /debug/metrics).
@@ -78,18 +144,32 @@ class Meter:
     than the retention window from the left (they can never count again)
     instead of rescanning the full ring per call — O(expired + recent),
     not O(cap). The ring bounds memory, so a sustained burst beyond `cap`
-    events/window under-reports — fine for an ops readout."""
+    events/window under-reports — `dropped` counts every mark that
+    evicted a STILL-LIVE timestamp (one inside the retention window), so
+    the QPS readout says when it is lying (snapshot())."""
 
-    __slots__ = ("_ring", "_lock", "window")
+    __slots__ = ("_ring", "_lock", "window", "dropped")
 
     def __init__(self, window: float = 10.0, cap: int = 8192) -> None:
         self.window = window
         self._ring: deque[float] = deque(maxlen=cap)
         self._lock = threading.Lock()
+        self.dropped = 0
 
     def mark(self) -> None:
+        now = time.monotonic()
         with self._lock:
-            self._ring.append(time.monotonic())
+            ring = self._ring
+            if len(ring) == ring.maxlen and ring[0] >= now - self.window:
+                # the append below evicts a mark the window still needs:
+                # the rate is about to under-report
+                self.dropped += 1
+            ring.append(now)
+
+    def snapshot(self) -> dict:
+        """Rate plus its honesty bit: dropped > 0 means the window
+        overflowed the ring and the qps number is a floor, not a rate."""
+        return {"qps": self.rate(), "dropped": self.dropped}
 
     def rate(self, window: float | None = None) -> float:
         """Events/sec over the trailing `window` seconds, clamped to the
@@ -250,7 +330,48 @@ class Registry:
                      "dgraph_placement_cooldown_skips_total",
                      "dgraph_placement_errors_total",
                      "dgraph_replica_reads_total",
-                     "dgraph_replica_fallbacks_total"):
+                     "dgraph_replica_fallbacks_total",
+                     # batched multi-query dispatch (query/batch.py;
+                     # ISSUE 9) — counters created by the batcher too,
+                     # but a node with batching OFF must still expose
+                     # them at 0 (the pre-registration invariant the
+                     # audit test enforces mechanically, ISSUE 13)
+                     "dgraph_batch_formed_total",
+                     "dgraph_batch_tasks_total",
+                     "dgraph_batch_window_waits_total",
+                     "dgraph_batch_deadline_bypass_total",
+                     # mesh deployment mode (parallel/mesh_exec.py;
+                     # ISSUES 6 + 12)
+                     "dgraph_mesh_dispatches_total",
+                     "dgraph_mesh_fused_hops_total",
+                     "dgraph_mesh_traversed_edges_total",
+                     "dgraph_mesh_program_builds_total",
+                     "dgraph_mesh_devices",
+                     "dgraph_mesh_sharded_tablets",
+                     "dgraph_mesh_replicated_tablets",
+                     "dgraph_mesh_residency_deferred_total",
+                     "dgraph_mesh_fused_queries_total",
+                     "dgraph_mesh_unfused_queries_total",
+                     "dgraph_mesh_replay_divergence_total",
+                     # HBM working-set manager (storage/residency.py;
+                     # ISSUE 11)
+                     "dgraph_residency_hbm_bytes",
+                     "dgraph_residency_host_bytes",
+                     "dgraph_residency_admissions_total",
+                     "dgraph_residency_evictions_total",
+                     "dgraph_residency_prefetch_hits_total",
+                     "dgraph_residency_prefetch_wasted_total",
+                     "dgraph_residency_thrash_total",
+                     "dgraph_residency_cold_serves_total",
+                     "dgraph_residency_upload_failures_total",
+                     "dgraph_residency_host_fallbacks_total",
+                     "dgraph_residency_budget_overruns_total",
+                     # host posting-list memory (Node.enforce_memory)
+                     "dgraph_memory_bytes",
+                     # query cost ledger (obs/costs.py; ISSUE 13)
+                     "dgraph_cost_records_total",
+                     "dgraph_cost_regressions_total",
+                     "dgraph_cost_ship_failures_total"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
@@ -260,10 +381,31 @@ class Registry:
         # reads/writes/bytes/serve_ms
         self.keyed_gauges["dgraph_tablet_load"] = KeyedGauge(
             labels=("pred", "group", "stat"))
+        self.keyed_gauges["dgraph_mesh_fallbacks_total"] = KeyedGauge(
+            labels=("reason",))
+        self.keyed_gauges["dgraph_batch_incompatible"] = KeyedGauge()
+        self.keyed_gauges["dgraph_overlay_depth"] = KeyedGauge()
+        self.keyed_gauges["dgraph_residency_tier_bytes"] = KeyedGauge(
+            labels=("tier",))
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s", "dgraph_compaction_s",
-                     "dgraph_planner_est_error_log2"):
-            self.histograms[name] = Histogram()
+                     "dgraph_planner_est_error_log2",
+                     "dgraph_batch_occupancy",
+                     # per-request cost distributions off the ledger
+                     # (obs/costs.py): aggregatable le-bucket histograms
+                     # with trace exemplars, NOT ring quantiles
+                     "dgraph_query_cost_device_ms",
+                     "dgraph_query_cost_edges",
+                     "dgraph_query_cost_bytes",
+                     # per-endpoint HTTP latency (api/http.py observes
+                     # these; pre-registered so a fresh node scrapes 0s)
+                     "dgraph_http_query_latency_s",
+                     "dgraph_http_mutate_latency_s",
+                     "dgraph_http_commit_latency_s",
+                     "dgraph_http_abort_latency_s",
+                     "dgraph_http_alter_latency_s"):
+            self.histograms[name] = Histogram(
+                buckets=default_buckets(name))
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -271,7 +413,11 @@ class Registry:
 
     def histogram(self, name: str) -> Histogram:
         with self._lock:
-            return self.histograms.setdefault(name, Histogram())
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram(
+                    buckets=default_buckets(name))
+            return h
 
     def meter(self, name: str) -> Meter:
         with self._lock:
@@ -287,9 +433,69 @@ class Registry:
         out: dict = {c: m.value for c, m in sorted(self.counters.items())}
         out.update({h: m.snapshot() for h, m in sorted(self.histograms.items())})
         out.update({f"{n}_qps": m.rate() for n, m in sorted(self.meters.items())})
+        out.update({f"{n}_meter_dropped": m.dropped
+                    for n, m in sorted(self.meters.items()) if m.dropped})
         out.update({n: g.snapshot()
                     for n, g in sorted(self.keyed_gauges.items())})
         return out
+
+    def export(self) -> dict:
+        """Compact mergeable snapshot of the whole registry — the payload
+        workers ship on the Status/load-report path (StatusResponse.
+        metrics_json) and Zero's fleet aggregator merges. Counters and
+        keyed gauges sum; fixed-bucket histograms merge EXACTLY because
+        every node uses the same bounds per metric name."""
+        with self._lock:
+            counters = dict(self.counters)
+            histograms = dict(self.histograms)
+            keyed = dict(self.keyed_gauges)
+        return {"counters": {n: c.value for n, c in counters.items()},
+                "histograms": {n: h.export()
+                               for n, h in histograms.items()},
+                "keyed": {n: {"labels": list(g.labels) if g.labels else
+                              None, "vals": g.snapshot()}
+                          for n, g in keyed.items()}}
+
+
+def merge_exports(snaps: list[dict]) -> dict:
+    """Sum/merge per-node Registry.export() snapshots into one fleet
+    view: counters and keyed-gauge values sum; histograms merge
+    bucket-by-bucket (bounds must match — a mismatch drops the straggler
+    series rather than producing a silently-wrong merge); exemplars keep
+    the newest per bucket."""
+    out = {"counters": {}, "histograms": {}, "keyed": {}}
+    for snap in snaps:
+        for n, v in snap.get("counters", {}).items():
+            out["counters"][n] = out["counters"].get(n, 0) + int(v)
+        for n, h in snap.get("histograms", {}).items():
+            cur = out["histograms"].get(n)
+            if cur is None:
+                out["histograms"][n] = {
+                    "bounds": list(h.get("bounds", [])),
+                    "counts": list(h.get("counts", [])),
+                    "sum": float(h.get("sum", 0.0)),
+                    "count": int(h.get("count", 0)),
+                    "exemplars": [list(e) if e else None
+                                  for e in h.get("exemplars", [])]}
+                continue
+            if cur["bounds"] != list(h.get("bounds", [])):
+                continue             # never merge mismatched bucket schemes
+            cur["counts"] = [a + b for a, b in
+                             zip(cur["counts"], h.get("counts", []))]
+            cur["sum"] += float(h.get("sum", 0.0))
+            cur["count"] += int(h.get("count", 0))
+            for i, e in enumerate(h.get("exemplars", [])):
+                if e and (i >= len(cur["exemplars"])
+                          or cur["exemplars"][i] is None
+                          or e[2] > cur["exemplars"][i][2]):
+                    if i < len(cur["exemplars"]):
+                        cur["exemplars"][i] = list(e)
+        for n, g in snap.get("keyed", {}).items():
+            cur = out["keyed"].setdefault(
+                n, {"labels": g.get("labels"), "vals": {}})
+            for k, v in g.get("vals", {}).items():
+                cur["vals"][k] = cur["vals"].get(k, 0) + int(v)
+    return out
 
 
 class Trace:

@@ -112,8 +112,9 @@ class RetryPolicy:
                     raise
                 if self.metrics is not None:
                     self.metrics.counter("dgraph_retry_total").inc()
-                from ..obs import otrace
+                from ..obs import costs, otrace
 
+                costs.note("retries")
                 otrace.event("retry", op=self.name or "call",
                              attempt=attempt + 1,
                              error=type(e).__name__, backoff_ms=

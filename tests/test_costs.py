@@ -1,0 +1,533 @@
+"""Query cost ledger + fleet metrics (ISSUE 13, obs/costs.py):
+per-request resource attribution threaded through every execution seam,
+aggregatable fixed-bucket histograms with trace exemplars, the
+Zero-federated fleet scrape, and the /debug/top sliding-window profiler
+with EWMA regression baselines."""
+
+import json
+import random
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.obs import costs, prom
+from dgraph_tpu.query import task as taskmod
+from dgraph_tpu.utils import faults, metrics
+
+SCHEMA = """
+    name: string @index(exact) .
+    age: int @index(int) .
+    follows: [uid] @reverse .
+"""
+
+
+@pytest.fixture
+def node():
+    n = Node(span_sample=1.0, trace_rng=random.Random(11))
+    n.alter(schema_text=SCHEMA)
+    n.mutate(set_nquads="""
+        _:a <name> "ann" .
+        _:b <name> "bob" .
+        _:c <name> "cid" .
+        _:a <age> "30" .
+        _:a <follows> _:b .
+        _:a <follows> _:c .
+    """, commit_now=True)
+    yield n
+    n.close()
+
+
+# ---------------------------------------------------------------------------
+# ledger unit behavior
+# ---------------------------------------------------------------------------
+
+def test_ledger_accumulates_and_scopes():
+    lg = costs.CostLedger(endpoint="query", shape="{ q }")
+    assert costs.current() is None
+    with costs.scope(lg):
+        assert costs.current() is lg
+        with lg.task("follows"):
+            with costs.kernel("csr.expand") as ck:
+                ck.set(h2d=100, d2h=200)
+            lg.add_task("follows", 7)
+        costs.note("task_cache_hit")
+        costs.add_rows(5)
+    assert costs.current() is None
+    lg.finish()
+    rec = lg.to_dict()
+    t = rec["total"]
+    assert t["edges"] == 7 and t["tasks"] == 1 and t["rows"] == 5
+    assert t["h2d"] == 100 and t["d2h"] == 200
+    assert t["out"] == {"task_cache_hit": 1}
+    assert t["pred"]["follows"][1] == 7         # edges on the pred row
+    assert t["pred"]["follows"][2] == 300       # bytes on the pred row
+    assert "csr.expand" in t["kern"]
+
+
+def test_ledger_wire_roundtrip_and_remote_merge():
+    w = costs.CostLedger(endpoint="serve_task")
+    with w.task("follows"):
+        w.add_kernel("csr.expand", 2.5, h2d=10, d2h=20)
+        w.add_task("follows", 3)
+    w.finish()
+    raw = w.to_wire()
+    rec = costs.CostLedger.from_wire(raw)
+    assert rec["edges"] == 3 and rec["pred"]["follows"][1] == 3
+
+    root = costs.CostLedger(endpoint="query")
+    root.add_task("follows", 3)     # the root attributed the RPC result
+    root.merge_remote("w1:7080", rec)
+    root.merge_remote("w1:7080", rec)   # second RPC to the same worker
+    out = root.to_dict()
+    # physical costs sum; logical counts dedupe against the root's view
+    assert out["groups"]["w1:7080"]["device_ms"] == 5.0
+    assert out["total"]["edges"] == 6       # 2 RPCs' worth, not 9
+    assert out["total"]["device_ms"] == 5.0
+    assert out["total"]["h2d"] == 20
+
+
+def test_scope_none_suppresses_charging():
+    lg = costs.CostLedger()
+    with costs.scope(lg):
+        with costs.scope(None):
+            costs.note("x")
+            with costs.kernel("k"):
+                pass
+        costs.note("y")
+    assert lg.outcomes == {"y": 1}
+
+
+# ---------------------------------------------------------------------------
+# embedded node: assembled record, /debug/top, exemplars
+# ---------------------------------------------------------------------------
+
+def test_embedded_query_assembles_cost_record(node, monkeypatch):
+    monkeypatch.setattr(taskmod, "HOST_EXPAND_MAX", 0)  # force device
+    out, _ = node.query(
+        '{ q(func: eq(name, "ann")) { name follows { name } } }')
+    assert len(out["q"][0]["follows"]) == 2
+    rec = node.cost_book.last()
+    t = rec["total"]
+    assert t["tasks"] >= 2
+    assert t["edges"] == 2
+    assert t["device_ms"] > 0
+    assert "follows" in t["pred"] and t["pred"]["follows"][1] == 2
+    assert t["out"].get("task_cache_miss", 0) >= 1
+    assert rec["trace_id"]
+    # the trace the record names is servable
+    assert node.tracer.sink.get(rec["trace_id"]) is not None
+    assert node.metrics.counters["dgraph_cost_records_total"].value >= 1
+
+
+def test_result_cache_hit_skips_book_but_notes_outcome(node):
+    q = '{ q(func: eq(name, "bob")) { name } }'
+    node.query(q)
+    n0 = len(node.cost_book)
+    c0 = node.metrics.counters["dgraph_cost_records_total"].value
+    assert c0 >= 1
+    node.query(q)                       # replay: whole-result cache hit
+    assert len(node.cost_book) == n0    # zero-cost records stay out
+    # the records counter means "admitted to the cost surfaces" — a
+    # trivial cache-hit replay must not move it
+    assert node.metrics.counters["dgraph_cost_records_total"].value == c0
+
+
+def test_no_cost_ledger_measures_nothing():
+    n = Node(cost_ledger=False)
+    n.alter(schema_text=SCHEMA)
+    n.mutate(set_nquads='_:a <name> "ann" .', commit_now=True)
+    n.query('{ q(func: eq(name, "ann")) { name } }')
+    assert len(n.cost_book) == 0
+    assert n.metrics.counters["dgraph_cost_records_total"].value == 0
+    n.close()
+
+
+def test_cost_histograms_carry_resolvable_exemplar(node):
+    node.query('{ q(func: eq(name, "ann")) { name follows { name } } }')
+    # exemplars are OpenMetrics-only syntax: the classic text-format
+    # exposition (what an un-negotiated Prometheus scrape gets) must NOT
+    # carry them — a 0.0.4 parser rejects the '# {...}' suffix and would
+    # drop the whole scrape
+    assert "# {trace_id=" not in prom.render(node.metrics)
+    text = prom.render(node.metrics, exemplars=True)
+    series = prom.parse(text)
+    ex = [lbl["__exemplar__"]
+          for lbl, _ in series.get("dgraph_query_cost_device_ms_bucket", [])
+          if lbl.get("__exemplar__")]
+    ex += [lbl["__exemplar__"]
+           for lbl, _ in series.get("dgraph_query_latency_s_bucket", [])
+           if lbl.get("__exemplar__")]
+    assert ex, "no exemplar rendered on the cost/latency histograms"
+    assert node.tracer.sink.get(ex[0]) is not None, \
+        "exemplar trace id must resolve at /debug/traces/<id>"
+
+
+def test_debug_top_ranks_shapes_and_preds(node, monkeypatch):
+    monkeypatch.setattr(taskmod, "HOST_EXPAND_MAX", 0)
+    hot = '{ q(func: eq(name, "ann")) { name follows { name } } }'
+    cold = '{ q(func: eq(name, "cid")) { name } }'
+    for i in range(4):
+        node.query(hot, variables={"$i": str(i)})
+        node.query(cold, variables={"$i": str(i)})
+    top = node.cost_book.top(window_s=60, by="device_ms", group="shape")
+    assert top["records_in_window"] >= 2
+    assert top["top"][0]["key"].startswith("{ q(func: eq(name,")
+    assert top["top"][0]["device_ms"] >= top["top"][-1]["device_ms"]
+    by_pred = node.cost_book.top(by="edges", group="pred")
+    assert any(r["key"] == "follows" and r["edges"] > 0
+               for r in by_pred["top"])
+    by_ep = node.cost_book.top(group="endpoint")
+    assert by_ep["top"] and by_ep["top"][0]["key"] == "query"
+
+
+def test_regression_flagged_into_slowlog_below_threshold():
+    """A shape whose device cost jumps k x over its EWMA baseline lands
+    in the slow-query ring via a seeded device.dispatch delay fault —
+    even though every run stays far under the 10s slow_query_ms."""
+    n = Node(span_sample=0.0, slow_query_ms=10_000.0,
+             cost_regression_factor=4.0)
+    n.alter(schema_text=SCHEMA)
+    n.mutate(set_nquads='_:a <name> "ann" .', commit_now=True)
+    q = '{ q(func: eq(name, "ann")) { name } }'
+    # warm the baseline past MIN_SAMPLES (vary a variable so the
+    # whole-result cache misses and the record is a real execution)
+    for i in range(costs.CostBook.MIN_SAMPLES + 2):
+        n.query(q, variables={"$i": str(i)})
+    assert not any(e.get("root") == "cost_regression"
+                   for e in n.slow_log.recent())
+    faults.GLOBAL.configure("device.dispatch:delay:1:0.05")
+    n.task_cache.clear()     # the regressed run must actually dispatch
+    try:
+        n.query(q, variables={"$i": "regressed"})
+    finally:
+        faults.GLOBAL.clear(None)
+    entries = [e for e in n.slow_log.recent()
+               if e.get("root") == "cost_regression"]
+    assert entries, "regressed shape never reached the slowlog ring"
+    e = entries[0]
+    assert e["device_ms"] > 4 * max(e["baseline_ms"],
+                                    costs.CostBook.BASELINE_FLOOR_MS)
+    assert e["query"].startswith("{ q(func:")
+    assert n.metrics.counters["dgraph_cost_regressions_total"].value == 1
+    top = n.cost_book.top(by="device_ms", group="shape")
+    assert top["flagged_total"] == 1
+    n.close()
+
+
+# ---------------------------------------------------------------------------
+# fixed-bucket histograms: merge exactness + exposition
+# ---------------------------------------------------------------------------
+
+def test_histogram_fixed_buckets_merge_exactly():
+    a = metrics.Histogram(buckets=metrics.BUCKETS_SECONDS)
+    b = metrics.Histogram(buckets=metrics.BUCKETS_SECONDS)
+    rng = random.Random(3)
+    for _ in range(200):
+        a.observe(rng.random())
+        b.observe(rng.random() * 4)
+    merged = metrics.merge_exports([
+        {"histograms": {"h": a.export()}},
+        {"histograms": {"h": b.export()}}])["histograms"]["h"]
+    assert merged["count"] == a.count + b.count
+    assert merged["sum"] == pytest.approx(a.total + b.total)
+    assert merged["counts"] == [
+        x + y for x, y in zip(a.export()["counts"], b.export()["counts"])]
+
+
+def test_histogram_bucket_of_le_semantics():
+    h = metrics.Histogram(buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 3.0, 100.0):
+        h.observe(v)
+    ex = h.export()
+    # le buckets: 1.0 holds {0.5, 1.0}; 2.0 none; 4.0 holds 3.0; +Inf 100
+    assert ex["counts"] == [2, 0, 1, 1]
+
+
+def test_mismatched_bucket_schemes_never_merge():
+    a = metrics.Histogram(buckets=(1.0, 2.0))
+    b = metrics.Histogram(buckets=(1.0, 3.0))
+    a.observe(0.5)
+    b.observe(0.5)
+    m = metrics.merge_exports([
+        {"histograms": {"h": a.export()}},
+        {"histograms": {"h": b.export()}}])["histograms"]["h"]
+    assert m["count"] == 1          # the straggler dropped, not mis-merged
+
+
+def test_meter_counts_overflow_drops():
+    m = metrics.Meter(window=10.0, cap=4)
+    for _ in range(4):
+        m.mark()
+    assert m.dropped == 0
+    m.mark()                        # evicts a mark still in the window
+    m.mark()
+    assert m.dropped == 2
+    snap = m.snapshot()
+    assert snap["dropped"] == 2 and snap["qps"] > 0
+    # expired marks evicted by cap are NOT lies: nothing in-window lost
+    m2 = metrics.Meter(window=0.01, cap=4)
+    for _ in range(4):
+        m2.mark()
+    time.sleep(0.02)
+    m2.mark()
+    assert m2.dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: mechanical pre-registration audit
+# ---------------------------------------------------------------------------
+
+# f-string placeholders used at metric call sites, expanded mechanically;
+# a NEW placeholder must be added here or the audit fails (that is the
+# point: the invariant stays mechanical, not hand-maintained)
+_PLACEHOLDERS = {
+    "prefix": ("task", "result"),
+    "ep": ("query", "mutate", "commit", "abort", "alter"),
+}
+
+_CALL_RE = re.compile(
+    r"""(?:counter|histogram|keyed)\(\s*f?["'](dgraph_[a-zA-Z0-9_{}]+)["']""")
+
+
+def _expand(name: str) -> list[str]:
+    m = re.search(r"\{(\w+)\}", name)
+    if m is None:
+        return [name]
+    key = m.group(1)
+    assert key in _PLACEHOLDERS, \
+        f"unknown metric-name placeholder {{{key}}} in {name!r}: add its " \
+        f"expansion to _PLACEHOLDERS so the audit stays mechanical"
+    out = []
+    for v in _PLACEHOLDERS[key]:
+        out.extend(_expand(name.replace("{%s}" % key, v)))
+    return out
+
+
+def test_every_incremented_metric_is_preregistered():
+    """Walk the source for every dgraph_* name passed to a metric
+    constructor and assert each appears on a FRESH node's /metrics at
+    value 0 — PRs 5-12 hand-maintained this; now it is mechanical."""
+    pkg = Path(costs.__file__).resolve().parent.parent
+    names: set[str] = set()
+    for py in pkg.rglob("*.py"):
+        for m in _CALL_RE.finditer(py.read_text()):
+            names.update(_expand(m.group(1)))
+    assert len(names) > 80, f"audit scan looks broken: {len(names)} names"
+    n = Node()
+    try:
+        text = prom.render(n.metrics)
+        series = prom.parse(text)
+        missing = []
+        for name in sorted(names):
+            present = (name in series or f"{name}_count" in series
+                       or f"# TYPE {name} " in text)
+            if not present:
+                missing.append(name)
+        assert not missing, \
+            f"metrics incremented somewhere but absent from a fresh " \
+            f"node's /metrics: {missing} — pre-register them in " \
+            f"utils/metrics.Registry"
+    finally:
+        n.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: concurrent debug surfaces under live load
+# ---------------------------------------------------------------------------
+
+def test_debug_surfaces_concurrent_with_mixed_workload(node):
+    srv = make_server(node, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    node.query('{ q(func: eq(name, "ann")) { name follows { name } } }')
+    tid = node.cost_book.last()["trace_id"]
+    stop = threading.Event()
+    errors: list = []
+
+    def workload():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                node.query('{ q(func: eq(name, "ann")) '
+                           '{ name follows { name } } }',
+                           variables={"$i": str(i)})
+                if i % 5 == 0:
+                    node.mutate(
+                        set_nquads=f'_:x <name> "w{i}" .', commit_now=True)
+            except Exception as e:      # noqa: BLE001
+                errors.append(("workload", e))
+
+    def hammer(path, check_prom=False):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    body = r.read()
+                    if r.status >= 500:
+                        errors.append((path, r.status))
+                    if check_prom:
+                        prom.parse(body.decode())
+                    elif path != "/metrics":
+                        json.loads(body)
+            except urllib.error.HTTPError as e:
+                if e.code >= 500:
+                    errors.append((path, e.code))
+            except Exception as e:      # noqa: BLE001
+                errors.append((path, e))
+
+    threads = [threading.Thread(target=workload, daemon=True)
+               for _ in range(2)]
+    for spec in (("/metrics", True), ("/debug/metrics", False),
+                 ("/debug/top", False), (f"/debug/traces/{tid}", False),
+                 ("/metrics", True), ("/debug/metrics", False),
+                 ("/debug/top?by=edges&group=pred", False),
+                 ("/debug/vars", False)):
+        threads.append(threading.Thread(target=hammer, args=spec,
+                                        daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(2.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    srv.shutdown()
+    assert not errors, f"debug surfaces failed under load: {errors[:5]}"
+
+
+# ---------------------------------------------------------------------------
+# wire cluster: one assembled record + fleet merge exactness
+# ---------------------------------------------------------------------------
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture
+def wire_cluster():
+    from dgraph_tpu.coord.zero import Zero
+    from dgraph_tpu.coord.zero_service import ZeroClient, serve_zero
+    from dgraph_tpu.parallel.client import ClusterClient
+    from dgraph_tpu.parallel.remote import serve_worker
+    from dgraph_tpu.storage.store import Store
+    from dgraph_tpu.utils.schema import parse_schema
+
+    def mk():
+        s = Store()
+        for e in parse_schema(SCHEMA):
+            s.set_schema(e)
+        return s
+
+    zero = Zero(2)
+    zero.move_tablet("name", 0)
+    zero.move_tablet("follows", 1)
+    zero.move_tablet("age", 1)
+    zsrv, zport, zsvc = serve_zero(zero, "localhost:0")
+    w0, p0 = serve_worker(mk(), "localhost:0")
+    w1, p1 = serve_worker(mk(), "localhost:0")
+    # register with Zero's membership so the fleet scrape finds them
+    zc = ZeroClient(f"localhost:{zport}")
+    zc.connect(f"localhost:{p0}", 0)
+    zc.connect(f"localhost:{p1}", 1)
+    zc.close()
+    client = ClusterClient(
+        f"localhost:{zport}",
+        {0: [f"localhost:{p0}"], 1: [f"localhost:{p1}"]},
+        span_sample=1.0, trace_rng=random.Random(7))
+    client.mutate(set_nquads="""
+        _:a <name> "ann" .
+        _:b <name> "bob" .
+        _:c <name> "cid" .
+        _:a <age> "30" .
+        _:a <follows> _:b .
+        _:a <follows> _:c .
+    """)
+    yield client, zsvc, (f"localhost:{p0}", f"localhost:{p1}")
+    client.close()
+    w0.stop(0)
+    w1.stop(0)
+    zsrv.stop(0)
+
+
+def test_cross_shard_query_one_merged_cost_record(wire_cluster,
+                                                 monkeypatch):
+    """ISSUE 13 acceptance: a cross-shard query yields ONE assembled
+    record whose per-group device ms/bytes/edges match the spans."""
+    client, _zsvc, addrs = wire_cluster
+    monkeypatch.setattr(taskmod, "HOST_EXPAND_MAX", 0)
+    out = client.query(
+        '{ q(func: eq(name, "ann")) { name age follows { name } } }')
+    assert len(out["q"][0]["follows"]) == 2
+    rec = client.cost_book.last()
+    # both groups shipped their cost records back over trailing metadata
+    assert set(rec["groups"]) == set(addrs), rec["groups"].keys()
+    t = rec["total"]
+    assert t["edges"] == 2                      # logical, not double-booked
+    g_follows = rec["groups"][addrs[1]]
+    assert g_follows["pred"]["follows"][1] == 2
+    assert g_follows["device_ms"] > 0
+    # per-group device charges reconcile against the shipped spans: every
+    # group's device_kernel span total is <= that group's ledger device
+    # ms (the ledger times the same fenced section), and a group with
+    # kernel spans has nonzero ledger charges
+    trace = client.tracer.sink.get(rec["trace_id"])
+    assert trace is not None
+    by_proc: dict = {}
+    for s in trace["spans"]:
+        if s["name"] == "device_kernel":
+            by_proc.setdefault(s["proc"], 0.0)
+            by_proc[s["proc"]] += s["dur"] * 1e3
+    assert by_proc, "no device spans shipped"
+    for proc, span_ms in by_proc.items():
+        addr = proc.split(":", 1)[1] if ":" in proc else proc
+        g = rec["groups"].get(addr)
+        assert g is not None, (proc, rec["groups"].keys())
+        assert g["device_ms"] >= span_ms * 0.5, \
+            f"{addr}: ledger {g['device_ms']}ms vs spans {span_ms}ms"
+    # the shipped per-group edge counts agree with the span annotations
+    span_edges = sum(s["attrs"].get("edges", 0)
+                     for s in trace["spans"]
+                     if s["name"] == "device_kernel"
+                     and s["attrs"].get("kernel") == "csr.expand")
+    assert span_edges == g_follows["pred"]["follows"][1]
+
+
+def test_fleet_scrape_merge_equals_per_node_sum(wire_cluster):
+    """ISSUE 13 acceptance: /metrics/fleet histogram _sum/_count equal
+    the sum of the per-node scrapes (merge exactness)."""
+    from dgraph_tpu.coord.zero_service import fleet_scrape
+
+    client, zsvc, addrs = wire_cluster
+    for i in range(3):
+        client.query('{ q(func: eq(name, "ann")) { name follows '
+                     '{ name } } }', variables={"$i": str(i)})
+    fl = fleet_scrape(zsvc)
+    assert set(fl["nodes"]) == set(addrs), fl["unreachable"]
+    merged = fl["merged"]
+    per = list(fl["nodes"].values())
+    for cname in ("dgraph_task_cache_misses_total",
+                  "dgraph_posting_writes_total"):
+        assert merged["counters"][cname] == \
+            sum(p["counters"][cname] for p in per)
+    for hname, h in merged["histograms"].items():
+        assert h["count"] == sum(
+            p["histograms"][hname]["count"] for p in per
+            if hname in p["histograms"])
+        assert h["sum"] == pytest.approx(sum(
+            p["histograms"][hname]["sum"] for p in per
+            if hname in p["histograms"]))
+        cum = 0
+        total = 0
+        for c in h["counts"]:
+            total += c
+        assert total == h["count"]
+    # and the merged exposition is valid prom text
+    text = prom.render_export(merged)
+    series = prom.parse(text)
+    assert series

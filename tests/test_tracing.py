@@ -249,10 +249,18 @@ def test_prometheus_exposition_parses(http_node):
     assert st == 200
     series = prom.parse(body.decode())      # raises on malformed output
     assert series["dgraph_num_queries_total"][0][1] >= 1
-    # histogram summary shape: quantile labels + _sum/_count
-    assert any(lbl.get("quantile") == "0.50"
-               for lbl, _ in series.get("dgraph_query_latency_s", []))
+    # fixed-bucket histogram shape (ISSUE 13): cumulative le buckets +
+    # _sum/_count — the OLD quantile-label summary rows are gone from
+    # /metrics (they can't be aggregated across nodes; the ring
+    # percentiles stay on /debug/metrics)
+    buckets = series.get("dgraph_query_latency_s_bucket", [])
+    assert buckets and any(lbl.get("le") == "+Inf" for lbl, _ in buckets)
     assert "dgraph_query_latency_s_count" in series
+    assert not any("quantile" in lbl for samples in series.values()
+                   for lbl, _ in samples)
+    # bucket counts are cumulative and monotone
+    vals = [v for lbl, v in buckets]
+    assert vals == sorted(vals)
     # meters render as labeled endpoint gauges
     assert any(lbl.get("endpoint") == "query"
                for lbl, _ in series.get("dgraph_endpoint_qps", []))
