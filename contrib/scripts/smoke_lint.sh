@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + the dgraph-analyze clean gate + the
+# lockdep-armed chaos subset (ISSUE 14 static analysis + lockdep).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1
+# to skip when the full suite already ran in an earlier CI stage).
+# Step 2 runs the static analyzer over the whole package — every project
+# invariant (metric pre-registration, ctxvar discipline, deadline
+# discipline, seam taxonomy, JAX purity, fault-point cross-check, static
+# lock order) must come up CLEAN, in under 10s, and the known-bad
+# fixtures must still FLAG (the analyzer itself is being smoke-tested).
+# Step 3 runs the chaos schedules with the runtime lockdep verifier
+# armed: any lock-order inversion observed under fault injection fails
+# the run with both witness stacks.
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== dgraph-analyze: package must be clean =="
+start=$(date +%s)
+python -m dgraph_tpu.analysis dgraph_tpu/
+elapsed=$(( $(date +%s) - start ))
+echo "analyzer clean in ${elapsed}s"
+if [ "$elapsed" -ge 10 ]; then
+  echo "analyzer blew the 10s budget" >&2
+  exit 1
+fi
+
+echo "== dgraph-analyze: known-bad fixtures must still flag =="
+if python -m dgraph_tpu.analysis tests/fixtures/analysis/ \
+    --format=json > /tmp/_lint_fixtures.json; then
+  echo "fixtures came back clean — the analyzer is broken" >&2
+  exit 1
+fi
+python - <<'EOF'
+import json
+out = json.load(open("/tmp/_lint_fixtures.json"))
+rules = {f["rule"] for f in out["findings"]}
+want = {"metric-registration", "ctxvar-copy", "deadline-wait",
+        "except-seam", "rpc-error-taxonomy", "jax-purity",
+        "fault-points", "lock-order"}
+missing = want - rules
+assert not missing, f"rules that no longer flag their fixture: {missing}"
+print(f"all {len(want)} rules flag their fixtures "
+      f"({len(out['findings'])} findings)")
+EOF
+
+echo "== lockdep-armed chaos subset =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_chaos.py tests/test_locks.py -q -m 'not slow' \
+  -p no:cacheprovider -p no:randomly
+
+echo "smoke_lint OK"

@@ -239,6 +239,7 @@ def cmd_worker(args) -> int:
                     pass                   # zero down: next tick retries
 
         if args.membership_interval > 0:
+            # dgraph: allow(ctxvar-copy) detached membership bg loop
             threading.Thread(target=membership_loop, daemon=True).start()
     lg.info(f"worker serving {len(store.predicates())} tablets on "
             f"{args.host}:{port}")
@@ -327,6 +328,7 @@ def cmd_zero(args) -> int:
                         lg.info("rebalanced", **out)
                 except Exception as e:       # noqa: BLE001 — next tick retries
                     lg.error("rebalance error", error=str(e))
+        # dgraph: allow(ctxvar-copy) detached console-stats bg loop
         threading.Thread(target=loop, daemon=True).start()
     lg.info(f"zero serving {args.groups} groups on {args.host}:{port}")
     try:

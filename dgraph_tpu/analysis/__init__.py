@@ -1,0 +1,29 @@
+"""dgraph-analyze: project-invariant static analysis (ISSUE 14).
+
+An AST-walking checker framework encoding the invariants this codebase's
+review rounds kept re-litigating by hand — metric pre-registration,
+contextvar discipline across thread seams, deadline discipline at
+blocking waits, the seam error taxonomy, JAX purity/donation rules, the
+fault-point registry cross-check, and static lock-order extraction (the
+compile-time sibling of utils/locks.py lockdep).
+
+Run it:
+
+    python -m dgraph_tpu.analysis dgraph_tpu/          # whole package
+    python -m dgraph_tpu.analysis --rule deadline-wait path/to/file.py
+    python -m dgraph_tpu.analysis --format=json dgraph_tpu/
+
+Suppress a finding where the flagged code is deliberate:
+
+    pool.submit(self._loop)   # dgraph: allow(ctxvar-copy) detached bg loop
+
+(the comment goes on the flagged line or the line directly above; the
+rationale after the closing paren is free text, but write one). The
+analyzer runs as a tier-1 test over the whole package and must come up
+clean — docs/dev.md "Project invariants" documents every rule.
+"""
+
+from .core import Finding, SourceFile
+from .runner import RULES, analyze_paths
+
+__all__ = ["Finding", "SourceFile", "RULES", "analyze_paths"]

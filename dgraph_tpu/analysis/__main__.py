@@ -1,0 +1,64 @@
+"""CLI: `python -m dgraph_tpu.analysis [paths] [--rule R] [--format F]`.
+
+Exit status: 0 clean, 1 findings, 2 usage error — so CI can gate on it
+(contrib/scripts/smoke_lint.sh does). `--format=json` emits a machine-
+readable finding list; `--list-rules` prints every rule with its doc.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from .runner import RULES, analyze_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dgraph_tpu.analysis",
+        description="dgraph-tpu project-invariant static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "installed dgraph_tpu package)")
+    ap.add_argument("--rule", action="append", dest="rules",
+                    metavar="RULE",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:24s} {RULES[name]().doc}")
+        return 0
+
+    paths = [Path(p) for p in args.paths] or \
+        [Path(__file__).resolve().parent.parent]
+    for p in paths:
+        if not p.exists():
+            print(f"error: no such path {p}", file=sys.stderr)
+            return 2
+    t0 = time.perf_counter()
+    try:
+        findings = analyze_paths(paths, args.rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+    if args.format == "json":
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "elapsed_s": round(dt, 3)}, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"{len(findings)} finding(s) "
+              f"[{', '.join(sorted(args.rules or RULES))}] "
+              f"in {dt:.2f}s", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

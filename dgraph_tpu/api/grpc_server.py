@@ -25,6 +25,7 @@ import grpc
 from ..coord.zero import TxnConflict
 from ..query import mutation as mut
 from ..query.task import TaskError
+from ..utils.errors import Unavailable
 from ..protos import api_pb2 as pb
 from .server import Node
 
@@ -148,6 +149,6 @@ def serve_grpc(node: Node, addr: str = "localhost:9080",
         port = server.add_insecure_port(addr)
     if port == 0:
         # grpc signals bind failure by returning 0, not raising
-        raise RuntimeError(f"could not bind gRPC listener on {addr}")
+        raise Unavailable(f"could not bind gRPC listener on {addr}")
     server.start()
     return server, port

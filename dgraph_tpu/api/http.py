@@ -526,6 +526,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._send(200, json.dumps(
             {"code": "Success", "message": "Server is shutting down"}).encode())
+        # dgraph: allow(ctxvar-copy) one-shot shutdown helper thread
         threading.Thread(target=self.server.shutdown, daemon=True).start()
 
     def _debug_faults(self):
@@ -698,6 +699,8 @@ def make_server(node: Node, host: str = "127.0.0.1", port: int = 8080,
 
 def serve_forever(node: Node, host: str = "127.0.0.1", port: int = 8080):
     srv = make_server(node, host, port)
+    # dgraph: allow(ctxvar-copy) server accept loop: each request gets
+    # its own fresh context at the handler
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv

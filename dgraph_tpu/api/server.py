@@ -41,6 +41,7 @@ from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData,
 from dgraph_tpu.storage.postings import Op
 from dgraph_tpu.storage.store import Store
 from dgraph_tpu.parallel.scheduler import Scheduler
+from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.schema import parse_schema
 
@@ -271,8 +272,11 @@ class Node:
                 try:
                     if self.memory_budget > 0:
                         self.enforce_memory(self.memory_budget)
+                # dgraph: allow(except-seam) bg maintenance tick: next
+                # tick retries; a dead enforcer must not kill the loop
                 except Exception:
                     pass
+        # dgraph: allow(ctxvar-copy) detached memory-enforcer bg loop
         threading.Thread(target=loop, daemon=True).start()
 
     # value-posting slots (lang/value fingerprints) carry the 1<<60 / 1<<61
@@ -358,6 +362,20 @@ class Node:
                     self.zero.oracle.abort(ts)
         return ctx
 
+    def _drain_inflight(self, ctx, clamped: bool = True) -> None:
+        """Wait out this txn's in-flight mutation applies, clamped to the
+        caller's deadline — the lifeline contract: a budgeted commit or
+        read never hangs behind a wedged apply (unbudgeted callers keep
+        the exact old blocking wait). abort() drains UNclamped: it is the
+        cleanup that unpins the oracle's conflict-GC watermark, and
+        bailing on an expired budget would leak the keyed txn forever
+        (the janitor only reaps pristine txns). Caller holds self._lock;
+        the condition releases it while waiting."""
+        while ctx.inflight:
+            if not self._inflight_cv.wait(
+                    dl.clamp(None) if clamped else None):
+                dl.check("txn inflight drain")
+
     def commit(self, start_ts: int) -> int:
         """CommitOrAbort (edgraph/server.go:462). Returns commit_ts; raises
         TxnConflict after aborting the txn's buffered layers on conflict."""
@@ -370,8 +388,7 @@ class Node:
             # otherwise a steady write stream could starve this wait and
             # late mutations would silently ride the commit
             ctx.finishing = True
-            while ctx.inflight:
-                self._inflight_cv.wait()
+            self._drain_inflight(ctx)
             if self._txns.pop(start_ts, None) is None:
                 # a concurrent commit/abort won the race while we waited
                 raise mut.MutationError(f"unknown txn {start_ts}")
@@ -395,8 +412,7 @@ class Node:
             ctx = self._txns.get(start_ts)
             if ctx is not None:
                 ctx.finishing = True
-                while ctx.inflight:
-                    self._inflight_cv.wait()
+                self._drain_inflight(ctx, clamped=False)
             ctx = self._txns.pop(start_ts, None)
             self.zero.oracle.abort(start_ts)
             if ctx is not None:
@@ -432,8 +448,11 @@ class Node:
                 try:
                     if self._assembler.compact_candidates():
                         self._assembler.compact(self._lock)
+                # dgraph: allow(except-seam) next tick retries; queries
+                # are unaffected by a failed compaction attempt
                 except Exception:
-                    pass     # next tick retries; queries are unaffected
+                    pass
+        # dgraph: allow(ctxvar-copy) detached compaction bg loop
         threading.Thread(target=loop, daemon=True,
                          name="dgt-rollup").start()
 
@@ -492,8 +511,7 @@ class Node:
                 ctx.last_active = time.monotonic()
                 # drain this txn's in-flight applies: the overlay build reads
                 # the uncommitted layer dicts a concurrent apply mutates
-                while ctx.inflight:
-                    self._inflight_cv.wait()
+                self._drain_inflight(ctx)
             if ctx is not None and ctx.preds:
                 base = self.snapshot(read_ts)
                 snap = GraphSnapshot(read_ts)

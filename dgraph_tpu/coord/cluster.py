@@ -429,6 +429,7 @@ class Cluster:
                 self._stop_rebalance.wait(interval_s)
 
         self._stop_rebalance = threading.Event()
+        # dgraph: allow(ctxvar-copy) detached rebalance bg loop
         self._rebalance_thread = threading.Thread(target=loop, daemon=True)
         self._rebalance_thread.start()
 

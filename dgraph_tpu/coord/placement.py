@@ -43,7 +43,7 @@ import time
 from collections import deque
 from dataclasses import dataclass
 
-from ..utils import faults
+from ..utils import faults, locks
 
 WRITE_WEIGHT = 2.0     # a write costs ~2 reads (apply + invalidation)
 
@@ -238,7 +238,7 @@ class TabletLoadBook:
     controller's inputs are inspectable independently of its decisions."""
 
     def __init__(self, metrics=None, group: int = 0) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("placement.TabletLoadBook._lock")
         self._rows: dict[str, list[float]] = {}
         self.group = int(group)
         self._gauge = (metrics.keyed("dgraph_tablet_load",
@@ -303,11 +303,11 @@ class PlacementController:
             else metrics_mod.Registry()
         self.log = logger
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("placement.PlacementController._lock")
         # journal lock is separate and tiny: GET /placement must stay
         # readable WHILE a tick streams a multi-second move under _lock —
         # the decision log matters most exactly then
-        self._jlock = threading.Lock()
+        self._jlock = locks.Lock("placement.PlacementController._jlock")
         self._prev: dict[int, tuple[float, dict]] = {}  # g -> (t, cum loads)
         self._rates: dict[int, dict[str, TabletRate]] = {}
         self._streak = 0                    # consecutive over-threshold polls
@@ -504,6 +504,7 @@ class PlacementController:
                     self.metrics.counter(
                         "dgraph_placement_errors_total").inc()
 
+        # dgraph: allow(ctxvar-copy) detached controller bg loop
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="dgt-placement")
         self._thread.start()

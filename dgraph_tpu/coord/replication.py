@@ -24,11 +24,13 @@ already the wire format a gRPC/DCN transport would carry.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import struct
 import threading
 
 from dgraph_tpu.api.server import Node
+from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.query import dql
 from dgraph_tpu.query.engine import Executor
 from dgraph_tpu.storage.csr_build import build_snapshot
@@ -264,8 +266,12 @@ class ReplicaGroup:
                     errs.append(e)
                 finally:
                     done.set()
-            threading.Thread(target=from_leader, daemon=True).start()
-            done.wait(hedge_after)
+            # copy context so the leader read carries the caller's
+            # deadline/trace/cost contextvars across the thread seam
+            ctx = contextvars.copy_context()
+            threading.Thread(target=ctx.run, args=(from_leader,),
+                             daemon=True).start()
+            done.wait(dl.clamp(hedge_after))
             if result:
                 return result[0]
         self.hedged_reads += 1
@@ -276,8 +282,10 @@ class ReplicaGroup:
         if not leader_asked:
             # dead leader AND no follower reader: nothing will ever answer
             raise NoQuorum("no live member can serve reads")
-        # no follower reader available: block on the leader after all
-        done.wait()
+        # no follower reader available: block on the leader after all —
+        # clamped to the caller's budget (typed, never a hang)
+        if not done.wait(dl.clamp(None)):
+            dl.check("quorum read: leader reply")
         if result:
             return result[0]
         raise errs[0] if errs else NoQuorum("no live member can serve reads")

@@ -24,6 +24,7 @@ from ..storage.csr_build import GraphSnapshot
 from ..storage.postings import Op
 from ..utils import deadline as dl
 from ..utils.deadline import DeadlineExceeded
+from ..utils.errors import Unavailable
 from ..utils.retry import CommitAmbiguous, RetryPolicy, transport_errors
 from ..utils.schema import SchemaState, parse_schema
 from .remote import NetworkDispatcher, RemoteWorker
@@ -176,7 +177,7 @@ class ClusterClient:
         try:
             return self.replicas[g].leader_worker()
         except RuntimeError:
-            raise RuntimeError(f"group {g} has no live leader")
+            raise Unavailable(f"group {g} has no live leader")
 
     # -- schema --------------------------------------------------------------
 
@@ -190,6 +191,8 @@ class ClusterClient:
         for g in self.groups:
             try:
                 text = self.leader_of(g).schema()
+            # dgraph: allow(except-seam) schema merge is best-effort per
+            # group; an unreachable group contributes nothing
             except Exception:
                 continue
             for e in parse_schema(text):
@@ -276,6 +279,8 @@ class ClusterClient:
             self._decide_all(start_ts, 0, keys_by_group)
             try:
                 self.zero.abort(start_ts)
+            # dgraph: allow(except-seam) best-effort abort on the unwind
+            # path; the raise below carries the real failure
             except Exception:
                 pass
             raise

@@ -31,6 +31,7 @@ from ..utils import deadline as dl
 from ..utils import faults
 from ..utils.ballot import tally as _tally
 from ..utils.retry import CircuitBreaker
+from ..utils.errors import FailedPrecondition, Unavailable
 from ..query.task import TaskQuery, TaskResult, process_task
 from ..storage.csr_build import STRUCTURAL_RECORDS
 from ..storage.store import _key_bytes, decode_record
@@ -629,6 +630,10 @@ class WorkerService:
                 # O(lag): deque iteration from the right end
                 records = list(_it.islice(reversed(self._buffer),
                                           lag))[::-1]
+            # dgraph: allow(ctxvar-copy) quorum append fan-out is
+            # deliberately detached: a ship must run to completion even
+            # if the triggering request's budget lapses mid-flight —
+            # aborting half an ack round would corrupt quorum accounting
             futs = [self._pool.submit(self._ship_to_peer, i, peers[i],
                                       records) for i in due]
             acks, stale = 1, None
@@ -670,6 +675,7 @@ class WorkerService:
                     self._syncing = True
                     import threading as _t
 
+                    # dgraph: allow(ctxvar-copy) detached catch-up sync
                     _t.Thread(target=self._state_sync,
                               args=(msg.leader_addr,),
                               daemon=True).start()
@@ -772,6 +778,8 @@ class WorkerService:
         for p in list(self.peers):
             try:
                 p.heartbeat(self.term, self.advertise_addr, members)
+            # dgraph: allow(except-seam) heartbeat fan-out: dead peers
+            # are the expected case; liveness is judged by the receiver
             except Exception:
                 pass
 
@@ -797,6 +805,8 @@ class WorkerService:
                         if r.term > self.term:
                             self._set_term(int(r.term))
                     return
+            # dgraph: allow(except-seam) vote fan-out: unreachable
+            # voters are abstentions; the tally decides
             except Exception:
                 pass
             finally:
@@ -915,8 +925,10 @@ class WorkerService:
                     self._assembler = SnapshotAssembler(
                         self.store, metrics=self.metrics)
                 self._last_seq = int(resp.session_seq)
+        # dgraph: allow(except-seam) next gap retries the state sync;
+        # the follower keeps serving its last applied state meanwhile
         except Exception:
-            pass                       # next gap retries the sync
+            pass
         finally:
             self._syncing = False
 
@@ -1225,7 +1237,7 @@ def serve_worker(store, addr: str = "localhost:0",
     server.add_generic_rpc_handlers((svc.handler(),))
     port = server.add_insecure_port(addr)
     if port == 0:
-        raise RuntimeError(f"could not bind worker listener on {addr}")
+        raise Unavailable(f"could not bind worker listener on {addr}")
     host = advertise_host or addr.rsplit(":", 1)[0] or "localhost"
     if host in ("0.0.0.0", "[::]", ""):
         import socket
@@ -1497,6 +1509,7 @@ class HedgedReplicas:
         self._thread = None
         if len(addrs) > 1:
             self._poll_once()    # routing is correct from the first read
+            # dgraph: allow(ctxvar-copy) detached health-echo bg loop
             self._thread = threading.Thread(target=self._echo_loop,
                                             daemon=True)
             self._thread.start()
@@ -1570,7 +1583,7 @@ class HedgedReplicas:
             self._poll_once()
         if self._leader_confirmed:
             return self.workers[self._leader_idx]
-        raise RuntimeError("group has no live leader")
+        raise Unavailable("group has no live leader")
 
     # -- routing -------------------------------------------------------------
 
@@ -1830,7 +1843,7 @@ class NetworkDispatcher:
         if rw is None:
             # a silent local fallback would answer with empty results for
             # data that exists — surface the unreachable group instead
-            raise RuntimeError(
+            raise Unavailable(
                 f"no connection to group {group} serving {attr!r}")
         return rw.process_task(q, read_ts, min_applied=floor)
 
@@ -1863,7 +1876,7 @@ class NetworkDispatcher:
             return None              # local/unknown: caller sorts locally
         rw = self.remotes.get(group)
         if rw is None:
-            raise RuntimeError(f"no connection to group {group} for sort")
+            raise Unavailable(f"no connection to group {group} for sort")
         return rw.sort(attr, uids, desc, lang, read_ts, need)
 
     def schema_over_network(self, preds=()):
@@ -1873,6 +1886,8 @@ class NetworkDispatcher:
         for g, rw in sorted(self.remotes.items()):
             try:
                 t = rw.schema(preds)
+            # dgraph: allow(except-seam) schema merge is best-effort per
+            # group; an unreachable group contributes nothing
             except Exception:
                 continue
             if t:
@@ -1896,7 +1911,7 @@ class NetworkDispatcher:
         for e in edges:
             if self.zero.writes_blocked(e.attr) or (
                     e.attr == "*" and self.zero.moving_tablets()):
-                raise RuntimeError(
+                raise FailedPrecondition(
                     f"predicate {e.attr!r} is moving; retry")
         by_group = mut.split_edges_by_group(
             edges, self.zero.n_groups, self.zero.should_serve)
@@ -1911,7 +1926,7 @@ class NetworkDispatcher:
                 else:
                     rw = self.remotes.get(g)
                     if rw is None:
-                        raise RuntimeError(f"no connection to group {g}")
+                        raise Unavailable(f"no connection to group {g}")
                     resp = rw.mutate(start_ts, ge)
                     touched = list(resp.keys)
                     conflict = list(resp.conflict_keys)
@@ -1925,6 +1940,8 @@ class NetworkDispatcher:
             try:
                 self.decide_over_network(start_ts, 0, keys_by_group,
                                          local_store)
+            # dgraph: allow(except-seam) best-effort abort fan-out on
+            # the unwind path; the raise below carries the real failure
             except Exception:
                 pass
             raise

@@ -25,6 +25,9 @@ import threading
 from collections import deque
 from typing import Callable, Iterable, TypeVar
 
+from ..utils import deadline as dl
+from ..utils.deadline import DeadlineExceeded
+
 T = TypeVar("T")
 
 
@@ -65,7 +68,25 @@ class Scheduler:
                     and min(self._excl, default=ticket + 1) > ticket
 
             while not runnable():
-                self._cv.wait()
+                # clamped to the caller's deadline (lifeline contract):
+                # a budgeted mutation stuck behind held conflict keys
+                # fails typed instead of hanging past its budget — and
+                # gives its ticket back so later tasks never wait on a
+                # ghost head-of-queue
+                if not self._cv.wait(dl.clamp(None)):
+                    self._outstanding.discard(ticket)
+                    if exclusive:
+                        self._excl.discard(ticket)
+                    else:
+                        for k in keyset:
+                            q = self._queues[k]
+                            q.remove(ticket)
+                            if not q:
+                                del self._queues[k]
+                    self._cv.notify_all()
+                    raise DeadlineExceeded(
+                        "mutation scheduler: budget exhausted before "
+                        "conflict keys freed")
             self.started += 1
             self._running += 1
             self.max_concurrent = max(self.max_concurrent, self._running)

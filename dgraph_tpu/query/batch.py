@@ -52,6 +52,7 @@ import numpy as np
 
 from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.utils import deadline as dl
+from dgraph_tpu.utils import locks
 
 
 def kernel_klass(q) -> str:
@@ -249,7 +250,7 @@ class DeviceBatcher:
         # concurrency-1 traffic pays ZERO added latency. Tests disable it
         # to force deterministic full batches.
         self.idle_fire = idle_fire
-        self._lock = threading.Lock()
+        self._lock = locks.Lock("batch.DeviceBatcher._lock")
         self._open: dict[tuple, _Batch] = {}
         self._own_inflight = 0
         m = self.metrics
@@ -371,6 +372,9 @@ class DeviceBatcher:
                     not (self.idle_fire and not self._busy()):
                 self._window_waits.inc()
                 t0 = time.perf_counter()
+                # dgraph: allow(deadline-wait) leader window wait is
+                # bounded by the ~2ms collection window constant; tight
+                # budgets bypassed the window entirely upstream
                 b.full.wait(self.window_s)
                 # continuous collection: while the device is busy (a step
                 # running or queued at the gate) the window is free — the
@@ -384,6 +388,8 @@ class DeviceBatcher:
                     if self.gate is not None else 0.0)
                 while (not b.full.is_set()) and self._busy() and \
                         time.perf_counter() - t0 < cap:
+                    # dgraph: allow(deadline-wait) bounded by `cap` (one
+                    # window + one expected step) in the loop condition
                     b.full.wait(self.window_s)
         finally:
             with self._lock:

@@ -45,7 +45,7 @@ import numpy as np
 from dgraph_tpu.obs import costs, otrace
 from dgraph_tpu.query.task import TaskQuery, TaskResult
 from dgraph_tpu.utils import deadline as dl
-from dgraph_tpu.utils import faults
+from dgraph_tpu.utils import faults, locks
 from dgraph_tpu.utils.deadline import DeadlineExceeded, ResourceExhausted
 
 # ---------------------------------------------------------------------------
@@ -324,7 +324,13 @@ class TaskResultCache(_ByteLRU):
             self._coalesced.inc()
             otrace.event("task_cache", outcome="coalesced")
             costs.note("task_cache_coalesced")
-            fl.event.wait()
+            # clamped to the follower's own budget: a budgeted request
+            # must never hang behind a wedged flight leader (the leader
+            # still publishes for any unbudgeted waiters)
+            if not fl.event.wait(dl.clamp(None)):
+                dl.check("task singleflight follower")
+                raise DeadlineExceeded(
+                    "task singleflight follower timed out")
             if fl.error is not None:
                 raise fl.error
             if fl.result is not None:
@@ -383,7 +389,8 @@ class DispatchGate:
         self._inflight = self.metrics.counter("dgraph_dispatch_inflight")
         self._waits = self.metrics.counter("dgraph_dispatch_waits_total")
         self._shed = self.metrics.counter("dgraph_shed_total")
-        self._wlock = threading.Lock()     # guards the _waiting count
+        self._wlock = locks.Lock(
+            "qcache.DispatchGate._wlock")  # guards the _waiting count
         self._waiting = 0                  # queued acquirers
         self._step_ewma = 0.0              # expected device-step seconds
         # per-kernel-class EWMAs (ISSUE 9): one global estimate spans ~1ms

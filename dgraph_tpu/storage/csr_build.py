@@ -684,6 +684,9 @@ def _fold_attrs(store: Store, attrs: list[str], read_ts: int,
             with sem:
                 return build_pred(store, a, read_ts, own_start_ts)
 
+        # dgraph: allow(ctxvar-copy) folds build SHARED snapshot state
+        # cached across requests — they must not inherit any one
+        # request's deadline/trace context
         futs = [pool.submit(run, a) for a in attrs]
         return [f.result() for f in futs]
     return [build_pred(store, a, read_ts, own_start_ts) for a in attrs]

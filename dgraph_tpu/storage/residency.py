@@ -64,7 +64,7 @@ import time
 import weakref
 
 from dgraph_tpu.obs import otrace
-from dgraph_tpu.utils import faults
+from dgraph_tpu.utils import faults, locks
 
 TIER_HBM = "hbm"
 TIER_WARM = "warm"
@@ -155,8 +155,13 @@ class ResidencyManager:
         # threads racing the same tablet's first device access must
         # produce ONE buffer set, but a prefetch of tablet A must not
         # block a foreground query's first access to tablet B
-        self._upload_locks = tuple(threading.RLock() for _ in range(16))
-        self._lock = threading.RLock()
+        # ONE lockdep class for the whole stripe family: stripe choice is
+        # hash-derived (id % 16), so any nesting of two stripes is a
+        # latent ABBA — the shared name makes lockdep's
+        # same-class-nesting check catch it from a single observation
+        self._upload_locks = tuple(
+            locks.RLock("residency.upload") for _ in range(16))
+        self._lock = locks.RLock("residency.ResidencyManager._lock")
         self._entries: dict[int, _Entry] = {}
         # attr -> resident entry keys: touch() runs per TASK and must not
         # scan every resident buffer group on the node
@@ -469,6 +474,10 @@ class ResidencyManager:
             if sync:
                 self._prefetch_one(owner)
             else:
+                # dgraph: allow(ctxvar-copy) prefetch outlives the
+                # admitting request by design (the uploaded buffers are
+                # shared) — inheriting its deadline would cancel uploads
+                # the NEXT query needs
                 self._prefetch_pool().submit(self._prefetch_one, owner)
         return len(todo)
 

@@ -362,7 +362,9 @@ class Store:
         self.by_pred: dict[tuple[int, str], set[bytes]] = {}
         self.schema = SchemaState()
         self.dirty: set[bytes] = set()
-        self._lock = SafeLock()   # lock-discipline asserts: utils/sync.py
+        # lock-discipline asserts (utils/sync.py) + lockdep class name
+        # (utils/locks.py) for runtime order verification in chaos runs
+        self._lock = SafeLock("store.Store._lock")
         self._wal: io.BufferedWriter | None = None
         self.max_seen_commit_ts = 0
         # attr -> highest commit_ts of any commit touching it: the dirty

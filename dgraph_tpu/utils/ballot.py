@@ -51,6 +51,7 @@ class BallotLoop:
 
     def start(self) -> None:
         if self._thread is None:
+            # dgraph: allow(ctxvar-copy) detached ballot tick bg loop
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
 

@@ -54,6 +54,7 @@ POINTS = (
     "disk.wal_write",       # store WAL append/commit records
     "disk.spill",           # out-of-core ingest spill-run writes
     "device.dispatch",      # device-dispatch gate critical section
+    "device.step",          # inside a held gate slot: slow device program
     # placement subsystem (coord/placement.py)
     "zero.rebalance_decide",  # controller tick, before acting on a pick
     "move.chunk_ship",      # per-chunk in the tablet move/replica stream

@@ -16,13 +16,17 @@ from __future__ import annotations
 
 import threading
 
+from . import locks
+
 
 class SafeLock:
     """RLock that can assert 'the current thread holds me'
-    (x/lock.go SafeMutex.AssertLock analog)."""
+    (x/lock.go SafeMutex.AssertLock analog). `name` is the lockdep
+    class (utils/locks.py): armed runs record this lock's orderings in
+    the global order graph; disarmed it is a raw threading.RLock."""
 
-    def __init__(self) -> None:
-        self._lock = threading.RLock()
+    def __init__(self, name: str = "sync.SafeLock") -> None:
+        self._lock = locks.RLock(name)
         self._owner: int | None = None
         self._depth = 0
 

@@ -33,6 +33,11 @@ assert len(jax.devices()) >= 8, (
     f"{jax.devices()} — check XLA_FLAGS/JAX_PLATFORMS handling in conftest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,12 @@
+"""Known-bad fixture: ctxvar-copy must flag both thread seams."""
+
+import threading
+
+
+def work():
+    pass
+
+
+def kick(pool):
+    pool.submit(work)                       # context lost across the pool
+    threading.Thread(target=work).start()   # and across the thread

@@ -1,0 +1,8 @@
+"""Known-bad fixture: fault-points (fired but never declared)."""
+
+from dgraph_tpu.utils import faults
+
+
+def ship(chunk):
+    faults.fire("bogus.chunk_ship")
+    return chunk

@@ -1,0 +1,32 @@
+"""Known-bad fixture: jax-purity — trace-time impurity inside device
+functions AND a donated buffer read after the donating call."""
+
+import random
+import time
+
+import jax
+from jax import lax
+
+
+@jax.jit
+def step(x):
+    return x * time.time()              # frozen at trace time
+
+
+def run(frontier):
+    def body(i, f):
+        return f + random.random()      # one sample for every step
+
+    return lax.fori_loop(0, 4, body, frontier)
+
+
+def _expand(f, adj):
+    return adj @ f
+
+
+_prog = jax.jit(_expand, donate_argnums=(0,))
+
+
+def caller(frontier, adj):
+    out = _prog(frontier, adj)
+    return out, frontier.sum()          # read after donation

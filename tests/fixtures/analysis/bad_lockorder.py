@@ -1,0 +1,14 @@
+"""Known-bad fixture: lock-order (static A->B in one method, B->A in
+another — a deadlock schedule)."""
+
+
+class Store:
+    def commit(self):
+        with self._txn_lock:
+            with self._wal_lock:
+                return 1
+
+    def replay(self):
+        with self._wal_lock:
+            with self._txn_lock:
+                return 2

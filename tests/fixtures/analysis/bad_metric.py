@@ -1,0 +1,10 @@
+"""Known-bad fixture: metric-registration must flag both sites."""
+
+
+class Thing:
+    def __init__(self, metrics):
+        # not in utils/metrics.Registry.__init__
+        self.c = metrics.counter("dgraph_bogus_surprise_total")
+        kind = "nope"
+        # f-string placeholder missing from METRIC_PLACEHOLDERS
+        self.h = metrics.histogram(f"dgraph_{kind}_latency_s")

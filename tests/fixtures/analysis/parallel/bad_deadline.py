@@ -1,0 +1,12 @@
+"""Known-bad fixture for the blocking-wait rule (path contains
+/parallel/ so the scoped rule applies). Four naked blocking waits."""
+
+import time
+
+
+class Server:
+    def serve(self, req):
+        time.sleep(0.2)                 # naked sleep on a request path
+        self.cv.wait()                  # unbounded condition wait
+        self.lk.acquire()               # blocking acquire, unclamped
+        return self.queue.get()         # unbounded queue get

@@ -1,0 +1,8 @@
+"""Known-bad fixture: except-seam (silent swallow at a wire seam)."""
+
+
+def send(peer, msg):
+    try:
+        peer.send(msg)
+    except Exception:
+        pass

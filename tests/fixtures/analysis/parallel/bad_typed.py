@@ -1,0 +1,7 @@
+"""Known-bad fixture: rpc-error-taxonomy (untyped raise at a seam)."""
+
+
+def route(groups, g):
+    if g not in groups:
+        raise RuntimeError(f"no connection to group {g}")
+    return groups[g]

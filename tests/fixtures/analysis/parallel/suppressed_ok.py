@@ -1,0 +1,29 @@
+"""Suppression fixture: every violation here carries an allow() and the
+analyzer must report NOTHING for this file."""
+
+import threading
+import time
+
+
+def work():
+    pass
+
+
+def kick(pool):
+    # dgraph: allow(ctxvar-copy) detached fixture loop
+    pool.submit(work)
+    t = threading.Thread(target=work)   # dgraph: allow(ctxvar-copy) same
+    t.start()
+
+
+def serve(req):
+    # dgraph: allow(deadline-wait) fixture: bounded by the test harness
+    # watchdog, demonstrating multi-line rationale comments
+    time.sleep(0.01)
+
+
+def send(peer, msg):
+    try:
+        peer.send(msg)
+    except Exception:  # dgraph: allow(except-seam) fixture best-effort
+        pass

@@ -1,0 +1,185 @@
+"""dgraph-analyze static-analysis suite (ISSUE 14).
+
+Covers: every checker catches its checked-in known-bad fixture, the
+suppression syntax silences annotated violations, the whole package
+comes up CLEAN (the tier-1 gate that keeps the invariants machine-
+checked as the tree grows), and the CLI contract (--rule, --format=json,
+exit codes, the <10s budget).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from dgraph_tpu.analysis import RULES, analyze_paths
+from dgraph_tpu.analysis.checkers import (collect_metric_names,
+                                          registered_metric_names)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+PKG = Path(__file__).parent.parent / "dgraph_tpu"
+
+
+def _findings(rule: str):
+    return [f for f in analyze_paths([FIXTURES], [rule]) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# each checker catches its known-bad fixture
+# ---------------------------------------------------------------------------
+
+def test_metric_registration_fixture():
+    fs = _findings("metric-registration")
+    assert any(f.path == "bad_metric.py" and
+               "dgraph_bogus_surprise_total" in f.message for f in fs)
+    # unknown f-string placeholder is its own finding (the audit must
+    # stay mechanical, not silently skip what it cannot expand)
+    assert any("placeholder" in f.message for f in fs)
+
+
+def test_ctxvar_fixture():
+    fs = _findings("ctxvar-copy")
+    assert {f.line for f in fs if f.path == "bad_ctxvar.py"} == {11, 12}
+
+
+def test_deadline_wait_fixture():
+    fs = [f for f in _findings("deadline-wait")
+          if f.path == "parallel/bad_deadline.py"]
+    # sleep, cv.wait, lock.acquire, queue.get
+    assert len(fs) == 4, fs
+
+
+def test_except_seam_fixture():
+    fs = _findings("except-seam")
+    assert [f.path for f in fs] == ["parallel/bad_except.py"]
+
+
+def test_typed_error_fixture():
+    fs = _findings("rpc-error-taxonomy")
+    assert [f.path for f in fs] == ["parallel/bad_typed.py"]
+
+
+def test_jax_purity_fixture():
+    fs = [f for f in _findings("jax-purity") if f.path == "bad_jax.py"]
+    msgs = "\n".join(f.message for f in fs)
+    assert "time.time" in msgs          # jit-decorated body
+    assert "random.random" in msgs      # fori_loop body fn
+    assert "donated" in msgs            # read-after-donation
+
+
+def test_fault_points_fixture():
+    fs = _findings("fault-points")
+    assert any("bogus.chunk_ship" in f.message for f in fs)
+
+
+def test_lock_order_fixture():
+    fs = _findings("lock-order")
+    assert any(f.path == "bad_lockorder.py" and "cycle" in f.message
+               for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# suppression + scoping semantics
+# ---------------------------------------------------------------------------
+
+def test_suppressions_silence_annotated_violations():
+    for f in analyze_paths([FIXTURES]):
+        assert f.path != "parallel/suppressed_ok.py", f
+
+
+def test_single_file_run_keeps_scope_segments():
+    # `python -m dgraph_tpu.analysis path/to/seam_file.py` roots at the
+    # file's parent; scoping must still see the absolute path's segments
+    # or the run reports a vacuous clean for exactly the rules that apply
+    fs = analyze_paths([FIXTURES / "parallel" / "bad_typed.py"],
+                       ["rpc-error-taxonomy"])
+    assert len(fs) == 1, fs
+
+
+def test_scoped_rules_ignore_out_of_scope_files(tmp_path):
+    # the same naked sleep OUTSIDE query/parallel/api/coord is not a
+    # deadline-wait finding (background tooling, loaders, benches)
+    (tmp_path / "tool.py").write_text(
+        "import time\n\ndef run():\n    time.sleep(1.0)\n")
+    assert analyze_paths([tmp_path], ["deadline-wait"]) == []
+
+
+def test_unknown_rule_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        analyze_paths([FIXTURES], ["no-such-rule"])
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the package itself is clean, fast
+# ---------------------------------------------------------------------------
+
+def test_package_is_clean_and_fast():
+    t0 = time.perf_counter()
+    findings = analyze_paths([PKG])
+    dt = time.perf_counter() - t0
+    assert findings == [], "analyzer findings in dgraph_tpu/:\n" + \
+        "\n".join(f.format() for f in findings)
+    assert dt < 10.0, f"analyzer took {dt:.1f}s over the package"
+
+
+def test_rule_registry_shape():
+    # the ~8 checkers the issue names, by stable rule id
+    assert set(RULES) == {
+        "metric-registration", "ctxvar-copy", "deadline-wait",
+        "except-seam", "rpc-error-taxonomy", "jax-purity",
+        "fault-points", "lock-order"}
+    for name, cls in RULES.items():
+        assert cls().doc, name
+
+
+# ---------------------------------------------------------------------------
+# shared metric collector (one implementation, two consumers)
+# ---------------------------------------------------------------------------
+
+def test_metric_collector_sees_the_tree():
+    names = collect_metric_names(PKG)
+    assert len(names) > 80, names
+    assert "dgraph_task_cache_hits_total" in names    # {prefix} expansion
+    assert "dgraph_http_query_latency_s" in names     # {ep} expansion
+    reg = registered_metric_names()
+    assert names <= reg, sorted(names - reg)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=120,
+        cwd=Path(__file__).parent.parent)
+
+
+def test_cli_findings_exit_nonzero_and_json():
+    p = _cli(str(FIXTURES), "--format=json")
+    assert p.returncode == 1, p.stderr
+    out = json.loads(p.stdout)
+    assert out["findings"], out
+    rules = {f["rule"] for f in out["findings"]}
+    assert "lock-order" in rules and "metric-registration" in rules
+
+
+def test_cli_rule_filter_and_clean_exit():
+    p = _cli(str(FIXTURES / "bad_ctxvar.py"), "--rule", "except-seam")
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    p = _cli(str(FIXTURES), "--rule", "bogus")
+    assert p.returncode == 2
+    p = _cli("--list-rules")
+    assert p.returncode == 0 and "deadline-wait" in p.stdout
+
+
+@pytest.mark.slow
+def test_cli_package_clean():
+    p = _cli("dgraph_tpu")
+    assert p.returncode == 0, p.stdout

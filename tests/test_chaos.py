@@ -61,6 +61,24 @@ def _clean_faults():
     faults.GLOBAL.clear()
 
 
+@pytest.fixture(autouse=True)
+def _lockdep_armed():
+    """The whole chaos suite runs with lockdep ARMED (ISSUE 14): every
+    lock the cluster constructs is instrumented, and any order-inversion
+    cycle observed during the schedules raises at the acquisition that
+    closed it — plus a belt-and-braces teardown assert that the run
+    recorded zero violations."""
+    from dgraph_tpu.utils import locks
+
+    locks.reset()
+    locks.arm(raise_on_cycle=True)
+    yield
+    vs = locks.violations()
+    locks.disarm()
+    locks.reset()
+    assert vs == [], f"lock-order violations under chaos: {vs}"
+
+
 @pytest.fixture
 def cluster():
     """2 worker groups + zero over real loopback gRPC; name/age on group

@@ -6,7 +6,6 @@ with EWMA regression baselines."""
 
 import json
 import random
-import re
 import threading
 import time
 import urllib.error
@@ -285,41 +284,17 @@ def test_meter_counts_overflow_drops():
 # satellite: mechanical pre-registration audit
 # ---------------------------------------------------------------------------
 
-# f-string placeholders used at metric call sites, expanded mechanically;
-# a NEW placeholder must be added here or the audit fails (that is the
-# point: the invariant stays mechanical, not hand-maintained)
-_PLACEHOLDERS = {
-    "prefix": ("task", "result"),
-    "ep": ("query", "mutate", "commit", "abort", "alter"),
-}
-
-_CALL_RE = re.compile(
-    r"""(?:counter|histogram|keyed)\(\s*f?["'](dgraph_[a-zA-Z0-9_{}]+)["']""")
-
-
-def _expand(name: str) -> list[str]:
-    m = re.search(r"\{(\w+)\}", name)
-    if m is None:
-        return [name]
-    key = m.group(1)
-    assert key in _PLACEHOLDERS, \
-        f"unknown metric-name placeholder {{{key}}} in {name!r}: add its " \
-        f"expansion to _PLACEHOLDERS so the audit stays mechanical"
-    out = []
-    for v in _PLACEHOLDERS[key]:
-        out.extend(_expand(name.replace("{%s}" % key, v)))
-    return out
-
-
 def test_every_incremented_metric_is_preregistered():
-    """Walk the source for every dgraph_* name passed to a metric
-    constructor and assert each appears on a FRESH node's /metrics at
-    value 0 — PRs 5-12 hand-maintained this; now it is mechanical."""
+    """Every dgraph_* name constructed anywhere must appear on a FRESH
+    node's /metrics at value 0. The source walk is the static analyzer's
+    metric-registration collector (dgraph_tpu/analysis, ISSUE 14 — one
+    implementation, two consumers: this runtime audit and the
+    `python -m dgraph_tpu.analysis` tier-1 gate); f-string placeholders
+    expand via analysis.checkers.METRIC_PLACEHOLDERS."""
+    from dgraph_tpu.analysis.checkers import collect_metric_names
+
     pkg = Path(costs.__file__).resolve().parent.parent
-    names: set[str] = set()
-    for py in pkg.rglob("*.py"):
-        for m in _CALL_RE.finditer(py.read_text()):
-            names.update(_expand(m.group(1)))
+    names = collect_metric_names(pkg)
     assert len(names) > 80, f"audit scan looks broken: {len(names)} names"
     n = Node()
     try:
