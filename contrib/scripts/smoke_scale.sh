@@ -1,0 +1,133 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 floor + the ISSUE-15 scale-regime cold path.
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1
+# to skip when the full suite already ran in an earlier CI stage).
+# Step 2 runs a small-SF LDBC battery end to end:
+#   * ldbc_gen (deterministic synthetic CSV dump) -> convert --ldbc ->
+#     bulk load,
+#   * result-set EQUALITY gates: interactive short reads + the 3-hop
+#     friends-of-friends complex read byte-identical between a lazy-fold
+#     node and an eager (--no_lazy_folds) node,
+#   * the lazy cold-open assert, TIMING-INDEPENDENT: after the first
+#     short read, the lazy node has folded only the read set — the big
+#     knows/content tablets are still pending fold-thunks — while
+#     results match eager exactly,
+#   * fold observability: /debug/metrics "folds" section + the
+#     dgraph_fold_* series parse on /metrics.
+# Step 3 runs the full bench.py ldbc battery (subprocess, 8-virtual-
+# device mesh) at a reduced SF and asserts every gate incl. the >= 3x
+# cold-open ratio and host/gRPC/mesh/tiered UID-set equality. Set
+# SMOKE_SKIP_BENCH=1 to keep CI fast when LDBC_r15.json came from a
+# previous stage. Runs entirely on the XLA host platform — no TPU.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-700}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== small-SF generate -> bulk -> battery + lazy cold-open (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import tempfile
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.api.http import _serving_metrics
+from dgraph_tpu.loader.bulk import bulk_load
+from dgraph_tpu.loader.convert import convert_ldbc
+from dgraph_tpu.models.ldbc import generate_ldbc
+from dgraph_tpu.obs import prom
+
+tmp = tempfile.mkdtemp(prefix="dgt-scale-smoke-")
+gen = generate_ldbc(os.path.join(tmp, "csv"), sf=0.01)
+conv = convert_ldbc(os.path.join(tmp, "csv"),
+                    os.path.join(tmp, "snb.rdf.gz"))
+with open(os.path.join(tmp, "snb.rdf.gz.schema")) as f:
+    schema = f.read()
+# workers=1: this runs as a `python -` heredoc, where the spawn context
+# cannot re-import __main__ (its "file" is stdin) — parse workers would
+# die at startup. The graph is tiny; in-process parse is instant.
+bulk_load(os.path.join(tmp, "snb.rdf.gz"), schema, os.path.join(tmp, "out"),
+          workers=1)
+print(f"generated sf=0.01: {gen.persons} persons, {gen.knows} knows, "
+      f"{gen.comments} comments, {conv.triples} triples")
+
+pid = 933
+short = ('{ q(func: eq(person.id, %d)) '
+         '{ person.id firstName lastName knows { person.id } } }' % pid)
+fof = ('{ q(func: eq(person.id, %d)) '
+       '{ knows { knows { knows { uid } } } } }' % pid)
+
+lazy = Node(dirpath=os.path.join(tmp, "out"))
+eager = Node(dirpath=os.path.join(tmp, "out"), lazy_folds=False)
+
+# lazy cold-open assert (timing-independent): the first short read folds
+# only its read set — the content/comment tablets stay pending
+out_l, _ = lazy.query(short)
+pend = lazy.metrics.counter("dgraph_fold_pending_tablets").value
+folds = sum(lazy.metrics.counter(f"dgraph_fold_{t}_total").value
+            for t in ("lazy", "eager", "prefetch", "inline"))
+n_preds = len(lazy.store.predicates())
+print(f"after first read: folded={folds} pending={pend} preds={n_preds}")
+assert pend > 0, "lazy cold open folded the whole world"
+assert folds < n_preds, (folds, n_preds)
+
+out_e, _ = eager.query(short)
+assert json.dumps(out_l, sort_keys=True) == json.dumps(out_e, sort_keys=True)
+fl, _ = lazy.query(fof)
+fe, _ = eager.query(fof)
+assert json.dumps(fl, sort_keys=True) == json.dumps(fe, sort_keys=True)
+print("short + 3-hop FoF byte-identical lazy vs eager")
+
+d = _serving_metrics(lazy)["folds"]
+assert d["lazy_enabled"] and d["pending_tablets"] >= 0
+text = prom.render(lazy.metrics)
+prom.parse(text)
+for name in ("dgraph_fold_lazy_total", "dgraph_fold_ms",
+             "dgraph_cold_open_ms", "dgraph_first_query_ms"):
+    assert name in text, name
+print("folds debug section + /metrics series OK")
+lazy.close()
+eager.close()
+PY
+
+if [ "${SMOKE_SKIP_BENCH:-0}" != "1" ]; then
+  echo "== bench.py ldbc battery (reduced SF, 8-virtual-device mesh) =="
+  DGT_LDBC_SF="${DGT_LDBC_SF:-0.05}" JAX_PLATFORMS=cpu python - <<'PY'
+import json
+
+from bench import bench_ldbc
+
+out = bench_ldbc()
+print(json.dumps({k: out[k] for k in
+                  ("sf", "persons", "triples", "identical",
+                   "traversed_edges_per_sec", "warm_qps")}, indent=1))
+c = out["cold_open"]
+print(f"cold-open: lazy {c['lazy']['first_query_ms']}ms vs eager "
+      f"{c['eager']['first_query_ms']}ms = {c['ratio']}x")
+assert out["identical"], "cross-path result mismatch"
+assert c["identical"], "lazy vs eager result mismatch"
+assert c["gate_demand_driven"], "no pending tablets after first read"
+assert c["gate_3x"], f"cold-open ratio {c['ratio']} < 3x"
+assert out["warm_qps"]["gate"], f"warm QPS regressed: {out['warm_qps']}"
+assert out["ok"]
+print("ldbc battery gates OK -> LDBC_r15.json")
+PY
+fi
+
+echo "smoke_scale OK"

@@ -51,7 +51,8 @@ def cmd_serve(args) -> int:
                 device_budget_mb=args.device_budget_mb,
                 residency_pin=args.residency_pin,
                 cost_ledger=not args.no_cost_ledger,
-                cost_regression_factor=args.cost_regression_factor)
+                cost_regression_factor=args.cost_regression_factor,
+                lazy_folds=not args.no_lazy_folds)
     if args.faults or args.faults_seed is not None:
         from dgraph_tpu.utils import faults as faults_mod
 
@@ -186,7 +187,8 @@ def cmd_worker(args) -> int:
                                 batching=not args.no_batch,
                                 batch_window_ms=args.batch_window_ms,
                                 batch_max=args.batch_max,
-                                cost_ledger=not args.no_cost_ledger)
+                                cost_ledger=not args.no_cost_ledger,
+                                lazy_folds=not args.no_lazy_folds)
     if args.zero:
         import threading
 
@@ -342,6 +344,20 @@ def cmd_zero(args) -> int:
     return 0
 
 
+def cmd_ldbc_gen(args) -> int:
+    """Deterministic LDBC-SNB-shaped synthetic CSV dump (ISSUE 15):
+    `ldbc_gen --sf 1 --out dump/` then `convert --ldbc dump/` then
+    `bulk -f` is the scale battery's zero-dependency ingest path."""
+    from dgraph_tpu.models.ldbc import generate_ldbc
+
+    lg = log.get_logger("ldbc_gen")
+    st = generate_ldbc(args.out, sf=args.sf, seed=args.seed)
+    lg.info("ldbc_gen done", sf=st.sf, persons=st.persons, knows=st.knows,
+            posts=st.posts, comments=st.comments, edges=st.edges,
+            out=args.out)
+    return 0
+
+
 def cmd_convert(args) -> int:
     lg = log.get_logger("convert")
     if args.ldbc:
@@ -446,6 +462,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="disable the background overlay compaction loop")
     sp.add_argument("--fold_workers", type=int, default=0,
                     help="parallel tablet-fold threads (0 = auto)")
+    sp.add_argument("--no_lazy_folds", action="store_true",
+                    help="fold every tablet eagerly at snapshot assembly "
+                         "(the pre-ISSUE-15 cold path) instead of "
+                         "on-demand at first read")
     sp.add_argument("--no_planner", action="store_true",
                     help="disable the cost-based query planner "
                          "(restores parse-order execution)")
@@ -569,6 +589,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="disable per-RPC cost accounting + the cost "
                          "record shipped back in ServeTask trailing "
                          "metadata")
+    wp.add_argument("--no_lazy_folds", action="store_true",
+                    help="fold every tablet eagerly at snapshot assembly "
+                         "instead of on-demand at first read")
     wp.set_defaults(fn=cmd_worker)
 
     zp = sub.add_parser("zero", help="run the cluster coordinator process")
@@ -610,6 +633,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="this zero's position in --peers (0 bootstraps "
                          "as leader)")
     zp.set_defaults(fn=cmd_zero)
+
+    gp = sub.add_parser("ldbc_gen",
+                        help="deterministic LDBC-SNB-shaped synthetic "
+                             "CSV dump (feed to `convert --ldbc`)")
+    gp.add_argument("--sf", type=float, default=0.1,
+                    help="scale factor (persons ~ 10000*sf^0.85)")
+    gp.add_argument("--out", required=True, help="output CSV dump dir")
+    gp.add_argument("--seed", type=int, default=20260804,
+                    help="generator seed (same sf+seed => same bytes)")
+    gp.set_defaults(fn=cmd_ldbc_gen)
 
     cp = sub.add_parser("convert",
                         help="GeoJSON or LDBC-SNB CSV -> RDF (.rdf.gz)")

@@ -233,6 +233,22 @@ def _serving_metrics(node: Node) -> dict:
             "parallel_folds": c("dgraph_parallel_folds_total"),
             "fold_pool_width": c("dgraph_fold_pool_width"),
         },
+        # lazy on-demand snapshot folds (ISSUE 15): per-trigger fold
+        # counters (lazy = first read, prefetch = plan-driven, inline =
+        # overlay-forced compaction, eager = assembly/materialize-all),
+        # the fold wall-time distribution, currently-pending fold thunks,
+        # and the cold-open / first-query gauges the scale runbook reads
+        "folds": {
+            "lazy_enabled": node._assembler.lazy_folds,
+            "lazy": c("dgraph_fold_lazy_total"),
+            "eager": c("dgraph_fold_eager_total"),
+            "prefetch": c("dgraph_fold_prefetch_total"),
+            "inline": c("dgraph_fold_inline_total"),
+            "fold_ms": m.histogram("dgraph_fold_ms").snapshot(),
+            "pending_tablets": c("dgraph_fold_pending_tablets"),
+            "cold_open_ms": c("dgraph_cold_open_ms"),
+            "first_query_ms": c("dgraph_first_query_ms"),
+        },
         # cost-based planner tier: decision counters, plan-cache hit
         # rates, and the estimation-error histogram (|log2(actual/est)|
         # per executed planned step — 0 is a perfect estimate)
@@ -355,7 +371,7 @@ class _Handler(BaseHTTPRequestHandler):
     _DEBUG_INDEX = {
         "/debug/vars": "expvar-style dgraph_* counters/histograms",
         "/debug/requests": "sampled request breadcrumb traces (?n=32)",
-        "/debug/metrics": "serving-layer readout: caches, overlay, "
+        "/debug/metrics": "serving-layer readout: caches, overlay, folds, "
                           "planner, mesh, residency",
         "/debug/traces": "distributed span traces index (?n=32)",
         "/debug/traces/<trace_id>": "one trace as Chrome trace-event JSON "

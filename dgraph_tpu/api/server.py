@@ -103,7 +103,8 @@ class Node:
                  device_budget_mb: int = 0,
                  residency_pin: str = "",
                  cost_ledger: bool = True,
-                 cost_regression_factor: float = 4.0) -> None:
+                 cost_regression_factor: float = 4.0,
+                 lazy_folds: bool = True) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -195,7 +196,12 @@ class Node:
             overlay_enabled=overlay,
             overlay_max_keys=overlay_max_keys,
             overlay_max_age_s=overlay_max_age_s,
-            fold_workers=fold_workers)
+            fold_workers=fold_workers,
+            lazy_folds=lazy_folds)
+        # cold-open / first-query gauges (ISSUE 15): wall from node birth
+        # to the first completed query — the number lazy folds move
+        self._birth = time.perf_counter()
+        self._first_query_done = False
         # background rollup: overlays past the size/age threshold fold back
         # into fresh bases OFF the query path (posting-list rollups one
         # level up); started lazily on the first stamped overlay
@@ -515,7 +521,12 @@ class Node:
             if ctx is not None and ctx.preds:
                 base = self.snapshot(read_ts)
                 snap = GraphSnapshot(read_ts)
-                snap.preds = dict(base.preds)
+                # lazy base (ISSUE 15): share the pending fold-thunks —
+                # dict(base.preds) would drop them via the CPython dict
+                # fast path and untouched predicates would read as absent
+                copier = getattr(base.preds, "lazy_copy", None)
+                snap.preds = copier() if copier is not None \
+                    else dict(base.preds)
                 snap.metrics = getattr(base, "metrics", None)
                 if ctx.overlay is not None and ctx.overlay[0] == ctx.version:
                     snap.preds.update(ctx.overlay[1])
@@ -608,6 +619,24 @@ class Node:
                 read_ts, snap = self._read_view(start_ts)
             sp.set(read_ts=int(read_ts))
             tr.printf("snapshot at ts %d (%d preds)", read_ts, len(snap.preds))
+            pf_attrs = None
+            if not req.mutations:
+                # plan-driven FOLD prefetch (ISSUE 15): pending lazy folds
+                # of the plan's read set resolve on the shared fold pool
+                # BEFORE the result-token computation, so the cache-key
+                # walk below JOINS in-flight folds instead of folding
+                # serially. Issued only when something is actually pending
+                # — a warm result-cache hit must stay free of prefetch
+                # work (the upload leg runs after the cache miss, below)
+                pf_attrs = qcache.plan_attrs(req)
+                is_pending = getattr(snap.preds, "is_pending", None)
+                if pf_attrs and is_pending is not None:
+                    # ONLY the pending attrs: the early call must not run
+                    # the upload leg for folded tablets a cache hit never
+                    # needs (and the miss-path call below would re-submit)
+                    pend = [a for a in pf_attrs if is_pending(a)]
+                    if pend:
+                        self.residency.prefetch(pend, snap)
             # whole-query result tier: keyed on (plan key, per-predicate
             # token tuple of the plan's read set, edge budget). A commit to
             # predicate P rotates only P's PredData token, so replays that
@@ -666,14 +695,13 @@ class Node:
                         "filter_reorders": len(plan.and_order),
                         "sibling_reorders": len(plan.child_order),
                         "cutover_overrides": len(plan.cutover)})
-            if self.residency.enabled and not req.mutations:
-                # plan-driven prefetch (ISSUE 11): the plan's statically
-                # derivable predicate read set starts async warm->HBM
-                # uploads BEFORE dispatch, overlapping the transfer with
-                # the preceding host work / device step
-                pf_attrs = qcache.plan_attrs(req)
-                if pf_attrs:
-                    self.residency.prefetch(pf_attrs, snap)
+            if self.residency.enabled and pf_attrs:
+                # warm→HBM UPLOAD prefetch (ISSUE 11): after the result
+                # cache missed, start async uploads for the read set so
+                # the transfer overlaps the preceding host work / device
+                # step — exactly the pre-lazy call site, so cache hits
+                # never paid for it
+                self.residency.prefetch(pf_attrs, snap)
             out = Executor(snap, self.store.schema,
                            cache=self.task_cache, gate=self.dispatch_gate,
                            edge_limit=edge_limit, plan=plan,
@@ -707,6 +735,10 @@ class Node:
             m.histogram("dgraph_query_latency_s").observe(
                 time.perf_counter() - t0,
                 exemplar=sp.trace_id or None)
+            if not self._first_query_done and not err:
+                self._first_query_done = True
+                m.counter("dgraph_first_query_ms").set(
+                    (time.perf_counter() - self._birth) * 1e3)
             self._finish_cost(lg, sp)
             self.traces.finish(tr, error=err)
 

@@ -202,6 +202,14 @@ class Cluster:
                 self._assemblers = [SnapshotAssembler(s) for s in self.stores]
             per_group = [a.snapshot(read_ts) for a in self._assemblers]
             snap = GraphSnapshot(read_ts)
+            from dgraph_tpu.storage.csr_build import DelegateThunk, LazyPreds
+
+            # lazy federation (ISSUE 15): the per-group assemblers hand
+            # out fold-thunks — routing only needs tablet PRESENCE, so
+            # delegate per-attr reads to the owning group's map instead
+            # of folding every tablet at assembly time
+            lazy = LazyPreds()
+            snap.preds = lazy
             replicas = self.zero.replicas()
             for attr, g in sorted(self.zero.tablets().items()):
                 src_g = g
@@ -213,10 +221,17 @@ class Cluster:
                     cands = [g] + sorted(h for h in holders if h != g)
                     src_g = cands[self._rr % len(cands)]
                     self._rr += 1
-                pd = per_group[src_g].preds.get(attr)
-                if pd is not None:
-                    snap.preds[attr] = pd
-                    serving[attr] = src_g
+                src = per_group[src_g].preds
+                if attr not in src:
+                    continue
+                if getattr(src, "is_pending", lambda _a: False)(attr):
+                    lazy.register(attr, DelegateThunk(src, attr))
+                else:
+                    pd = src.get(attr)
+                    if pd is None:
+                        continue
+                    lazy[attr] = pd
+                serving[attr] = src_g
 
         def on_task(tq, res, dt):
             attr = tq.attr[1:] if tq.attr.startswith("~") else tq.attr

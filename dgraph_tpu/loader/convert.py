@@ -30,6 +30,8 @@ class LdbcStats:
     persons: int = 0
     knows: int = 0
     posts: int = 0
+    comments: int = 0
+    reply_of: int = 0
     triples: int = 0
 
 
@@ -90,6 +92,9 @@ _POST_COLS = {"content": ("content", None),
               "language": ("language", None),
               "creationDate": ("creationDate", None),
               "length": ("length", "xs:int")}
+_COMMENT_COLS = {"content": ("content", None),
+                 "creationDate": ("creationDate", None),
+                 "length": ("length", "xs:int")}
 
 
 def _ldbc_file(dirpath: str, stem: str) -> str | None:
@@ -174,14 +179,20 @@ imageFile: string .
 language: string .
 length: int .
 hasCreator: [uid] @reverse @count .
+comment.id: int @index(int) @upsert .
+replyOf: [uid] @reverse @count .
 """
 
 
 def convert_ldbc(dirpath: str, out_path: str) -> LdbcStats:
-    """Map an LDBC-SNB interactive CSV dump (persons/knows/posts subset)
-    to gzipped N-Quads for `bulk -f`. Also writes `<out>.schema` with the
-    matching schema text. Blank-node identity is `_:p<id>` / `_:post<id>`
-    so relation files join without an id map."""
+    """Map an LDBC-SNB interactive CSV dump (persons/knows/posts/comments
+    subset) to gzipped N-Quads for `bulk -f`. Also writes `<out>.schema`
+    with the matching schema text. Blank-node identity is `_:p<id>` /
+    `_:post<id>` / `_:c<id>` so relation files join without an id map.
+
+    Comment entities carry the `replyOf` chains (comment→post and
+    comment→comment, ISSUE 15) so depth-3 traversals over
+    replyOf/hasCreator have realistic fan-out, not just person.knows."""
     stats = LdbcStats()
     with gzip.open(out_path, "wt", encoding="utf-8") as out:
         _emit_entity(out, _ldbc_file(dirpath, "person"), "p", "person.id",
@@ -192,6 +203,14 @@ def convert_ldbc(dirpath: str, out_path: str) -> LdbcStats:
                      _POST_COLS, stats, "posts")
         _emit_relation(out, _ldbc_file(dirpath, "post_hasCreator_person"),
                        "post", "hasCreator", "p", stats, None)
+        _emit_entity(out, _ldbc_file(dirpath, "comment"), "c", "comment.id",
+                     _COMMENT_COLS, stats, "comments")
+        _emit_relation(out, _ldbc_file(dirpath, "comment_replyOf_post"),
+                       "c", "replyOf", "post", stats, "reply_of")
+        _emit_relation(out, _ldbc_file(dirpath, "comment_replyOf_comment"),
+                       "c", "replyOf", "c", stats, "reply_of")
+        _emit_relation(out, _ldbc_file(dirpath, "comment_hasCreator_person"),
+                       "c", "hasCreator", "p", stats, None)
     with open(out_path + ".schema", "w", encoding="utf-8") as f:
         f.write(LDBC_SCHEMA)
     return stats

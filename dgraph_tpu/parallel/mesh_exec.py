@@ -250,23 +250,64 @@ class MeshExecutor:
             return hit[1]
         out = GraphSnapshot(snap.read_ts)
         out.metrics = getattr(snap, "metrics", None)
-        sharded = replicated = 0
-        for attr, pd in snap.preds.items():
-            placed = self._place_pred(pd)
-            out.preds[attr] = placed
-            for c in (placed.csr, placed.rev_csr):
-                if c is None:
-                    continue
-                if self.owns(c):
-                    sharded += 1
-                else:
-                    replicated += 1
-        self.metrics.counter("dgraph_mesh_sharded_tablets").set(sharded)
-        self.metrics.counter("dgraph_mesh_replicated_tablets").set(replicated)
+        pend_fn = getattr(snap.preds, "pending_attrs", None)
+        # capture the pending list BEFORE folded_items: a tablet resolving
+        # between the two reads (prefetch folds run on the pool) must land
+        # in at least one set — register() no-ops on already-placed attrs,
+        # so the overlap direction is safe while the gap direction would
+        # silently drop the tablet from the cached placed snapshot
+        pending = pend_fn() if pend_fn is not None else []
+        if pending:
+            # lazy base (ISSUE 15): placement must not fold the world at
+            # snapshot time. Folded tablets place eagerly; pending ones
+            # register pass-through thunks that fold-then-place on first
+            # read — placement stays identity-cached at the PredData
+            # level, so cache tokens behave exactly as today
+            from dgraph_tpu.storage.csr_build import DelegateThunk, LazyPreds
+
+            preds = LazyPreds()
+            preds.hint_fn = getattr(snap.preds, "hint_fn", None)
+            out.preds = preds
+            for attr, pd in snap.preds.folded_items():
+                preds[attr] = self._place_pred(pd)
+            for attr in pending:
+                preds.register(attr, DelegateThunk(snap.preds, attr,
+                                                   wrap=self._place_pred))
+            preds.on_resolve = lambda _a, _pd: self._count_placed(preds)
+        else:
+            for attr, pd in snap.preds.items():
+                out.preds[attr] = self._place_pred(pd)
+        self._count_placed(out.preds)
         self._placed_snaps[id(snap)] = (snap, out)
         while len(self._placed_snaps) > self._SNAP_CACHE:
             self._placed_snaps.popitem(last=False)
         return out
+
+    def _count_placed(self, preds) -> None:
+        """Refresh the sharded/replicated tablet gauges over the placed
+        (folded) entries — lazy placements update them as they resolve.
+        Concurrent resolutions mutate the dict mid-walk; retry the
+        briefly-inconsistent iteration (the overlay_stats contract) —
+        a gauge refresh must never fail the triggering read."""
+        for _ in range(4):
+            sharded = replicated = 0
+            try:
+                items = getattr(preds, "folded_items", preds.items)()
+                for _attr, pd in items:
+                    for c in (pd.csr, pd.rev_csr):
+                        if c is None:
+                            continue
+                        if self.owns(c):
+                            sharded += 1
+                        else:
+                            replicated += 1
+            except RuntimeError:
+                continue
+            break
+        else:
+            return
+        self.metrics.counter("dgraph_mesh_sharded_tablets").set(sharded)
+        self.metrics.counter("dgraph_mesh_replicated_tablets").set(replicated)
 
     def _place_pred(self, pd):
         hit = self._placed_pd.get(id(pd))

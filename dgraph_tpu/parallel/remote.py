@@ -187,7 +187,8 @@ class WorkerService:
 
     def __init__(self, store, batching: bool = True,
                  batch_window_ms: float = 2.0, batch_max: int = 16,
-                 cost_ledger: bool = True) -> None:
+                 cost_ledger: bool = True,
+                 lazy_folds: bool = True) -> None:
         import collections
         import os
         import threading
@@ -207,7 +208,9 @@ class WorkerService:
         # the query node assembles one tree — proc is refined to the bound
         # address by serve_worker.
         self.tracer = otrace.Tracer(proc="worker")
-        self._assembler = SnapshotAssembler(store, metrics=self.metrics)
+        self.lazy_folds = bool(lazy_folds)
+        self._assembler = SnapshotAssembler(store, metrics=self.metrics,
+                                            lazy_folds=self.lazy_folds)
         self._lock = threading.Lock()
         # server-side task-result cache: repeated/fanned-out ServeTask
         # calls for the same (snapshot, task) answer from memory, and
@@ -923,7 +926,8 @@ class WorkerService:
                     _sh.rmtree(d, ignore_errors=True)
                 with self._lock:
                     self._assembler = SnapshotAssembler(
-                        self.store, metrics=self.metrics)
+                        self.store, metrics=self.metrics,
+                        lazy_folds=self.lazy_folds)
                 self._last_seq = int(resp.session_seq)
         # dgraph: allow(except-seam) next gap retries the state sync;
         # the follower keeps serving its last applied state meanwhile
@@ -1221,7 +1225,7 @@ def serve_worker(store, addr: str = "localhost:0",
                  max_workers: int = 8, advertise_host: str | None = None,
                  elections: bool = False, batching: bool = True,
                  batch_window_ms: float = 2.0, batch_max: int = 16,
-                 cost_ledger: bool = True):
+                 cost_ledger: bool = True, lazy_folds: bool = True):
     """Start a Worker gRPC server for one group's store; returns
     (server, bound_port). advertise_host overrides the callback host
     followers use for FetchState — required when binding a wildcard
@@ -1231,7 +1235,8 @@ def serve_worker(store, addr: str = "localhost:0",
     Node's batched-dispatch knobs for the worker's own device path."""
     svc = WorkerService(store, batching=batching,
                         batch_window_ms=batch_window_ms,
-                        batch_max=batch_max, cost_ledger=cost_ledger)
+                        batch_max=batch_max, cost_ledger=cost_ledger,
+                        lazy_folds=lazy_folds)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          options=GRPC_OPTIONS)
     server.add_generic_rpc_handlers((svc.handler(),))

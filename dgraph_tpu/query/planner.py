@@ -317,6 +317,24 @@ def build_plan(req, snap, schema, metrics=None, top_k: int = 8,
     return plan
 
 
+def _snapshot_universe(snap, metrics) -> int:
+    """Total has() cardinality across the snapshot — the root-estimate
+    normalization. A lazy snapshot (storage/csr_build.LazyPreds) must NOT
+    fold the world for a normalization constant: folded tablets use their
+    live stats, pending ones a decode-free key-count hint. Order decisions
+    only — results are identical either way (plan ≡ parse-order)."""
+    preds = snap.preds
+    folded = getattr(preds, "folded_values", None)
+    if folded is None:
+        return sum(stmod.pred_stats(pd, metrics).has_card
+                   for pd in preds.values()) or 1
+    total = sum(stmod.pred_stats(pd, metrics).has_card
+                for pd in folded())
+    for attr in preds.pending_attrs():
+        total += preds.pending_card(attr)
+    return total or 1
+
+
 def _count(metrics, name: str) -> None:
     if metrics is not None:
         metrics.counter(name).inc()
@@ -336,8 +354,7 @@ def _plan_block(plan: Plan, gq, snap, schema, metrics, trace,
     source = "frontier"
     swapped = False
     if frontier_est is None:
-        universe = sum(stmod.pred_stats(pd, metrics).has_card
-                       for pd in snap.preds.values()) or 1
+        universe = _snapshot_universe(snap, metrics)
         root_est = universe
         parts = []
         if gq.uids:

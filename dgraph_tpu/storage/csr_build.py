@@ -28,6 +28,8 @@ build new snapshots (posting/mvcc.go's readTs gating, without device MVCC).
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Iterable
 
@@ -297,7 +299,10 @@ class GraphSnapshot:
     @property
     def nbytes(self) -> int:
         total = 0
-        for pd in self.preds.values():
+        # memory accounting must never force folds: a lazy snapshot counts
+        # only its materialized tablets (unfolded thunks hold no arrays)
+        folded = getattr(self.preds, "folded_values", None)
+        for pd in (folded() if folded is not None else self.preds.values()):
             for csr in (pd.csr, pd.rev_csr):
                 if csr is not None:
                     est = getattr(csr, "approx_nbytes", None)
@@ -321,6 +326,338 @@ class GraphSnapshot:
             if pd.vecindex is not None:
                 total += pd.vecindex.nbytes()
         return total
+
+
+# ---------------------------------------------------------------------------
+# lazy on-demand snapshot folds (ISSUE 15)
+# ---------------------------------------------------------------------------
+#
+# Eager assembly folded EVERY predicate at snapshot time — ~4 µs/list of
+# Python (PERF.md round 5), i.e. 13-20 s to the first query at 10M edges
+# and minutes at LDBC-SNB SF10+. The scale-regime cold path instead
+# registers unfolded tablets as fold-THUNKS: the first read of a predicate
+# (task/engine seams via GraphSnapshot.pred / LazyPreds.get), a residency
+# plan-driven prefetch (storage/residency.prefetch, overlapped through the
+# shared fold pool), or an overlay-forced inline compaction triggers the
+# fold, with singleflight per tablet so racing first readers share ONE
+# fold. PredData identity is minted at first fold and then reused exactly
+# like the eager path's, so qcache per-predicate tokens, the
+# DeviceBatcher's same-CSR-object rule, and mesh placement caches behave
+# identically — and the fold itself is byte-identical to eager assembly
+# (same build_pred at the same effective read_ts).
+#
+# Consistency window (the one deliberate divergence from eager): an
+# unresolved thunk folds against the LIVE store at its registration-time
+# read_ts. Normal commits land above that ts and stay invisible — the
+# fold is byte-identical to eager. The exceptions are the races the
+# staleness machinery already polices: a predicate DROP resolves the
+# pending tablet as empty (build_pred's dropped-mid-build contract —
+# eager would have served the pre-drop fold), and a replication replay
+# BELOW the watermark is included by a post-replay fold while tablets
+# folded earlier excluded it; pred_replay_seq marks such snapshots stale
+# and the next snapshot() call rebuilds, bounding the mixed view to
+# queries already holding the snapshot — the same exposure the stamped
+# eager cache accepts between _stale() checks.
+
+# fold-trigger counters (pre-registered in utils/metrics.Registry; literal
+# names so the analysis metric rule and the runtime audit both see them)
+_FOLD_COUNTERS = {
+    "lazy": "dgraph_fold_lazy_total",
+    "eager": "dgraph_fold_eager_total",
+    "prefetch": "dgraph_fold_prefetch_total",
+    "inline": "dgraph_fold_inline_total",
+}
+
+
+def _note_fold(metrics, trigger: str, dt_ms: float | None) -> None:
+    if metrics is None:
+        return
+    metrics.counter(_FOLD_COUNTERS.get(trigger,
+                                       "dgraph_fold_lazy_total")).inc()
+    if dt_ms is not None:
+        metrics.histogram("dgraph_fold_ms").observe(dt_ms)
+
+
+class _FoldThunk:
+    """One unfolded tablet: fold-on-first-read with per-tablet
+    singleflight. The claim lock is held only to elect a leader — the
+    fold itself runs outside it (no nested lock acquisition, so
+    lockdep-armed runs see no new edges). A failed fold propagates to the
+    waiters of THAT attempt and resets leadership so a later read
+    retries; a resolved thunk answers every subsequent caller (including
+    LazyPreds copies sharing it) without re-folding."""
+
+    __slots__ = ("attr", "eff", "pct", "seq", "inline", "fold",
+                 "pd", "error", "_lock", "_event", "_claimed")
+
+    def __init__(self, attr: str, eff: int, fold, pct: int = 0,
+                 seq: int = 0, inline: bool = False) -> None:
+        self.attr = attr
+        self.eff = eff
+        self.pct = pct
+        self.seq = seq
+        self.inline = inline      # fold forced by overlay depth/stamp miss
+        self.fold = fold          # callable(thunk, trigger) -> PredData
+        self.pd: PredData | None = None
+        self.error: BaseException | None = None
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._claimed = False
+
+    def resolve(self, trigger: str = "lazy") -> PredData:
+        pd = self.pd
+        if pd is not None:
+            return pd
+        with self._lock:
+            if self.pd is not None:
+                return self.pd
+            lead = not self._claimed
+            if lead:
+                self._claimed = True
+            event = self._event
+        if not lead:
+            # racing first reader: share the leader's fold. Clamped to the
+            # caller's own deadline budget (same contract as the task-cache
+            # singleflight follower) — never an unbounded hang.
+            from dgraph_tpu.utils import deadline as dl
+
+            if not event.wait(dl.clamp(None)):
+                dl.check("lazy fold follower")
+                raise dl.DeadlineExceeded(
+                    f"lazy fold of {self.attr} timed out")
+            if self.pd is not None:
+                return self.pd
+            if self.error is not None:
+                raise self.error
+            return self.resolve(trigger)       # leader failed then reset
+        try:
+            pd = self.fold(self, "inline" if self.inline else trigger)
+        except BaseException as e:
+            with self._lock:
+                self.error = e
+                self._claimed = False
+                self._event = threading.Event()
+            event.set()
+            raise
+        self.pd = pd
+        self.error = None
+        event.set()
+        return pd
+
+
+class DelegateThunk:
+    """Pass-through thunk: resolves another lazy map's entry (embedded
+    Cluster assembly, mesh placement). The FOLD singleflight lives in the
+    underlying map's own thunk; the claim lock here serializes the `wrap`
+    transform too — racing first readers must receive ONE placed identity
+    (and pay one sharding/upload), not two."""
+
+    __slots__ = ("src", "attr", "wrap", "pd", "_lock")
+
+    def __init__(self, src, attr: str, wrap=None) -> None:
+        self.src = src
+        self.attr = attr
+        self.wrap = wrap          # optional post-fold transform (placement)
+        self.pd = None
+        self._lock = threading.Lock()
+
+    def resolve(self, trigger: str = "lazy"):
+        if self.pd is not None:
+            return self.pd
+        with self._lock:
+            if self.pd is None:
+                pd = self.src.get(self.attr)
+                if pd is not None and self.wrap is not None:
+                    pd = self.wrap(pd)
+                if pd is None:
+                    return None
+                self.pd = pd
+        return self.pd
+
+
+class LazyPreds(dict):
+    """attr → PredData where unfolded tablets are fold-thunks.
+
+    The dict storage holds FOLDED entries only; `_thunks` holds the
+    pending tablets. Key views (len / contains / iter / keys) see the
+    union WITHOUT folding; `get`/`[]` fold exactly the requested tablet
+    (the demand-driven seam every query path reads through); `values()` /
+    `items()` materialize everything first — callers that genuinely need
+    the whole world (mesh re-sharding, expand() known-uid validation)
+    keep eager semantics, in parallel through the shared fold pool.
+    Mutation (`[k] = v`, `update`) drops any shadowed thunk: an explicit
+    entry (txn overlay, placed tablet) always wins."""
+
+    __slots__ = ("_thunks", "hint_fn", "on_resolve")
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._thunks: dict[str, object] = {}
+        self.hint_fn = None       # callable(attr) -> cardinality estimate
+        self.on_resolve = None    # callback(attr, pd) per materialization
+
+    # -- registration ---------------------------------------------------------
+
+    def register(self, attr: str, thunk) -> None:
+        if not dict.__contains__(self, attr):
+            self._thunks[attr] = thunk
+
+    # -- resolution -----------------------------------------------------------
+
+    def resolve(self, attr: str, trigger: str = "lazy"):
+        """Fold one pending tablet (or return the folded entry)."""
+        pd = dict.get(self, attr)
+        if pd is not None:
+            return pd
+        th = self._thunks.get(attr)
+        if th is None:
+            return dict.get(self, attr)   # raced another resolver
+        pd = th.resolve(trigger)
+        if pd is None:                    # delegate over an absent tablet
+            self._thunks.pop(attr, None)
+            return None
+        dict.__setitem__(self, attr, pd)
+        self._thunks.pop(attr, None)
+        cb = self.on_resolve
+        if cb is not None:
+            try:
+                cb(attr, pd)
+            except Exception:
+                pass          # gauges/bookkeeping must never fail a read
+        return pd
+
+    def materialize_all(self, trigger: str = "eager") -> int:
+        """Fold every pending tablet, in parallel through the shared fold
+        pool. Distinct attrs have distinct thunks and each pool task waits
+        only on a leader that is already RUNNING (claims happen inside
+        resolve), so pool-width saturation cannot deadlock."""
+        pending = [a for a in list(self._thunks)
+                   if not dict.__contains__(self, a)]
+        if not pending:
+            return 0
+        if len(pending) > 1:
+            from concurrent.futures import TimeoutError as _FutTimeout
+
+            from dgraph_tpu.utils import deadline as dl
+
+            pool = _fold_pool()
+            # dgraph: allow(ctxvar-copy) folds build SHARED snapshot
+            # state cached across requests — they must not inherit any
+            # one request's deadline/trace context
+            futs = [pool.submit(self.resolve, a, trigger) for a in pending]
+            for f in futs:
+                try:
+                    # clamped to the CALLER's budget: a timed-out request
+                    # raises typed instead of waiting out the whole fold
+                    # wall; the pool keeps folding for the next reader
+                    f.result(timeout=dl.clamp(None))
+                except _FutTimeout:
+                    dl.check("materialize_all fold")
+                    raise dl.DeadlineExceeded(
+                        "materialize-all folds timed out")
+        else:
+            self.resolve(pending[0], trigger)
+        return len(pending)
+
+    # -- mapping protocol -----------------------------------------------------
+
+    def __getitem__(self, attr):
+        pd = dict.get(self, attr)
+        if pd is not None:
+            return pd
+        if attr in self._thunks:
+            pd = self.resolve(attr)
+            if pd is not None:
+                return pd
+        raise KeyError(attr)
+
+    def get(self, attr, default=None):
+        pd = dict.get(self, attr)
+        if pd is not None:
+            return pd
+        if attr in self._thunks:
+            pd = self.resolve(attr)
+            if pd is not None:
+                return pd
+        return default
+
+    def __contains__(self, attr) -> bool:
+        return dict.__contains__(self, attr) or attr in self._thunks
+
+    def __len__(self) -> int:
+        return len(set(dict.keys(self)) | set(self._thunks))
+
+    def __iter__(self):
+        return iter(sorted(set(dict.keys(self)) | set(self._thunks)))
+
+    def keys(self):
+        return sorted(set(dict.keys(self)) | set(self._thunks))
+
+    def values(self):
+        # sorted-key order: eager assembly inserted in sorted
+        # store.predicates() order, while on-demand resolution inserts in
+        # completion order — iteration must stay deterministic (tablet
+        # routing assigns groups in iteration order)
+        self.materialize_all()
+        return [v for _k, v in sorted(dict.items(self))]
+
+    def items(self):
+        self.materialize_all()
+        return sorted(dict.items(self))
+
+    def __setitem__(self, attr, pd) -> None:
+        self._thunks.pop(attr, None)
+        dict.__setitem__(self, attr, pd)
+
+    def update(self, other=(), **kw) -> None:
+        d = dict(other, **kw)
+        for k in d:
+            self._thunks.pop(k, None)
+        dict.update(self, d)
+
+    # -- lazy-aware views (planner / stats / residency / memory) --------------
+
+    def folded_get(self, attr, default=None):
+        """Folded entry or default — NEVER resolves a thunk (identity
+        probes like compact()'s pinned-view scan must not fold)."""
+        return dict.get(self, attr, default)
+
+    def folded_items(self):
+        return list(dict.items(self))
+
+    def folded_values(self):
+        return list(dict.values(self))
+
+    def pending_attrs(self) -> list[str]:
+        return [a for a in list(self._thunks)
+                if not dict.__contains__(self, a)]
+
+    def is_pending(self, attr: str) -> bool:
+        return attr in self._thunks and not dict.__contains__(self, attr)
+
+    def pending_card(self, attr: str) -> int:
+        """Cardinality ESTIMATE for an unfolded tablet (planner universe
+        normalization — order decisions only, never results)."""
+        fn = self.hint_fn
+        if fn is None:
+            return 0
+        try:
+            return int(fn(attr))
+        except Exception:
+            return 0
+
+    def lazy_copy(self) -> "LazyPreds":
+        """Folded entries copied, pending thunks SHARED — the txn
+        read-view copy (api/server._read_view). A fold through either
+        map resolves the one shared thunk; `dict(base.preds)` would
+        silently drop the pending tablets via the CPython dict fast
+        path, which is why that call site uses this instead."""
+        out = LazyPreds()
+        dict.update(out, self)
+        out._thunks = dict(self._thunks)
+        out.hint_fn = self.hint_fn
+        out.on_resolve = self.on_resolve
+        return out
 
 
 _UNPACK_CHUNK = 16384   # lists decoded per vectorized unpack_many call
@@ -670,9 +1007,15 @@ def _fold_attrs(store: Store, attrs: list[str], read_ts: int,
                 metrics=None) -> list[PredData]:
     """build_pred over many attrs, through the fold pool when it pays;
     `workers` caps this call's concurrency without resizing the pool."""
-    if len(attrs) > 1 and workers > 1:
-        import threading
+    def one(a):
+        t0 = time.perf_counter()
+        pd = build_pred(store, a, read_ts, own_start_ts)
+        # per COMPLETED fold, wall observed on dgraph_fold_ms — the same
+        # accounting every lazy/prefetch/inline trigger gets
+        _note_fold(metrics, "eager", (time.perf_counter() - t0) * 1e3)
+        return pd
 
+    if len(attrs) > 1 and workers > 1:
         pool = _fold_pool()
         sem = threading.Semaphore(workers)
         if metrics is not None:
@@ -682,25 +1025,48 @@ def _fold_attrs(store: Store, attrs: list[str], read_ts: int,
 
         def run(a):
             with sem:
-                return build_pred(store, a, read_ts, own_start_ts)
+                return one(a)
 
         # dgraph: allow(ctxvar-copy) folds build SHARED snapshot state
         # cached across requests — they must not inherit any one
         # request's deadline/trace context
         futs = [pool.submit(run, a) for a in attrs]
         return [f.result() for f in futs]
-    return [build_pred(store, a, read_ts, own_start_ts) for a in attrs]
+    return [one(a) for a in attrs]
 
 
 def build_snapshot(store: Store, read_ts: int,
                    attrs: Iterable[str] | None = None,
                    own_start_ts: int | None = None,
-                   fold_workers: int | None = None) -> GraphSnapshot:
+                   fold_workers: int | None = None,
+                   lazy: bool = False) -> GraphSnapshot:
     """Fold the store at read_ts into a GraphSnapshot (upload to device).
     Folds run across the shared thread pool (per-predicate folds are
-    independent); fold_workers=1 forces the serial path."""
+    independent); fold_workers=1 forces the serial path.
+
+    lazy=True registers every tablet as a fold-thunk instead: the first
+    read of a predicate folds exactly that tablet (singleflighted), with
+    output byte-identical to the eager fold at the same read_ts. The
+    serving path (SnapshotAssembler) is lazy by default; this one-shot
+    utility stays eager by default because its callers (replication
+    quorum reads, smoke-test reference builds) want the complete fold."""
     snap = GraphSnapshot(read_ts)
     todo = sorted(attrs) if attrs is not None else store.predicates()
+    if lazy:
+        metrics = getattr(store, "metrics", None)
+        preds = LazyPreds()
+        snap.preds = preds
+
+        def bare_fold(th, trigger):
+            t0 = time.perf_counter()
+            pd = build_pred(store, th.attr, th.eff, own_start_ts)
+            _note_fold(metrics, trigger,
+                       (time.perf_counter() - t0) * 1e3)
+            return pd
+
+        for attr in todo:
+            preds.register(attr, _FoldThunk(attr, read_ts, bare_fold))
+        return snap
     workers = fold_workers if fold_workers is not None \
         else default_fold_workers()
     for attr, pd in zip(todo, _fold_attrs(store, todo, read_ts,
@@ -744,7 +1110,8 @@ class SnapshotAssembler:
                  overlay_enabled: bool = True,
                  overlay_max_keys: int | None = None,
                  overlay_max_age_s: float | None = None,
-                 fold_workers: int | None = None) -> None:
+                 fold_workers: int | None = None,
+                 lazy_folds: bool = True) -> None:
         self.store = store
         self.on_pred_build = on_pred_build       # callback(attr) per re-fold
         self.metrics = metrics                   # utils.metrics.Registry|None
@@ -755,10 +1122,24 @@ class SnapshotAssembler:
             self.OVERLAY_MAX_AGE_S = float(overlay_max_age_s)
         self.fold_workers = (fold_workers if fold_workers is not None
                              else default_fold_workers())
+        # lazy on-demand folds (ISSUE 15): assembly registers fold-thunks
+        # and the first read of a predicate folds exactly that tablet
+        self.lazy_folds = bool(lazy_folds)
         # attr -> (built_ts, PredData, replay_seq at build)
         self._pred_cache: dict[str, tuple[int, PredData, int]] = {}
         self._overlays: dict[str, _OverlayState] = {}
         self._snaps: dict[int, GraphSnapshot] = {}
+        # attr -> unresolved fold thunk: carried across assemblies while
+        # the data window is unchanged so successive snapshots share one
+        # pending fold exactly like they share one cached PredData
+        self._pending: dict[str, _FoldThunk] = {}
+        self._card_hints: dict[str, int] = {}    # attr -> DATA key count
+        self._first_assembled = False
+        # bumped by invalidate(): structural changes ('s'/'dp'/'dk'
+        # records) don't move pred_commit_ts/pred_replay_seq, so a lazy
+        # fold in flight across an alter needs its own stability check
+        # before writing _pred_cache
+        self._cache_gen = 0
 
     def snapshot(self, read_ts: int) -> GraphSnapshot:
         """Committed view at read_ts (clamped to the newest commit: two
@@ -804,9 +1185,14 @@ class SnapshotAssembler:
             a: self.store.pred_replay_seq.get(a, 0) for a in snap.preds}
 
     def _assemble(self, eff: int) -> GraphSnapshot:
+        t0 = time.perf_counter()
         snap = GraphSnapshot(eff)
+        if self.lazy_folds:
+            preds = LazyPreds()
+            preds.hint_fn = self._card_hint
+            snap.preds = preds
         reused = 0
-        todo: list[str] = []
+        todo: list[tuple[str, int, int, bool]] = []
         for attr in self.store.predicates():
             pct = self.store.pred_commit_ts.get(attr, 0)
             seq = self.store.pred_replay_seq.get(attr, 0)
@@ -827,10 +1213,27 @@ class SnapshotAssembler:
             if pd is not None:
                 snap.preds[attr] = pd
             else:
-                todo.append(attr)
-        if todo:
-            for attr, pd in zip(todo, _fold_attrs(
-                    self.store, todo, eff, None, self.fold_workers,
+                todo.append((attr, pct, seq, cached is not None))
+        if todo and self.lazy_folds:
+            # register fold-thunks instead of folding: the first read of
+            # a predicate (or a residency prefetch) folds exactly that
+            # tablet, singleflighted. A still-pending thunk from an
+            # earlier assembly is reused while its data window matches —
+            # the same both-views-complete rule as _pred_cache reuse
+            for attr, pct, seq, had_cached in todo:
+                th = self._pending.get(attr)
+                if th is None or not (th.eff >= pct and eff >= pct
+                                      and th.seq == seq):
+                    th = _FoldThunk(attr, eff, self._fold_pending,
+                                    pct=pct, seq=seq, inline=had_cached)
+                    if eff >= pct:
+                        self._pending[attr] = th
+                snap.preds.register(attr, th)
+            self._set_pending_gauge()
+        elif todo:
+            attrs = [a for a, _p, _s, _c in todo]
+            for attr, pd in zip(attrs, _fold_attrs(
+                    self.store, attrs, eff, None, self.fold_workers,
                     self.metrics)):
                 if self.on_pred_build is not None:
                     self.on_pred_build(attr)
@@ -852,7 +1255,61 @@ class SnapshotAssembler:
         # snapshot — per-node correct, no module globals
         snap.metrics = self.metrics
         self._stamp(snap)
+        if not self._first_assembled:
+            # the cold-open lever: under eager folds this wall covered
+            # EVERY tablet's fold; lazy assembly is O(predicates)
+            self._first_assembled = True
+            if self.metrics is not None:
+                self.metrics.counter("dgraph_cold_open_ms").set(
+                    (time.perf_counter() - t0) * 1e3)
         return snap
+
+    def _fold_pending(self, th: _FoldThunk, trigger: str) -> PredData:
+        """On-demand fold of one registered thunk (the _FoldThunk leader
+        runs this OUTSIDE the claim lock) plus the cache bookkeeping the
+        eager assembly tail performs. pct/seq are read around the fold
+        and the cache entry written only when nothing moved mid-fold (the
+        compact() pattern), so a racing commit or replication replay can
+        never pin a view whose delta the journal can't reproduce."""
+        store = self.store
+        gen0 = self._cache_gen
+        pct0 = store.pred_commit_ts.get(th.attr, 0)
+        seq0 = store.pred_replay_seq.get(th.attr, 0)
+        t0 = time.perf_counter()
+        pd = build_pred(store, th.attr, th.eff)
+        _note_fold(self.metrics, trigger, (time.perf_counter() - t0) * 1e3)
+        if self.on_pred_build is not None:
+            self.on_pred_build(th.attr)
+        pct = store.pred_commit_ts.get(th.attr, 0)
+        seq = store.pred_replay_seq.get(th.attr, 0)
+        if th.eff >= pct and (pct0, seq0) == (pct, seq) \
+                and gen0 == self._cache_gen:
+            self._pred_cache[th.attr] = (th.eff, pd, seq)
+            self._overlays.pop(th.attr, None)
+            self._set_depth(th.attr, 0)
+            store.prune_delta(th.attr, th.eff)
+        if self._pending.get(th.attr) is th:
+            self._pending.pop(th.attr, None)
+        self._set_pending_gauge()
+        return pd
+
+    def _card_hint(self, attr: str) -> int:
+        """DATA key count of one tablet — the planner's universe
+        normalization for unfolded tablets (order decisions only, never
+        results; exact post-bulk via the packed-tablet count, a decode-free
+        key scan otherwise). Cached until invalidate()."""
+        h = self._card_hints.get(attr)
+        if h is None:
+            tp = self.store.packed_tablet(int(K.KeyKind.DATA), attr)
+            h = int(tp.n) if tp is not None else \
+                len(self.store.keys_of(K.KeyKind.DATA, attr))
+            self._card_hints[attr] = h
+        return h
+
+    def _set_pending_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.counter("dgraph_fold_pending_tablets").set(
+                len(self._pending))
 
     def _set_depth(self, attr: str, depth: int) -> None:
         if self.metrics is not None:
@@ -926,9 +1383,17 @@ class SnapshotAssembler:
         import time as _time
 
         now = _time.monotonic()
-        return [attr for attr, st in self._overlays.items()
-                if force or st.depth >= self.OVERLAY_MAX_KEYS
-                or now - st.born >= self.OVERLAY_MAX_AGE_S]
+        # lazy folds pop _overlays from query threads (_fold_pending runs
+        # lock-free); retry the briefly-inconsistent iteration like
+        # overlay_stats does instead of requiring the node lock
+        for _ in range(4):
+            try:
+                return [attr for attr, st in list(self._overlays.items())
+                        if force or st.depth >= self.OVERLAY_MAX_KEYS
+                        or now - st.born >= self.OVERLAY_MAX_AGE_S]
+            except RuntimeError:
+                continue
+        return []
 
     def compact(self, lock, attrs: list[str] | None = None,
                 force: bool = False) -> int:
@@ -969,8 +1434,12 @@ class SnapshotAssembler:
                 # the next read reassembles over the fresh base (cheap — all
                 # predicates are cache hits) and the overlay memory frees
                 if old is not None:
+                    # folded-only peek: a pinned stamped view is always a
+                    # materialized entry — .get here would FOLD pending
+                    # tablets of every cached snapshot just to compare
                     for k in [k for k, s in self._snaps.items()
-                              if s.preds.get(attr) is old[1]]:
+                              if getattr(s.preds, "folded_get",
+                                         s.preds.get)(attr) is old[1]]:
                         self._snaps.pop(k, None)
                 done += 1
                 self._set_depth(attr, 0)
@@ -990,6 +1459,15 @@ class SnapshotAssembler:
         self._pred_cache.clear()
         self._overlays.clear()
         self._snaps.clear()
+        # outstanding lazy thunks (held by handed-out snapshots) still
+        # resolve against the live store at their own read_ts; the
+        # assembler just stops reusing them — and the generation bump
+        # keeps an in-flight fold (started pre-alter) from writing its
+        # stale view back into _pred_cache after this clear
+        self._cache_gen += 1
+        self._pending.clear()
+        self._card_hints.clear()
+        self._set_pending_gauge()
         return n
 
     def cache_size(self) -> int:

@@ -448,28 +448,49 @@ class ResidencyManager:
     # -- plan-driven prefetch -------------------------------------------------
 
     def prefetch(self, attrs, snap, sync: bool = False) -> int:
-        """Async warm-tier uploads for a plan's predicate read set, issued
-        BEFORE dispatch so the transfer overlaps the preceding host work /
-        device step. Only warm, admissible, not-yet-resident buffer groups
-        upload; returns the number of uploads scheduled. sync=True runs
-        them inline (tests / deterministic benches)."""
-        if not self.enabled or not attrs:
+        """Plan-driven prefetch, two legs issued BEFORE dispatch:
+
+        * lazy FOLDS (ISSUE 15) — attrs still registered as fold-thunks
+          (storage/csr_build.LazyPreds) resolve on the shared fold pool,
+          overlapping the fold with the request's preceding host work;
+          the request's own first read then JOINS the in-flight fold via
+          the thunk's singleflight. Folding is host-side cost, so this
+          leg runs regardless of the device budget.
+        * warm→HBM UPLOADS (ISSUE 11) — folded, admissible,
+          not-yet-resident buffer groups upload on the async pool, only
+          with a finite budget (`enabled`), exactly as before. A
+          prefetched fold chains into its own upload when admissible.
+
+        Returns the number of fold+upload actions scheduled. sync=True
+        runs everything inline (tests / deterministic benches)."""
+        if not attrs:
             return 0
-        todo = []
+        preds = snap.preds
+        is_pending = getattr(preds, "is_pending", None)
+        scheduled = 0
+        folded_attrs = []
         for attr in attrs:
-            pd = snap.preds.get(attr)
+            if is_pending is not None and is_pending(attr):
+                scheduled += 1
+                if sync:
+                    self._prefetch_fold(preds, attr, sync=True)
+                else:
+                    from dgraph_tpu.storage.csr_build import _fold_pool
+
+                    # dgraph: allow(ctxvar-copy) prefetched folds build
+                    # SHARED snapshot state cached across requests — they
+                    # must not inherit any one request's deadline/trace
+                    _fold_pool().submit(self._prefetch_fold, preds, attr)
+            else:
+                folded_attrs.append(attr)
+        if not self.enabled:
+            return scheduled
+        todo = []
+        for attr in folded_attrs:
+            pd = preds.get(attr)
             if pd is None:
                 continue
-            for owner in (pd.csr, pd.rev_csr, pd.vecindex):
-                if owner is None or getattr(owner, "_res", None) is not self:
-                    continue
-                try:
-                    if owner.device_resident() or \
-                            not self.allows_device(owner.device_nbytes()):
-                        continue
-                except Exception:
-                    continue
-                todo.append(owner)
+            todo.extend(self._upload_candidates(pd))
         for owner in todo:
             if sync:
                 self._prefetch_one(owner)
@@ -479,7 +500,45 @@ class ResidencyManager:
                 # shared) — inheriting its deadline would cancel uploads
                 # the NEXT query needs
                 self._prefetch_pool().submit(self._prefetch_one, owner)
-        return len(todo)
+        return scheduled + len(todo)
+
+    def _upload_candidates(self, pd) -> list:
+        """Managed, admissible, not-yet-resident buffer groups of one
+        folded PredData."""
+        out = []
+        for owner in (pd.csr, pd.rev_csr, pd.vecindex):
+            if owner is None or getattr(owner, "_res", None) is not self:
+                continue
+            try:
+                if owner.device_resident() or \
+                        not self.allows_device(owner.device_nbytes()):
+                    continue
+            except Exception:
+                continue
+            out.append(owner)
+        return out
+
+    def _prefetch_fold(self, preds, attr: str, sync: bool = False) -> None:
+        """Resolve one pending fold-thunk (trigger=prefetch), then chain
+        its warm→HBM uploads when a finite budget is configured. The
+        uploads route through the dedicated prefetch pool — a blocking
+        H2D transfer must not occupy a fold-pool slot other queries'
+        lazy folds are waiting on (sync=True runs them inline)."""
+        try:
+            pd = preds.resolve(attr, "prefetch")
+        except Exception:
+            # racing drops / injected faults: the on-demand read path
+            # retries; a failed prefetch must never surface anywhere
+            return
+        if pd is None or not self.enabled:
+            return
+        for owner in self._upload_candidates(pd):
+            if sync:
+                self._prefetch_one(owner)
+            else:
+                # dgraph: allow(ctxvar-copy) prefetch uploads are shared
+                # node work detached from any request's deadline/trace
+                self._prefetch_pool().submit(self._prefetch_one, owner)
 
     def _prefetch_pool(self):
         with self._lock:

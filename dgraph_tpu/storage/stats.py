@@ -275,9 +275,12 @@ def topk_terms(ti, k: int = 8) -> list[tuple[str, int]]:
 
 def snapshot_stats(snap, metrics=None, top_k: int = 0) -> dict:
     """Whole-snapshot stats readout ({attr: PredStats dict}) — the
-    /debug/metrics "stats" section and the EXPLAIN header."""
+    /debug/metrics "stats" section and the EXPLAIN header. Lazy
+    snapshots report FOLDED tablets only: a debug scrape must never
+    trigger the folds the lazy cold path exists to defer."""
     out = {}
-    for attr, pd in sorted(snap.preds.items()):
+    items = getattr(snap.preds, "folded_items", snap.preds.items)()
+    for attr, pd in sorted(items):
         d = pred_stats(pd, metrics).to_dict()
         if top_k:
             d["top_terms"] = {name: topk_terms(ti, top_k)

@@ -371,7 +371,18 @@ class Registry:
                      # query cost ledger (obs/costs.py; ISSUE 13)
                      "dgraph_cost_records_total",
                      "dgraph_cost_regressions_total",
-                     "dgraph_cost_ship_failures_total"):
+                     "dgraph_cost_ship_failures_total",
+                     # lazy on-demand snapshot folds (storage/csr_build
+                     # LazyPreds/_FoldThunk; ISSUE 15): per-trigger fold
+                     # counters plus the cold-open / first-query gauges
+                     # the scale runbook reads
+                     "dgraph_fold_lazy_total",
+                     "dgraph_fold_eager_total",
+                     "dgraph_fold_prefetch_total",
+                     "dgraph_fold_inline_total",
+                     "dgraph_fold_pending_tablets",
+                     "dgraph_cold_open_ms",
+                     "dgraph_first_query_ms"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
@@ -397,6 +408,9 @@ class Registry:
                      "dgraph_query_cost_device_ms",
                      "dgraph_query_cost_edges",
                      "dgraph_query_cost_bytes",
+                     # per-tablet fold wall time (lazy/eager/prefetch/
+                     # inline triggers alike; ISSUE 15)
+                     "dgraph_fold_ms",
                      # per-endpoint HTTP latency (api/http.py observes
                      # these; pre-registered so a fresh node scrapes 0s)
                      "dgraph_http_query_latency_s",

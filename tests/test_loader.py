@@ -274,6 +274,7 @@ def test_ldbc_convert_roundtrip(tmp_path):
     out = tmp_path / "snb.rdf.gz"
     stats = convert_ldbc(fixture, str(out))
     assert stats.persons == 3 and stats.knows == 2 and stats.posts == 2
+    assert stats.comments == 3 and stats.reply_of == 3
     # persons: id + 5 value cols = 18; knows: 2; posts: 343 has id +
     # imageFile + creationDate + length(0 -> "0" kept? length "0" is
     # falsy-string "0"? no: "0" is truthy) = 4... count explicitly below
@@ -291,13 +292,33 @@ def test_ldbc_convert_roundtrip(tmp_path):
     assert q["lastName"] == "Perera"
     assert sorted(k["firstName"] for k in q["knows"]) == \
         ["Carmen", "Hồ Chí"]
-    assert q["~hasCreator"] == [{"length": 0}]
+    # post 343 (length 0) + comment 1013 (length 13) both credit Mahinda
+    assert sorted(x["length"] for x in q["~hasCreator"]) == [0, 13]
     # unicode content survives the round trip
     res, _ = node.query('{ q(func: eq(post.id, 618)) { content language '
                         '  hasCreator { firstName } } }')
     assert res["q"][0]["language"] == "uz"
     assert "Hồ Chí Minh" in res["q"][0]["content"]
     assert res["q"][0]["hasCreator"] == [{"firstName": "Carmen"}]
+    # comment entities (ISSUE 15): a depth-3 replyOf chain resolves
+    # comment -> comment -> comment -> post, and hasCreator hangs off
+    # every hop (the fan-out shape the 3-hop battery exercises)
+    res, _ = node.query('{ q(func: eq(comment.id, 1014)) { '
+                        '  replyOf { comment.id replyOf { comment.id '
+                        '    replyOf { post.id hasCreator '
+                        '      { firstName } } } } } }')
+    hop1 = res["q"][0]["replyOf"][0]
+    assert hop1["comment.id"] == 1013
+    hop2 = hop1["replyOf"][0]
+    assert hop2["comment.id"] == 1012
+    hop3 = hop2["replyOf"][0]
+    assert hop3["post.id"] == 618
+    assert hop3["hasCreator"] == [{"firstName": "Carmen"}]
+    # unicode comment content + reverse replyOf (who replied to 1012?)
+    res, _ = node.query('{ q(func: eq(comment.id, 1013)) { content '
+                        '  ~replyOf { comment.id } } }')
+    assert "không hẳn vậy" in res["q"][0]["content"]
+    assert res["q"][0]["~replyOf"] == [{"comment.id": 1014}]
     node.close()
 
 

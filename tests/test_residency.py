@@ -441,7 +441,8 @@ def test_vector_heavy_snapshot_triggers_eviction():
         v = ", ".join(f"{x:.3f}" for x in rng.normal(size=64))
         quads.append(f'<{i:#x}> <emb> "[{v}]" .')
     node.mutate(set_nquads="\n".join(quads), commit_now=True)
-    node.snapshot()                            # fold the vector matrix
+    # fold the vector matrix (lazy snapshots fold on first READ)
+    node.snapshot().pred("emb")
     vec_bytes = 399 * 64 * 4
     report = node.enforce_memory(
         budget_bytes=node.store.memory_stats()["bytes"] + vec_bytes // 4)

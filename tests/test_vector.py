@@ -198,8 +198,9 @@ def test_overlay_stamp_and_compaction_byte_equivalence():
     from dgraph_tpu.storage.csr_build import build_pred
 
     node, rng = _mk_node(dim=8, n=50, metric="cosine", seed=9)
-    node.snapshot()        # warm the per-predicate fold cache: the next
-    #                        commit must STAMP that base, not re-fold
+    node.snapshot().pred("emb")   # warm the per-predicate fold cache (lazy
+    #                               folds build on first read): the next
+    #                               commit must STAMP that base, not re-fold
     stamps0 = node.metrics.counter("dgraph_overlay_stamps_total").value
     nv = rng.normal(size=8)
     node.mutate(set_nquads=f'<0x999> <emb> "{_vec_str(nv)}" .',
