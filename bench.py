@@ -1593,6 +1593,270 @@ def bench_batch(n_subjects=4000, follows=6, pool=128, reps=3,
     return out
 
 
+WRITE_ARTIFACT = "WRITE_r16.json"
+
+
+def bench_write(n_txns=384, reps=3, concurrencies=(1, 16, 64),
+                live_files=8, live_quads=300, visible_commits=100,
+                sync_ms=8.0):
+    """ISSUE 16 group-commit battery, on a REAL journal (every commit
+    fsyncs a wal.log on disk — the cost the window amortizes):
+
+      * commits_per_s — n_txns pre-staged txns committed by c concurrent
+        workers (c = 1/16/64), window on vs off. Raw loopback-fs numbers
+        first (context: this image's 9p fsync is ~0.2ms, unrepresentative
+        of durable disks), then the HEADLINE sweep with a `disk.fsync`
+        delay fault emulating a sync_ms-class durable disk (8ms default:
+        HDD / cloud block storage) — the bench_batch emulated-sync
+        precedent, applied to the write path. Gate: c=64 on/off >= 10x
+        under emulated sync.
+      * commit_visible_ms — sequential mutate+commit_now then a probe
+        query that must see the write, measured RAW (no emulated sync:
+        both paths pay exactly one real fsync, so raw isolates the
+        window's bookkeeping overhead); p50 gated within 10% of the
+        per-commit path (idle-fire must not tax unloaded writers).
+      * byte identity — the SAME deterministic write program through the
+        window and through the solo path: live reads, WAL-replayed reads
+        (reopen), and a from-scratch build_snapshot fold digest must all
+        agree across modes.
+      * live_load — satellite 1: concurrent live-loader streams sharing
+        one node's commit window, quads/s on vs off (emulated sync).
+    """
+    import hashlib
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.storage.csr_build import build_snapshot
+    from dgraph_tpu.utils import faults
+
+    schema_txt = ("name: string @index(exact) .\n"
+                  "v: int @index(int) .")
+    battery = [
+        '{ q(func: has(v)) { count(uid) } }',
+        '{ q(func: ge(v, 0), first: 12, orderasc: v) { v } }',
+        '{ q(func: uid(0x1)) { name } }',
+        '{ q(func: has(name)) { count(uid) } }',
+    ]
+
+    def fold_digest(store):
+        """Deterministic per-predicate digest of a from-scratch eager
+        fold (host mirrors + values) at the store's max commit ts."""
+        snap = build_snapshot(store, store.max_seen_commit_ts)
+        dig = {}
+        for attr in sorted(snap.preds):
+            pd = snap.preds[attr]
+            h = hashlib.sha256()
+            for arr in (pd.value_subjects_host, pd.num_values_host):
+                if arr is not None:
+                    h.update(np.ascontiguousarray(arr).tobytes())
+            for u in sorted(pd.host_values):
+                h.update(f"{u}:{pd.host_values[u].value!r}".encode())
+            dig[attr] = h.hexdigest()[:16]
+        return dig
+
+    def run_mode(write_batch):
+        d = tempfile.mkdtemp(prefix="dgwrite_")
+        node = Node(dirpath=d, write_batch=write_batch)
+        node.alter(schema_text=schema_txt)
+        res = {}
+        uidp = [0x100]      # same deterministic uid program in both modes
+
+        def commit_throughput(c):
+            per = max(n_txns // c, 1)
+            samples = []
+            for _rep in range(reps):
+                starts = []
+                for _ in range(c * per):        # stage OUTSIDE the clock
+                    u = uidp[0]
+                    uidp[0] += 1
+                    r = node.mutate(
+                        set_nquads=f'<0x{u:x}> <v> "{u}"^^<xs:int> .')
+                    starts.append(r.context.start_ts)
+                errs = []
+
+                def worker(w):
+                    for st in starts[w * per:(w + 1) * per]:
+                        try:
+                            node.commit(st)
+                        except BaseException as e:   # noqa: BLE001
+                            errs.append(e)
+
+                ths = [threading.Thread(target=worker, args=(w,))
+                       for w in range(c)]
+                t0 = time.perf_counter()
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                dt = time.perf_counter() - t0
+                assert not errs, errs[:1]
+                samples.append(c * per / dt)
+            return _band(samples)
+
+        res["commits_per_s_raw"] = {
+            f"c{c}": commit_throughput(c) for c in concurrencies}
+        faults.GLOBAL.install("disk.fsync", "delay", p=1.0,
+                              delay_s=sync_ms / 1000.0)
+        try:
+            res["commits_per_s"] = {
+                f"c{c}": commit_throughput(c) for c in concurrencies}
+        finally:
+            faults.GLOBAL.clear("disk.fsync")
+        reads = [json.dumps(node.query(q)[0], sort_keys=True)
+                 for q in battery]
+        if write_batch:
+            c = lambda nm: node.metrics.counter(nm).value
+            occ = node.metrics.histogram(
+                "dgraph_write_batch_occupancy").snapshot()
+            res["group_commit"] = {
+                "windows": c("dgraph_write_batch_formed_total"),
+                "commits": c("dgraph_write_batch_commits_total"),
+                "fsyncs": c("dgraph_write_batch_fsyncs_total"),
+                "fsync_amortization": round(
+                    c("dgraph_write_batch_commits_total") /
+                    max(c("dgraph_write_batch_fsyncs_total"), 1), 2),
+                "occupancy_mean": occ.get("mean", 0.0),
+                "occupancy_max": occ.get("max", 0),
+                "window_waits": c("dgraph_write_batch_window_waits_total"),
+                "deadline_bypass": c(
+                    "dgraph_write_batch_deadline_bypass_total"),
+                "conflict_aborts": c(
+                    "dgraph_write_batch_conflict_aborts_total"),
+            }
+        node.close()
+        # durability: reopen from the journal (acked windows must replay)
+        n2 = Node(dirpath=d)
+        replayed = [json.dumps(n2.query(q)[0], sort_keys=True)
+                    for q in battery]
+        digest = fold_digest(n2.store)
+        n2.close()
+        shutil.rmtree(d, ignore_errors=True)
+        return res, reads, replayed, digest
+
+    def live_qps(write_batch):
+        """Satellite 1: concurrent live-load streams into one node — the
+        loader's commit_now batches share the node's commit window."""
+        from dgraph_tpu.loader.live import live_load
+
+        tmpd = tempfile.mkdtemp(prefix="dgwrite_rdf_")
+        d = tempfile.mkdtemp(prefix="dgwrite_live_")
+        paths = []
+        for w in range(live_files):
+            p = os.path.join(tmpd, f"l{w}.rdf")
+            with open(p, "w") as f:
+                for i in range(live_quads):
+                    f.write(f'_:w{w}n{i} <name> "L{w}_{i}" .\n')
+            paths.append(p)
+        # ops.md tuning runbook: for throughput ingest raise the window
+        # toward the fsync cost — sized here to the emulated sync_ms
+        node = Node(dirpath=d, write_batch=write_batch,
+                    write_window_ms=sync_ms)
+        node.alter(schema_text=schema_txt)
+        errs = []
+
+        def load(p):
+            try:
+                # small batches on purpose: the commit path (not RDF
+                # parsing) must be the measured signal. Parsing is
+                # GIL-serialized across streams, so commit arrivals are
+                # staggered and window occupancy stays low (~1.6); the
+                # speedup here is the fsync share the window claws back,
+                # not the c=64 amortization ceiling.
+                live_load(node, p, batch=5)
+            except BaseException as e:           # noqa: BLE001
+                errs.append(e)
+
+        ths = [threading.Thread(target=load, args=(p,)) for p in paths]
+        faults.GLOBAL.install("disk.fsync", "delay", p=1.0,
+                              delay_s=sync_ms / 1000.0)
+        t0 = time.perf_counter()
+        try:
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            dt = time.perf_counter() - t0
+        finally:
+            faults.GLOBAL.clear("disk.fsync")
+        assert not errs, errs[:1]
+        out_q, _ = node.query('{ q(func: has(name)) { count(uid) } }')
+        assert out_q["q"][0]["count"] == live_files * live_quads
+        node.close()
+        shutil.rmtree(tmpd, ignore_errors=True)
+        shutil.rmtree(d, ignore_errors=True)
+        return round(live_files * live_quads / dt, 1)
+
+    def visible_pair():
+        """Commit-to-visible latency, raw fsync (no emulated sync: both
+        paths pay exactly one real fsync, so this isolates the window's
+        per-commit bookkeeping). Samples INTERLEAVE across two live
+        nodes (window on / off) so scheduler and background-fold jitter
+        lands on both medians equally — back-to-back whole-mode runs
+        drift +-15% on this box, swamping the 10% gate."""
+        nodes = {}
+        for mode in (True, False):
+            d = tempfile.mkdtemp(prefix="dgwrite_vis_")
+            n = Node(dirpath=d, write_batch=mode)
+            n.alter(schema_text=schema_txt)
+            n.query('{ q(func: uid(0x1)) { name } }')    # warm the path
+            nodes[mode] = (n, d)
+        vis = {True: [], False: []}
+        for i in range(visible_commits):
+            for mode in (True, False):
+                n = nodes[mode][0]
+                t0 = time.perf_counter()
+                n.mutate(set_nquads=f'<0x1> <name> "s{i}" .',
+                         commit_now=True)
+                out_q, _ = n.query('{ q(func: uid(0x1)) { name } }')
+                dt = (time.perf_counter() - t0) * 1e3
+                assert out_q["q"][0]["name"] == f"s{i}", \
+                    "commit not visible"
+                vis[mode].append(dt)
+        for n, d in nodes.values():
+            n.close()
+            shutil.rmtree(d, ignore_errors=True)
+        return _band(vis[True]), _band(vis[False])
+
+    vis_on, vis_off = visible_pair()
+    res_on, reads_on, replay_on, dig_on = run_mode(True)
+    res_off, reads_off, replay_off, dig_off = run_mode(False)
+    res_on["commit_visible_ms"] = vis_on
+    res_off["commit_visible_ms"] = vis_off
+    out = {"on": res_on, "off": res_off}
+    out["identical"] = bool(
+        reads_on == reads_off == replay_on == replay_off
+        and dig_on == dig_off)
+    out["live_load_quads_per_s"] = {"on": live_qps(True),
+                                    "off": live_qps(False)}
+    top = f"c{concurrencies[-1]}"
+    out[f"speedup_{top}"] = round(
+        res_on["commits_per_s"][top]["median"] /
+        max(res_off["commits_per_s"][top]["median"], 1e-9), 2)
+    out["speedup_c1"] = round(
+        res_on["commits_per_s"]["c1"]["median"] /
+        max(res_off["commits_per_s"]["c1"]["median"], 1e-9), 2)
+    out["visible_p50_ratio"] = round(
+        res_on["commit_visible_ms"]["median"] /
+        max(res_off["commit_visible_ms"]["median"], 1e-9), 3)
+    out["live_load_speedup"] = round(
+        out["live_load_quads_per_s"]["on"] /
+        max(out["live_load_quads_per_s"]["off"], 1e-9), 2)
+    out["ok"] = bool(out["identical"]
+                     and out[f"speedup_{top}"] >= 10.0
+                     and out["visible_p50_ratio"] <= 1.10)
+    # the trajectory artifact records the full-scale battery only: reduced
+    # runs (smoke_write.sh) must not clobber it with smoke-scale numbers
+    if (n_txns, concurrencies[-1]) == (384, 64):
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               WRITE_ARTIFACT), "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return out
+
+
 RESIDENCY_ARTIFACT = "RESIDENCY_r11.json"
 
 
@@ -1977,6 +2241,10 @@ def main():
     except Exception as e:  # batched-dispatch battery must not sink it
         batch = {"error": f"{type(e).__name__}: {e}"}
     try:
+        write = bench_write()
+    except Exception as e:  # group-commit battery must not sink it either
+        write = {"error": f"{type(e).__name__}: {e}"}
+    try:
         skew = bench_skew()
     except Exception as e:  # placement battery must not sink it either
         skew = {"error": f"{type(e).__name__}: {e}"}
@@ -2011,6 +2279,7 @@ def main():
         "chaos": chaos,
         "vector": vector,
         "batch": batch,
+        "write": write,
         "skew": skew,
         "residency": residency,
         "obs": obs,

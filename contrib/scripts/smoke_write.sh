@@ -1,0 +1,139 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a short group-commit write-path check (ISSUE 16).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 runs the bench.py bench_write battery at reduced scale and asserts
+#   * byte identity — live reads, WAL-replayed reads, and the from-scratch
+#     build_snapshot fold digest agree between the commit window and the
+#     --no_write_batch per-commit path,
+#   * windows actually form (fsync amortization > 1 under emulated sync),
+#   * window-on beats window-off on the emulated-durable-disk sweep,
+#   * commit-to-visible p50 stays near the per-commit path (idle-fire),
+# then replays a concurrent commit program against a windowed Node vs a
+# --no_write_batch Node end-to-end (flags surface), reopens the windowed
+# journal (gc-record replay), and checks the dgraph_write_batch_* series in
+# the /debug/metrics "writes" section. Runs entirely on the XLA host
+# platform — no TPU needed.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-700}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== group-commit write-path smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from bench import bench_write
+
+# reduced scale: does not clobber the full-scale WRITE_r16.json artifact
+r = bench_write(n_txns=64, reps=2, concurrencies=(1, 16),
+                live_files=4, live_quads=120, visible_commits=30)
+gc = r["on"]["group_commit"]
+print(f"  windows {gc['windows']} commits {gc['commits']} "
+      f"fsyncs {gc['fsyncs']} (amortization {gc['fsync_amortization']}x, "
+      f"occupancy max {gc['occupancy_max']}); "
+      f"on c16 {r['on']['commits_per_s']['c16']['median']}/s vs "
+      f"off c16 {r['off']['commits_per_s']['c16']['median']}/s "
+      f"({r['speedup_c16']}x); visible p50 ratio "
+      f"{r['visible_p50_ratio']}; live {r['live_load_speedup']}x")
+assert r["identical"], \
+    "windowed reads/replay/fold diverged from --no_write_batch"
+assert gc["fsync_amortization"] > 1, \
+    f"no windows formed: {gc}"
+assert r["speedup_c16"] >= 2.5, \
+    f"window did not beat per-commit path: {r['speedup_c16']}x"
+assert r["visible_p50_ratio"] <= 1.25, \
+    f"idle-fire taxed unloaded commit-to-visible: {r['visible_p50_ratio']}"
+
+# -- flags end-to-end: windowed Node vs --no_write_batch Node ------------
+import shutil
+import tempfile
+import threading
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.utils import faults
+
+SCHEMA = "name: string @index(exact) ."
+N = 16
+
+
+def program(node):
+    """Stage N disjoint commits, then commit them concurrently."""
+    starts = []
+    for i in range(1, N + 1):
+        r = node.mutate(set_nquads=f'<0x{i:x}> <name> "w{i}" .')
+        starts.append(r.context.start_ts)
+    barrier = threading.Barrier(N)
+    errs = []
+
+    def commit(st):
+        barrier.wait(timeout=30)
+        try:
+            node.commit(st)
+        except BaseException as e:       # noqa: BLE001
+            errs.append(e)
+
+    ths = [threading.Thread(target=commit, args=(st,)) for st in starts]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(60)
+    assert not errs, errs[:1]
+    out, _ = node.query('{ q(func: has(name), orderasc: name) { name } }')
+    return out
+
+
+d_off = tempfile.mkdtemp(prefix="smoke_write_off_")
+plain = Node(dirpath=d_off, write_batch=False)
+assert plain.write_batcher is None
+plain.alter(schema_text=SCHEMA)
+want = program(plain)
+plain.close()
+
+d_on = tempfile.mkdtemp(prefix="smoke_write_on_")
+node = Node(dirpath=d_on, write_window_ms=50, write_batch_max=8)
+assert node.write_batcher is not None
+node.alter(schema_text=SCHEMA)
+# emulate a durable-disk fsync so the concurrent commits pile into windows
+faults.GLOBAL.install("disk.fsync", "delay", p=1.0, delay_s=0.005)
+try:
+    got = program(node)
+finally:
+    faults.GLOBAL.clear("disk.fsync")
+assert got == want, "windowed Node diverged from --no_write_batch Node"
+
+from dgraph_tpu.api.http import _serving_metrics
+
+m = _serving_metrics(node)["writes"]
+assert m["enabled"] and m["formed"] >= 1 and m["commits"] >= N, m
+assert m["occupancy"]["max"] > 1, m
+node.close()
+
+# gc-record durability: reopen the windowed journal and re-read
+n2 = Node(dirpath=d_on)
+out, _ = n2.query('{ q(func: has(name), orderasc: name) { name } }')
+assert out == want, "windowed WAL replay diverged"
+n2.close()
+shutil.rmtree(d_off, ignore_errors=True)
+shutil.rmtree(d_on, ignore_errors=True)
+print(f"  flags e2e: {N} concurrent commits byte-identical, "
+      f"{m['formed']} windows ({m['fsync_amortization']}x amortization) "
+      f"on /debug/metrics, journal replays after reopen")
+print("OK: byte-identity gate, amortization gate, on-vs-off gate, "
+      "visible-latency gate, flags e2e")
+PY
+echo "== smoke passed =="

@@ -32,6 +32,9 @@ def cmd_serve(args) -> int:
                 batching=not args.no_batch,
                 batch_window_ms=args.batch_window_ms,
                 batch_max=args.batch_max,
+                write_batch=not args.no_write_batch,
+                write_window_ms=args.write_window_ms,
+                write_batch_max=args.write_batch_max,
                 overlay=not args.no_overlay,
                 overlay_max_keys=args.overlay_max_keys,
                 overlay_max_age_s=args.overlay_max_age_s,
@@ -448,6 +451,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--no_batch", action="store_true",
                     help="disable batched multi-query device execution "
                          "(exact per-task dispatch)")
+    sp.add_argument("--write_window_ms", type=float, default=2.0,
+                    help="group-commit collect window in ms; a window "
+                         "fires immediately when the journal is idle")
+    sp.add_argument("--write_batch_max", type=int, default=64,
+                    help="max txns committed per group-commit window "
+                         "(one WAL append + one fsync per window)")
+    sp.add_argument("--no_write_batch", action="store_true",
+                    help="disable group-commit write batching (exact "
+                         "per-commit WAL append + fsync)")
     sp.add_argument("--dispatch_width", type=int, default=4,
                     help="max simultaneous device dispatches")
     sp.add_argument("--no_overlay", action="store_true",

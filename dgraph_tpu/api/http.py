@@ -101,6 +101,9 @@ async function show(r, t0){
     const ba = m.batching || {};
     if (ba.formed) lat += ' · batch ' +
         (ba.occupancy.mean||0).toFixed(1) + 'x/' + ba.formed;
+    const wr = m.writes || {};
+    if (wr.commits) lat += ' · gc ' + wr.commits + 'c/' +
+        wr.fsyncs + 'f (' + (wr.fsync_amortization||1).toFixed(1) + 'x)';
     const tl = Object.entries(m.tablet_load || {})
         .sort((a,b)=>(b[1].r||0)-(a[1].r||0))[0];
     if (tl) lat += ' · hot ' + tl[0] + ' (' + (tl[1].r||0) + 'r/' +
@@ -216,6 +219,31 @@ def _serving_metrics(node: Node) -> dict:
             "window_waits": c("dgraph_batch_window_waits_total"),
             "deadline_bypass": c("dgraph_batch_deadline_bypass_total"),
             "incompatible": m.keyed("dgraph_batch_incompatible").snapshot(),
+        },
+        # group-commit write window (ISSUE 16, storage/writebatch.py):
+        # formed windows, member commits vs fsyncs (the amortization
+        # ratio), occupancy distribution, window waits, deadline
+        # bypasses, and intra-window conflict aborts
+        "writes": {
+            "enabled": node.write_batcher is not None,
+            "window_ms": (node.write_batcher.window_s * 1000.0
+                          if node.write_batcher is not None else 0.0),
+            "max_batch": (node.write_batcher.max_batch
+                          if node.write_batcher is not None else 0),
+            "formed": c("dgraph_write_batch_formed_total"),
+            "commits": c("dgraph_write_batch_commits_total"),
+            "fsyncs": c("dgraph_write_batch_fsyncs_total"),
+            "fsync_amortization": round(
+                c("dgraph_write_batch_commits_total") /
+                c("dgraph_write_batch_fsyncs_total"), 2)
+            if c("dgraph_write_batch_fsyncs_total") else None,
+            "occupancy":
+                m.histogram("dgraph_write_batch_occupancy").snapshot(),
+            "window_waits": c("dgraph_write_batch_window_waits_total"),
+            "deadline_bypass":
+                c("dgraph_write_batch_deadline_bypass_total"),
+            "conflict_aborts":
+                c("dgraph_write_batch_conflict_aborts_total"),
         },
         # delta-overlay maintenance tier: O(Δ) commit-to-visible stamping,
         # background compaction, parallel cold folds, and the task/result

@@ -195,6 +195,53 @@ class Oracle:
                 self._purge_below_locked()
             return commit_ts
 
+    def commit_batch(self, start_ts_list: list[int]) -> list:
+        """One commit window's decisions under ONE lock hold (the group-
+        commit conflict pass, ISSUE 16): per member, exactly commit()'s
+        logic — conflict check against _key_commit, commit_ts assignment,
+        key/pred watermark updates. Returns a per-member list of either the
+        assigned commit_ts (int) or the exception INSTANCE (TxnConflict /
+        TxnNotFound) that member's solo commit() would have raised; the
+        caller demuxes. Intra-window conflicts resolve first-committer-wins
+        naturally: an earlier member's _key_commit update aborts a later
+        member of the same window that shares a key."""
+        out: list = []
+        with self._lock:
+            d0 = self._decisions
+            for start_ts in start_ts_list:
+                st = self._pending.get(start_ts)
+                if st is None:
+                    if start_ts in self._aborted:
+                        out.append(TxnConflict(
+                            f"txn {start_ts} already aborted"))
+                    else:
+                        out.append(TxnNotFound(f"unknown txn {start_ts}"))
+                    continue
+                if self._has_conflict(st):
+                    del self._pending[start_ts]
+                    self._aborted.add(start_ts)
+                    self._decisions += 1
+                    out.append(TxnConflict(
+                        f"txn {start_ts} conflicts on a key committed "
+                        f"after it"))
+                    continue
+                commit_ts = self._next_ts
+                self._next_ts += 1
+                self.max_assigned = self._next_ts - 1
+                self._bump_ceiling_locked()
+                for fp in st.keys:
+                    if commit_ts > self._key_commit.get(fp, 0):
+                        self._key_commit[fp] = commit_ts
+                for pred in st.preds:
+                    if commit_ts > self.pred_commit.get(pred, 0):
+                        self.pred_commit[pred] = commit_ts
+                del self._pending[start_ts]
+                self._decisions += 1
+                out.append(commit_ts)
+            if self._decisions // self.PURGE_EVERY > d0 // self.PURGE_EVERY:
+                self._purge_below_locked()
+        return out
+
     def abort(self, start_ts: int) -> None:
         with self._lock:
             self._pending.pop(start_ts, None)

@@ -17,6 +17,7 @@ from dgraph_tpu.coord.zero import TxnConflict
 from dgraph_tpu.loader.bulk import iter_quads
 from dgraph_tpu.loader.xidmap import XidMap
 from dgraph_tpu.query.rdf import NQuad
+from dgraph_tpu.utils.retry import RetryPolicy
 
 
 @dataclass
@@ -58,19 +59,31 @@ def live_load(node, rdf_paths: str | list[str], *, batch: int = 1000,
     stats0 = (xm.stats.lookups, xm.stats.shard_loads, xm.stats.evictions)
     pending: list = []
 
+    # aborted-txn retries ride the unified policy (utils/retry): full-
+    # jitter exponential backoff instead of the old immediate hot loop,
+    # deadline-aware (never sleeps past an active budget, never retries
+    # DeadlineExceeded/CommitAmbiguous), and the attempts show up on the
+    # node's dgraph_retry_total
+    policy = RetryPolicy(max_attempts=retries + 1, name="live_load",
+                         metrics=getattr(node, "metrics", None))
+
     def flush():
         if not pending:
             return
         xm.sync()   # identities durable before the txn that uses them
-        for attempt in range(retries + 1):
+
+        def attempt():
             try:
+                # commit_now routes each batch through the node's group-
+                # commit window (storage/writebatch.py): concurrent
+                # loader workers share fsyncs and conflict passes
                 node.mutate_quads(pending, commit_now=True)
-                stats.txns += 1
-                break
             except TxnConflict:
                 stats.aborts += 1
-                if attempt == retries:
-                    raise
+                raise
+
+        policy.run(attempt, retryable=(TxnConflict,))
+        stats.txns += 1
         pending.clear()
 
     for subj, pred, obj, val, lang, facets, star in iter_quads(paths, workers):

@@ -91,11 +91,17 @@ def posting_from_json(d: dict) -> Posting:
 # bytes — the tag byte is the format version, like the snapshot header
 # (DGTS1/DGTS2/DGTS3 below; the writer emits DGTS3, all three still load).
 
-_REC_M, _REC_C, _REC_A = 0x01, 0x02, 0x03
+_REC_M, _REC_C, _REC_A, _REC_GC = 0x01, 0x02, 0x03, 0x04
 _Q = struct.Struct("<q")
 _HDR_M = struct.Struct("<q I")        # start_ts, key len
 _HDR_C = struct.Struct("<q q I")      # start_ts, commit_ts, n keys
 _HDR_A = struct.Struct("<q I")        # start_ts, n keys
+# group commit (ISSUE 16): one record = one window's commit decisions,
+# appended and fsynced as ONE WAL write. Layout: tag, u32 member count,
+# then per member exactly the _REC_C payload (_HDR_C + length-prefixed
+# keys). Replays identically to N _REC_C records; pre-16 WALs (per-commit
+# records) still load — tags discriminate.
+_HDR_GC = struct.Struct("<I")         # n member commits
 
 
 @dataclasses.dataclass
@@ -283,6 +289,15 @@ def encode_record(rec: dict) -> bytes:
             out.append(struct.pack("<I", len(kb)))
             out.append(kb)
         return b"".join(out)
+    if t == "gc":
+        out = [bytes([_REC_GC]), _HDR_GC.pack(len(rec["txns"]))]
+        for sub in rec["txns"]:
+            keys = [_key_bytes(k) for k in sub["k"]]
+            out.append(_HDR_C.pack(sub["s"], sub["ts"], len(keys)))
+            for kb in keys:
+                out.append(struct.pack("<I", len(kb)))
+                out.append(kb)
+        return b"".join(out)
     return json.dumps(rec, separators=(",", ":")).encode("utf-8")
 
 
@@ -320,6 +335,23 @@ def decode_record(raw: bytes) -> dict:
             facets = tuple(fs)
         return {"t": "m", "s": s, "k": kb,
                 "p": Posting(uid, Op(op), value, lang or "", facets)}
+    if tag == _REC_GC:
+        (cnt,) = _HDR_GC.unpack_from(raw, off)
+        off += _HDR_GC.size
+        txns = []
+        for _ in range(cnt):
+            s, ts, n = _HDR_C.unpack_from(raw, off)
+            off += _HDR_C.size
+            keys = []
+            for _ in range(n):
+                (klen,) = struct.unpack_from("<I", raw, off)
+                off += 4
+                keys.append(raw[off: off + klen])
+                off += klen
+            # members are plain "c" records: replay/replication apply them
+            # through the exact single-commit branch
+            txns.append({"t": "c", "s": s, "k": keys, "ts": ts})
+        return {"t": "gc", "txns": txns}
     if tag == _REC_C:
         s, ts, n = _HDR_C.unpack_from(raw, off)
         off += _HDR_C.size
@@ -640,6 +672,30 @@ class Store:
                 self._bump_pred_ts(kb, commit_ts)
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, commit_ts)
 
+    def commit_group(self, members: list[tuple[int, int, list[bytes]]]) -> None:
+        """One commit window's durability + visibility (ISSUE 16 group
+        commit): members is [(start_ts, commit_ts, key_bytes), ...] already
+        decided conflict-free by the oracle. The whole window appends as
+        ONE contiguous WAL record with ONE fsync (and one wal_sink ship),
+        then every member's in-memory apply — pl.commit + _bump_pred_ts
+        watermark/journal advance — runs under ONE store-lock hold, so the
+        delta journal accumulates the window's UNION delta per predicate
+        and the next read stamps each touched predicate once. A crash
+        mid-append leaves a torn tail replay drops whole: the window is
+        all-or-nothing in the log, never torn across members."""
+        self._wal_write(
+            {"t": "gc", "txns": [{"s": s, "ts": ts, "k": list(kbs)}
+                                 for s, ts, kbs in members]}, sync=True)
+        with self._lock:
+            for start_ts, commit_ts, key_bytes in members:
+                for kb in key_bytes:
+                    pl = self.lists.get(kb)
+                    if pl is not None:
+                        pl.commit(start_ts, commit_ts)
+                    self._bump_pred_ts(kb, commit_ts)
+                self.max_seen_commit_ts = max(self.max_seen_commit_ts,
+                                              commit_ts)
+
     MAX_DELTA_KEYS = 8192     # per-attr journal bound (bulk loads overflow
     # it on purpose: their next fold re-bases incremental stamping)
 
@@ -866,6 +922,13 @@ class Store:
                 self._wal.write(_U32.pack(len(data)) + data)
                 self.wal_record_count += 1
                 if sync:
+                    # the durability seam itself (sync writes only): a
+                    # delay fault here emulates the fsync cost class of
+                    # durable disks (bench_write's sync sweep) — it
+                    # sleeps under the lock exactly as a real fsync
+                    # serializes writers
+                    faults.fire("disk.fsync",
+                                m=getattr(self, "metrics", None))
                     self._wal.flush()
                     os.fsync(self._wal.fileno())
 
@@ -956,6 +1019,14 @@ class Store:
                 else:
                     pl.commit(rec["s"], rec["ts"])
             self.max_seen_commit_ts = max(self.max_seen_commit_ts, rec["ts"])
+        elif t == "gc":
+            # a group record IS its member commits: each applies through
+            # the exact "c" branch above (including the ts <= snapshot_ts
+            # already-folded abort rule, per member)
+            for sub in rec["txns"]:
+                self._apply_record_locked(
+                    {"t": "c", "s": sub["s"], "k": sub["k"],
+                     "ts": sub["ts"]})
         elif t == "a":
             for kraw in rec["k"]:
                 pl = self.lists.get(_key_bytes(kraw))

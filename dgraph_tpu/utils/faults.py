@@ -52,6 +52,9 @@ POINTS = (
     "zero.rpc",             # RPC send seam: any ZeroClient call
     "rpc.send",             # RPC send seam: RemoteWorker.process_task
     "disk.wal_write",       # store WAL append/commit records
+    "disk.fsync",           # the sync-write durability seam only (the
+    # fsync a commit pays); a delay here emulates durable-disk sync cost
+    # (bench_write's sync sweep — loopback-fs fsync is unrepresentative)
     "disk.spill",           # out-of-core ingest spill-run writes
     "device.dispatch",      # device-dispatch gate critical section
     "device.step",          # inside a held gate slot: slow device program

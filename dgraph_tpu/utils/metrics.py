@@ -340,6 +340,16 @@ class Registry:
                      "dgraph_batch_tasks_total",
                      "dgraph_batch_window_waits_total",
                      "dgraph_batch_deadline_bypass_total",
+                     # group-commit write window (storage/writebatch.py;
+                     # ISSUE 16) — created by the WriteBatcher too, but a
+                     # node with write batching OFF must still expose
+                     # them at 0 (the same pre-registration invariant)
+                     "dgraph_write_batch_formed_total",
+                     "dgraph_write_batch_commits_total",
+                     "dgraph_write_batch_fsyncs_total",
+                     "dgraph_write_batch_window_waits_total",
+                     "dgraph_write_batch_deadline_bypass_total",
+                     "dgraph_write_batch_conflict_aborts_total",
                      # mesh deployment mode (parallel/mesh_exec.py;
                      # ISSUES 6 + 12)
                      "dgraph_mesh_dispatches_total",
@@ -402,6 +412,7 @@ class Registry:
                      "dgraph_commit_latency_s", "dgraph_compaction_s",
                      "dgraph_planner_est_error_log2",
                      "dgraph_batch_occupancy",
+                     "dgraph_write_batch_occupancy",
                      # per-request cost distributions off the ledger
                      # (obs/costs.py): aggregatable le-bucket histograms
                      # with trace exemplars, NOT ring quantiles

@@ -609,3 +609,116 @@ def test_eviction_storm_under_write_mix():
         faults.GLOBAL.clear()
         clean.close()
         node.close()
+
+
+def test_group_commit_chaos_wal_fault_and_kill_mid_window(tmp_path):
+    """ISSUE 16 chaos schedule: concurrent committers through FORCED
+    commit windows under a seeded disk.wal_write fault, then a hard kill
+    (the journal as it sits on disk, no clean close) and replay, then a
+    torn group-record tail. Contract: every acked commit is durably
+    visible after replay; every failed commit is typed (TxnConflict /
+    CommitAmbiguous / fault transport error); a multi-key txn is NEVER
+    torn — both its predicates replay or neither — and a torn gc tail
+    drops whole. Lockdep is armed for the run (autouse fixture)."""
+    import shutil
+
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.storage.writebatch import WriteBatcher
+    from dgraph_tpu.utils.faults import FaultError
+
+    d = tmp_path / "primary"
+    d.mkdir()
+    node = Node(dirpath=str(d))
+    node.alter(schema_text="name: string @index(exact) .\n"
+                           "age: int @index(int) .")
+    # never idle-fire: every commit joins a real multi-member window
+    node.write_batcher = WriteBatcher(
+        node.zero.oracle, node.store, node.metrics,
+        window_ms=50.0, max_batch=8, idle_fire=False)
+
+    faults.GLOBAL.reseed(1616)
+    faults.GLOBAL.install("disk.wal_write", "error", p=0.3)
+    acked: dict[int, int] = {}        # subject uid -> commit_ts
+    failures: list[BaseException] = []
+    lock = threading.Lock()
+
+    def commit_one(uid):
+        # one txn, TWO predicates: the torn-write probe — after replay
+        # the subject has BOTH name and age or NEITHER
+        try:
+            r = node.mutate(set_nquads=(
+                f'<0x{uid:x}> <name> "p{uid}" .\n'
+                f'<0x{uid:x}> <age> "{uid}"^^<xs:int> .'))
+            ts = node.commit(r.context.start_ts)
+            with lock:
+                acked[uid] = ts
+        except (CommitAmbiguous, FaultError, ConnectionError, OSError) as e:
+            with lock:
+                failures.append(e)
+        except TYPED_ERRORS as e:
+            with lock:
+                failures.append(e)
+
+    uid = 0
+    try:
+        for _round in range(6):
+            threads = []
+            for _ in range(8):
+                uid += 1
+                threads.append(threading.Thread(target=commit_one,
+                                                args=(uid,)))
+            for t in threads:
+                t.start()
+            stop_by = time.monotonic() + 30.0
+            for t in threads:
+                t.join(timeout=max(stop_by - time.monotonic(), 0.1))
+            hung = [t for t in threads if t.is_alive()]
+            assert not hung, f"{len(hung)} committers hung mid-window"
+    finally:
+        faults.GLOBAL.clear()
+
+    assert acked, "fault schedule starved every window (p=0.3 seed drift)"
+    assert failures, "fault schedule never fired on the group append"
+
+    # HARD KILL mid-stream: copy the journal as it sits on disk right now
+    # (acked windows are fsynced; nothing about the kill is clean) and
+    # replay it into a fresh store — the node object is simply abandoned.
+    killed = tmp_path / "killed"
+    shutil.copytree(d, killed)
+    n2 = Node(dirpath=str(killed))
+    out, _ = n2.query('{ q(func: has(name)) { uid name age } }')
+    rows = {int(x["uid"], 16): x for x in out.get("q", [])}
+    for u, _ts in acked.items():
+        assert u in rows, f"acked commit 0x{u:x} lost by replay"
+        assert rows[u]["name"] == f"p{u}" and rows[u]["age"] == u
+    # never torn: any replayed subject (acked or ambiguous-but-landed)
+    # carries BOTH predicates of its single commit record
+    out_age, _ = n2.query('{ q(func: has(age)) { uid } }')
+    assert {int(x["uid"], 16) for x in out_age.get("q", [])} == \
+        set(rows), "torn commit: name and age diverged after replay"
+    n2.close()
+
+    # TORN TAIL: truncate the copied journal mid-way through its LAST
+    # record — replay must drop the whole gc record (no member partially
+    # applied), keeping every earlier record intact.
+    torn = tmp_path / "torn"
+    shutil.copytree(killed, torn)
+    wal = torn / "wal.log"
+    raw = wal.read_bytes()
+    import struct as _struct
+    off, frames = 0, []
+    while off + 4 <= len(raw):
+        (ln,) = _struct.unpack_from("<I", raw, off)
+        frames.append((off, 4 + ln))
+        off += 4 + ln
+    last_off, last_len = frames[-1]
+    wal.write_bytes(raw[: last_off + 4 + max(last_len - 4 - 2, 1)])
+    n3 = Node(dirpath=str(torn))
+    out3, _ = n3.query('{ q(func: has(name)) { uid name age } }')
+    rows3 = {int(x["uid"], 16) for x in out3.get("q", [])}
+    out3a, _ = n3.query('{ q(func: has(age)) { uid } }')
+    assert {int(x["uid"], 16) for x in out3a.get("q", [])} == rows3, \
+        "torn tail partially applied a window member"
+    assert rows3 <= set(rows)          # only whole records survived
+    n3.close()
+    node.close()

@@ -1,0 +1,436 @@
+"""Group-commit write path (ISSUE 16): commit-window batching, the one-
+fsync group WAL record, and per-member demux.
+
+Covers the tentpole contracts — a window's group record replays
+byte-identically to its members' solo records (including a handwritten
+FROZEN pre-16 per-commit WAL fixture, so the old log format can never
+drift), Oracle.commit_batch decides exactly like sequential commit()
+calls, conflicting members get their typed TxnConflict while the rest of
+the window commits, and `write_batch=False` restores the exact
+per-commit path.
+"""
+
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.coord.zero import Oracle, TxnConflict, TxnNotFound
+from dgraph_tpu.storage import keys as K
+from dgraph_tpu.storage.store import Store, decode_record, encode_record
+from dgraph_tpu.storage.writebatch import WriteBatcher
+from dgraph_tpu.utils.retry import CommitAmbiguous
+
+
+def _forced_window(node, max_batch=64, window_ms=200.0):
+    """Swap in a batcher that NEVER idle-fires: every commit joins a real
+    window, so tests observe deterministic multi-member groups."""
+    wb = WriteBatcher(node.zero.oracle, node.store, node.metrics,
+                      window_ms=window_ms, max_batch=max_batch,
+                      idle_fire=False)
+    node.write_batcher = wb
+    return wb
+
+
+def _commit_n(node, n, pred="name"):
+    """n concurrent committers writing disjoint keys; returns (oks, errs)."""
+    txns = []
+    for i in range(n):
+        r = node.mutate(set_nquads=f'<0x{i + 1:x}> <{pred}> "p{i + 1}" .')
+        txns.append(r.context.start_ts)
+    oks, errs = [], []
+    lock = threading.Lock()
+
+    def commit_one(st):
+        try:
+            ts = node.commit(st)
+            with lock:
+                oks.append(ts)
+        except BaseException as e:          # noqa: BLE001 — demuxed below
+            with lock:
+                errs.append(e)
+
+    threads = [threading.Thread(target=commit_one, args=(st,))
+               for st in txns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return oks, errs
+
+
+# -- codec: the group-commit record ------------------------------------------
+
+def test_gc_record_codec_roundtrip():
+    rec = {"t": "gc", "txns": [
+        {"s": 11, "ts": 12, "k": [K.data_key("name", 1).encode()]},
+        {"s": 10, "ts": 13, "k": [K.data_key("name", 2).encode(),
+                                  K.index_key("name", b"p2").encode()]},
+    ]}
+    out = decode_record(encode_record(rec))
+    assert out["t"] == "gc" and len(out["txns"]) == 2
+    # members decode as plain "c" records — replay and replication apply
+    # them through the exact single-commit branch
+    assert out["txns"][0] == {"t": "c", "s": 11, "ts": 12,
+                              "k": [K.data_key("name", 1).encode()]}
+    assert out["txns"][1]["k"][1] == K.index_key("name", b"p2").encode()
+
+
+def test_group_record_replays_identically_to_singles(tmp_path):
+    """The same three commits journaled as ONE gc record and as three
+    per-commit c records must replay to identical stores."""
+    from dgraph_tpu.storage.postings import Op, Posting
+
+    d_gc, d_solo = tmp_path / "gc", tmp_path / "solo"
+    members = []
+    for i in range(3):
+        kb = K.data_key("follows", i + 1)
+        members.append((10 + i, 20 + i, kb))
+
+    for d in (d_gc, d_solo):
+        d.mkdir()
+        s = Store(str(d))
+        for st, _ts, kb in members:
+            s.add_mutation(st, kb, Posting(100 + st, Op.SET))
+        if d is d_gc:
+            s.commit_group([(st, ts, [kb.encode()])
+                            for st, ts, kb in members])
+        else:
+            for st, ts, kb in members:
+                s.commit(st, ts, [kb.encode()])
+        s.close()
+
+    r_gc, r_solo = Store(str(d_gc)), Store(str(d_solo))
+    for st, _ts, kb in members:
+        np.testing.assert_array_equal(r_gc.get(kb).uids(25), [100 + st])
+        np.testing.assert_array_equal(r_solo.get(kb).uids(25), [100 + st])
+    # visibility watermark advanced identically
+    assert r_gc.pred_commit_ts["follows"] == \
+        r_solo.pred_commit_ts["follows"] == 22
+    assert r_gc.max_seen_commit_ts == r_solo.max_seen_commit_ts == 22
+    r_gc.close()
+    r_solo.close()
+
+
+def test_pre16_per_commit_wal_still_loads(tmp_path):
+    """A WAL written by the pre-group-commit path (per-commit binary c
+    records) must keep replaying. The fixture bytes are HANDWRITTEN to the
+    frozen layout — tag 0x01 m-record (<q I> start_ts,klen + key + <Q B B>
+    uid,op,flags) and tag 0x02 c-record (<q q I> start_ts,commit_ts,nkeys
+    + <I>-prefixed keys), each framed by a little-endian u32 length — so
+    the frozen format can never drift with encode_record."""
+    u32 = struct.Struct("<I")
+    kb = K.data_key("follows", 1).encode()
+
+    def frame(payload: bytes) -> bytes:
+        return u32.pack(len(payload)) + payload
+
+    m_rec = (bytes([0x01]) + struct.pack("<q I", 10, len(kb)) + kb
+             + struct.pack("<Q B B", 7, 0, 0))     # uid 7, SET, no flags
+    c_rec = (bytes([0x02]) + struct.pack("<q q I", 10, 11, 1)
+             + struct.pack("<I", len(kb)) + kb)
+
+    d = tmp_path / "pre16"
+    d.mkdir()
+    with open(d / "wal.log", "wb") as f:
+        f.write(frame(m_rec) + frame(c_rec))
+    s = Store(str(d))
+    np.testing.assert_array_equal(s.lists[kb].uids(11), [7])
+    assert s.pred_commit_ts["follows"] == 11
+    s.close()
+
+
+def test_mixed_wal_gc_after_pre16_records(tmp_path):
+    """Old per-commit records and new group records interleave in one log
+    (the upgrade case: a store whose WAL predates the window keeps
+    appending gc records to the same file)."""
+    from dgraph_tpu.storage.postings import Op, Posting
+
+    d = tmp_path / "mixed"
+    d.mkdir()
+    s = Store(str(d))
+    k1, k2 = K.data_key("follows", 1), K.data_key("follows", 2)
+    s.add_mutation(10, k1, Posting(7, Op.SET))
+    s.commit(10, 11, [k1.encode()])                       # pre-16 shape
+    s.add_mutation(12, k2, Posting(8, Op.SET))
+    s.commit_group([(12, 13, [k2.encode()])])             # window shape
+    s.close()
+    r = Store(str(d))
+    np.testing.assert_array_equal(r.lists[k1.encode()].uids(14), [7])
+    np.testing.assert_array_equal(r.lists[k2.encode()].uids(14), [8])
+    r.close()
+
+
+# -- oracle: batched conflict pass -------------------------------------------
+
+def test_commit_batch_matches_sequential_commits():
+    """One commit_batch call must decide exactly what sequential commit()
+    calls decide: same commit_ts assignment order, same conflict losers,
+    same typed errors."""
+    def build():
+        o = Oracle()
+        ts = [o.new_txn().start_ts for _ in range(5)]
+        o.track(ts[0], [b"a"])
+        o.track(ts[1], [b"a"])            # loses to ts[0]
+        o.track(ts[2], [b"b"])
+        o.track(ts[3], [b"c"])
+        o.track(ts[4], [b"b"])            # loses to ts[2]
+        return o, ts
+
+    o1, ts1 = build()
+    batched = o1.commit_batch(ts1 + [999_999])
+    o2, ts2 = build()
+    seq = []
+    for st in ts2 + [999_999]:
+        try:
+            seq.append(o2.commit(st))
+        except BaseException as e:        # noqa: BLE001 — compared below
+            seq.append(e)
+    assert len(batched) == len(seq) == 6
+    for b, s in zip(batched, seq):
+        if isinstance(s, BaseException):
+            assert type(b) is type(s)
+        else:
+            assert b == s
+    assert isinstance(batched[1], TxnConflict)
+    assert isinstance(batched[4], TxnConflict)
+    assert isinstance(batched[5], TxnNotFound)
+    # purge cadence kept the maps bounded the same way
+    assert o1._key_commit == o2._key_commit
+
+
+def test_commit_batch_intra_window_first_wins():
+    o = Oracle()
+    t1, t2 = o.new_txn().start_ts, o.new_txn().start_ts
+    o.track(t1, [b"k"])
+    o.track(t2, [b"k"])
+    r = o.commit_batch([t1, t2])
+    assert isinstance(r[0], int) and isinstance(r[1], TxnConflict)
+
+
+# -- the window ---------------------------------------------------------------
+
+def test_window_forms_one_group_one_fsync():
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    wb = _forced_window(n, max_batch=8)
+    oks, errs = _commit_n(n, 8)
+    assert errs == [] and len(oks) == 8 and len(set(oks)) == 8
+    m = n.metrics
+    assert m.counter("dgraph_write_batch_formed_total").value == 1
+    assert m.counter("dgraph_write_batch_fsyncs_total").value == 1
+    assert m.counter("dgraph_write_batch_commits_total").value == 8
+    assert m.histogram("dgraph_write_batch_occupancy").snapshot()["max"] == 8
+    assert wb._open is None
+    # every member is visible — acks demuxed only after the stamp landed
+    out, _ = n.query('{ q(func: has(name)) { name } }')
+    assert len(out["q"]) == 8
+    n.close()
+
+
+def test_window_demuxes_conflict_while_rest_commit():
+    n = Node()
+    n.alter(schema_text="v: int .")
+    n.mutate(set_nquads='<0x1> <v> "1"^^<xs:int> .', commit_now=True)
+    _forced_window(n, max_batch=4)
+    # two txns race on 0x1 (one must lose), two touch disjoint subjects
+    r1 = n.mutate(set_nquads='<0x1> <v> "2"^^<xs:int> .')
+    r2 = n.mutate(set_nquads='<0x1> <v> "3"^^<xs:int> .')
+    r3 = n.mutate(set_nquads='<0x2> <v> "4"^^<xs:int> .')
+    r4 = n.mutate(set_nquads='<0x3> <v> "5"^^<xs:int> .')
+    oks, errs = [], []
+    lock = threading.Lock()
+
+    def commit_one(st):
+        try:
+            ts = n.commit(st)
+            with lock:
+                oks.append(ts)
+        except TxnConflict as e:
+            with lock:
+                errs.append(e)
+
+    threads = [threading.Thread(target=commit_one,
+                                args=(r.context.start_ts,))
+               for r in (r1, r2, r3, r4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(oks) == 3 and len(errs) == 1
+    assert isinstance(errs[0], TxnConflict)
+    m = n.metrics
+    assert m.counter("dgraph_write_batch_conflict_aborts_total").value == 1
+    assert m.counter("dgraph_num_aborts_total").value == 1
+    out, _ = n.query('{ q(func: uid(0x1)) { v } }')
+    assert out["q"][0]["v"] in (2, 3)      # exactly one racer won
+    out, _ = n.query('{ q(func: uid(0x2, 0x3)) { v } }')
+    assert sorted(x["v"] for x in out["q"]) == [4, 5]
+    n.close()
+
+
+def test_batch_of_one_runs_exact_solo_path(tmp_path):
+    """An unaccompanied commit through the window must journal the same
+    per-commit c record the pre-16 path wrote (byte-compatible logs for
+    unbatched traffic)."""
+    d = tmp_path / "one"
+    d.mkdir()
+    n = Node(dirpath=str(d))
+    n.alter(schema_text="name: string .")
+    n.mutate(set_nquads='<0x1> <name> "solo" .', commit_now=True)
+    n.close()
+    tags = []
+    u32 = struct.Struct("<I")
+    with open(d / "wal.log", "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (ln,) = u32.unpack(hdr)
+            tags.append(f.read(ln)[0])
+    assert 0x02 in tags and 0x04 not in tags   # c record, never gc
+
+
+def test_no_write_batch_restores_per_commit_path():
+    n = Node(write_batch=False)
+    assert n.write_batcher is None
+    n.alter(schema_text="name: string @index(exact) .")
+    oks, errs = _commit_n(n, 6)
+    assert errs == [] and len(oks) == 6
+    assert n.metrics.counter("dgraph_write_batch_formed_total").value == 0
+    out, _ = n.query('{ q(func: has(name)) { name } }')
+    assert len(out["q"]) == 6
+    n.close()
+
+
+def test_reads_identical_window_on_vs_off():
+    """The acceptance gate's read-equivalence check in unit form: the same
+    write program through the window and through the solo path must leave
+    byte-identical query results."""
+    import json
+
+    outs = []
+    for write_batch in (True, False):
+        n = Node(write_batch=write_batch)
+        n.alter(schema_text="name: string @index(exact) .\n"
+                            "follows: [uid] @reverse .")
+        _commit_n(n, 12)
+        n.mutate(set_nquads="<0x1> <follows> <0x2> .\n"
+                            "<0x2> <follows> <0x3> .", commit_now=True)
+        out, _ = n.query('{ q(func: has(name), orderasc: name) '
+                         '{ name follows { name } } }')
+        outs.append(json.dumps(out, sort_keys=True))
+        n.close()
+    assert outs[0] == outs[1]
+
+
+def test_wal_append_fault_types_whole_window_ambiguous(tmp_path):
+    """disk.wal_write mid-window: the oracle already decided, the single
+    group append covers every member — so every member gets the typed
+    CommitAmbiguous (never a hang, never a silent partial commit) and
+    nothing becomes visible (all-or-nothing record)."""
+    from dgraph_tpu.utils import faults
+
+    d = tmp_path / "faulted"
+    d.mkdir()
+    n = Node(dirpath=str(d))     # a real journal, so the fault point fires
+    n.alter(schema_text="name: string @index(exact) .")
+    _forced_window(n, max_batch=4)
+    # stage all mutations BEFORE arming the fault: their own m-record
+    # appends must succeed — the fault is for the window's group append
+    txns = [n.mutate(set_nquads=f'<0x{i + 1:x}> <name> "p{i + 1}" .')
+            .context.start_ts for i in range(4)]
+    faults.GLOBAL.clear()
+    faults.GLOBAL.reseed(16)
+    oks, errs = [], []
+    lock = threading.Lock()
+
+    def commit_one(st):
+        try:
+            ts = n.commit(st)
+            with lock:
+                oks.append(ts)
+        except BaseException as e:       # noqa: BLE001 — typed below
+            with lock:
+                errs.append(e)
+
+    try:
+        faults.GLOBAL.install("disk.wal_write", "error", p=1.0, count=1)
+        threads = [threading.Thread(target=commit_one, args=(st,))
+                   for st in txns]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert oks == [] and len(errs) == 4
+        for e in errs:
+            assert isinstance(e, CommitAmbiguous)
+            assert e.__cause__ is not None
+        out, _ = n.query('{ q(func: has(name)) { name } }')
+        assert out.get("q", []) == []
+    finally:
+        faults.GLOBAL.clear()
+    # the window machinery survives: the next commits go through clean
+    oks2, errs2 = _commit_n(n, 2, pred="name")
+    assert errs2 == [] and len(oks2) == 2
+    n.close()
+
+
+def test_deadline_bypass_commits_solo():
+    from dgraph_tpu.utils import deadline as dl
+
+    n = Node()
+    n.alter(schema_text="name: string .")
+    _forced_window(n, window_ms=500.0)   # window far wider than the budget
+    r = n.mutate(set_nquads='<0x1> <name> "p" .')
+    with dl.scope(0.2):
+        ts = n.commit(r.context.start_ts)
+    assert ts > 0
+    m = n.metrics
+    assert m.counter("dgraph_write_batch_deadline_bypass_total").value == 1
+    assert m.counter("dgraph_write_batch_formed_total").value == 0
+    n.close()
+
+
+def test_live_load_routes_through_window_and_retries(tmp_path):
+    """Satellite 1: the live loader's batches commit through the window
+    and TxnConflict retries ride utils/retry's policy (visible on
+    dgraph_retry_total when a conflict occurs)."""
+    from dgraph_tpu.loader.live import live_load
+
+    rdf = tmp_path / "live.rdf"
+    rdf.write_text("".join(
+        f'_:p{i} <name> "p{i}" .\n' for i in range(40)))
+    n = Node()
+    n.alter(schema_text="name: string @index(exact) .")
+    stats = live_load(n, str(rdf), batch=10)
+    assert stats.quads == 40 and stats.txns == 4 and stats.aborts == 0
+    out, _ = n.query('{ q(func: has(name)) { count(uid) } }')
+    assert out["q"][0]["count"] == 40
+    # windows formed (batch-of-one counts: live loader is sequential here)
+    assert n.metrics.counter(
+        "dgraph_write_batch_commits_total").value == stats.txns
+    n.close()
+
+
+def test_node_wal_replay_after_windowed_commits(tmp_path):
+    """End-to-end durability: a node that group-committed everything is
+    reopened from its journal and serves identical reads."""
+    import json
+
+    d = tmp_path / "store"
+    d.mkdir()
+    n = Node(dirpath=str(d))
+    n.alter(schema_text="name: string @index(exact) .")
+    _forced_window(n, max_batch=8)
+    oks, errs = _commit_n(n, 8)
+    assert errs == [] and len(oks) == 8
+    out1, _ = n.query('{ q(func: has(name), orderasc: name) { name } }')
+    n.close()
+    n2 = Node(dirpath=str(d))
+    out2, _ = n2.query('{ q(func: has(name), orderasc: name) { name } }')
+    assert json.dumps(out1, sort_keys=True) == \
+        json.dumps(out2, sort_keys=True)
+    n2.close()
