@@ -1032,6 +1032,205 @@ def bench_mesh():
     return out
 
 
+AGG_ARTIFACT = "AGG_r17.json"
+# 1M+ groups, ~4 members each: the scale point of the ISSUE-17 gate
+_AGG_GROUPS = 1 << 20
+
+_AGG_SCHEMA = ("name: string @index(exact) .\n"
+               "rating: float @index(float) .\n"
+               "score: int @index(int) .\n"
+               "p0: [uid] .\np1: [uid] .\np2: [uid] .\n")
+
+# groupby battery: byte identity plain vs mesh, and — for the terminal
+# shapes — chain + aggregation as ONE fused dispatch
+_AGG_BATTERY = [
+    ("gb_count", '{ q(func: eq(name, "node3")) { p0 @groupby(p2) '
+                 '{ count(uid) } } }', True),
+    ("gb_count_deep", '{ q(func: eq(name, "node3")) { p0 { p1 '
+                      '@groupby(p2) { count(uid) } } } }', True),
+    ("gb_aggs", '{ var(func: has(name)) { r as rating } '
+                '  q(func: eq(name, "node3")) { p0 { p1 @groupby(p2) '
+                '{ count(uid) s: sum(val(r)) m: min(val(r)) '
+                '  x: max(val(r)) a: avg(val(r)) } } } }', True),
+    ("gb_int_aggs", '{ var(func: has(name)) { s as score } '
+                    '  q(func: eq(name, "node3")) { p0 @groupby(p2) '
+                    '{ count(uid) t: sum(val(s)) } } }', True),
+    ("gb_value_key", '{ q(func: eq(name, "node3")) { p0 { p1 '
+                     '@groupby(name) { count(uid) } } } }', False),
+    ("gb_multi_key", '{ q(func: eq(name, "node3")) { p0 { p1 '
+                     '@groupby(p2, p0) { count(uid) } } } }', False),
+    ("gb_plain_child", '{ q(func: eq(name, "node3")) { p0 { p1 '
+                       '@groupby(p2) { count(uid) name } } } }', False),
+    ("gb_root", '{ q(func: has(name)) @groupby(p2) { count(uid) } }',
+     False),
+]
+
+
+def _agg_quads(n=400):
+    quads = []
+    for i in range(1, n + 1):
+        quads.append(f'<0x{i:x}> <name> "node{i % 80}" .')
+        quads.append(f'<0x{i:x}> <rating> "{(i * 13) % 100 / 10}"'
+                     f'^^<xs:float> .')
+        if i % 5:
+            quads.append(f'<0x{i:x}> <score> "{(i * 7) % 50}"'
+                         f'^^<xs:int> .')
+        for attr, mul, off in (("p0", 3, 1), ("p1", 5, 2), ("p2", 7, 3)):
+            for k in range(3):
+                t = (i * mul + off + k) % n + 1
+                if t != i:
+                    quads.append(f"<0x{i:x}> <{attr}> <0x{t:x}> .")
+    return quads
+
+
+def _agg_scale_gate(reps=3):
+    """The ≥5× claim at 1M+ groups: the rank-space fused assembly
+    (ops/segments — device segment ids from group lengths, every op in
+    one dispatch) against the REFERENCE per-group aggregation loop
+    (query/aggregator.aggregate over Val lists, the dict-path semantics
+    this PR's group assembly replaced). The vectorized f64 host lattice
+    is recorded alongside — on the CPU host platform it wins below the
+    crossover, which is exactly why groupby routes through
+    _HOST_AGG_MAX instead of always dispatching."""
+    import numpy as np
+
+    from dgraph_tpu.ops import segments as segs
+    from dgraph_tpu.query.aggregator import aggregate
+    from dgraph_tpu.query.groupby import _host_segment_reduce
+    from dgraph_tpu.utils.types import TypeID, Val
+
+    rng = np.random.default_rng(17)
+    ng = _AGG_GROUPS
+    lens = rng.poisson(4.0, ng).astype(np.int64)
+    n = int(lens.sum())
+    vals = rng.integers(0, 7, n).astype(np.float64)   # f32-exact regime
+    ops = ("sum", "min", "max", "avg")
+
+    fused = segs.fused_group_reduce(ops, vals, lens, ng)   # compile warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fused = segs.fused_group_reduce(ops, vals, lens, ng)
+        ts.append(time.perf_counter() - t0)
+    fused_ms = _band([t * 1e3 for t in ts])["median"]
+
+    seg_ids = np.repeat(np.arange(ng, dtype=np.int64), lens)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        host = {op: _host_segment_reduce(op, seg_ids, vals, ng)
+                for op in ops}
+        ts.append(time.perf_counter() - t0)
+    host_ms = _band([t * 1e3 for t in ts])["median"]
+
+    # reference semantics: one pass, per-group aggregate() over Val lists
+    t0 = time.perf_counter()
+    vv = [Val(TypeID.INT, int(x)) for x in vals]
+    ends = np.cumsum(lens)
+    starts = ends - lens
+    ref = {op: [aggregate(op, vv[starts[g]: ends[g]])
+                for g in range(ng)] for op in ops}
+    ref_ms = (time.perf_counter() - t0) * 1e3
+
+    exact = all(np.array_equal(np.asarray(fused[op], np.float64),
+                               host[op], equal_nan=True) for op in ops)
+    # spot-check the reference agreement on a sample of groups
+    pick = rng.integers(0, ng, 500)
+    for op in ops:
+        for g in pick.tolist():
+            r = ref[op][g]
+            f = float(np.asarray(fused[op])[g])
+            exact &= (np.isnan(f) if r is None
+                      else f == float(r.value))
+    speedup = ref_ms / max(fused_ms, 1e-9)
+    return {"groups": ng, "members": n,
+            "fused_ms": round(fused_ms, 1),
+            "host_f64_ms": round(host_ms, 1),
+            "reference_ms": round(ref_ms, 1),
+            "speedup_vs_reference": round(speedup, 1),
+            "exact": bool(exact),
+            "gate_5x": bool(speedup >= 5.0 and exact)}
+
+
+def _agg_child():
+    """Runs INSIDE the forced-8-device CPU subprocess: the groupby
+    byte-identity battery (plain vs mesh node, one fused dispatch for
+    every terminal shape incl. the aggregation), the labeled
+    groupby/agg fallback reasons, and the 1M-group scale gate."""
+    from dgraph_tpu.api.server import Node
+
+    import jax
+
+    quads = _agg_quads()
+    plain = Node()
+    mesh = Node(mesh_devices=8, mesh_min_edges=1)
+    for nd in (plain, mesh):
+        nd.alter(schema_text=_AGG_SCHEMA)
+        nd.mutate(set_nquads="\n".join(quads), commit_now=True)
+        nd.task_cache = nd.result_cache = None
+
+    mdisp = mesh.metrics.counter("dgraph_mesh_dispatches_total")
+    mterm = mesh.metrics.counter("dgraph_agg_terminal_ops_total")
+    out = {"n_devices": len(jax.devices()), "identical": True,
+           "one_dispatch": True, "battery": {}}
+    for name, q, terminal in _AGG_BATTERY:
+        a, _ = plain.query(q)
+        mesh.query(q)                      # warm the fused program
+        d0, t0c = mdisp.value, mterm.value
+        s0 = time.perf_counter()
+        b, _ = mesh.query(q)
+        ms = (time.perf_counter() - s0) * 1e3
+        disp, term = mdisp.value - d0, mterm.value - t0c
+        same = json.dumps(a, sort_keys=True, default=str) == \
+            json.dumps(b, sort_keys=True, default=str)
+        out["identical"] &= same
+        if terminal:
+            out["one_dispatch"] &= (disp == 1 and term == 1)
+        out["battery"][name] = {
+            "identical": same, "dispatches": disp,
+            "terminal_ops": term, "p50_ms": round(ms, 2)}
+    out["fallback_reasons"] = {
+        k: v for k, v in mesh.metrics.keyed(
+            "dgraph_mesh_fallbacks_total",
+            labels=("reason",)).snapshot().items()
+        if k in ("groupby", "agg")}
+    out["scale"] = _agg_scale_gate()
+    out["ok"] = bool(out["identical"] and out["one_dispatch"]
+                     and out["scale"]["gate_5x"]
+                     and out["fallback_reasons"].get("groupby", 0) >= 1
+                     and out["fallback_reasons"].get("agg", 0) >= 1)
+    plain.close()
+    mesh.close()
+    return out
+
+
+def bench_agg():
+    """Device-aggregation battery (ISSUE 17): groupby byte identity +
+    one-dispatch terminals + the ≥5× grouped-aggregation gate at 1M+
+    groups, in a forced-8-device subprocess; writes AGG_r17.json."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--agg-child"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(f"agg child failed: {proc.stderr[-500:]}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           AGG_ARTIFACT), "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return out
+
+
 LDBC_ARTIFACT = "LDBC_r15.json"
 # scale factor for the in-repo battery (persons ≈ 10000·sf^0.85); the
 # smoke script passes a smaller one via env. SF10/SF100 run the same
@@ -2150,6 +2349,10 @@ def main():
         # forced-8-device CPU subprocess (bench_ldbc): one JSON line out
         print(json.dumps(_ldbc_child()))
         return
+    if "--agg-child" in sys.argv:
+        # forced-8-device CPU subprocess (bench_agg): one JSON line out
+        print(json.dumps(_agg_child()))
+        return
     # the axon relay can hang forever inside backend init (observed all of
     # round 3: make_c_api_client never returns, blocking even SIGALRM
     # delivery). Probe the backend in a SUBPROCESS — the parent's timeout
@@ -2260,6 +2463,10 @@ def main():
         ldbc = bench_ldbc()
     except Exception as e:  # scale battery must not sink it either
         ldbc = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        agg = bench_agg()
+    except Exception as e:  # device-aggregation battery must not sink it
+        agg = {"error": f"{type(e).__name__}: {e}"}
 
     band = _band(eps_samples)
     print(json.dumps({
@@ -2284,6 +2491,7 @@ def main():
         "residency": residency,
         "obs": obs,
         "ldbc": ldbc,
+        "agg": agg,
     }))
 
 

@@ -488,7 +488,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # endpoints that feed the per-endpoint QPS meters + latency histograms
     _OBSERVED = {"/query": "query", "/mutate": "mutate", "/commit": "commit",
-                 "/abort": "abort", "/alter": "alter"}
+                 "/abort": "abort", "/alter": "alter",
+                 "/analytics": "analytics"}
 
     def do_POST(self):
         path = urlparse(self.path).path.rstrip("/")
@@ -505,6 +506,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._abort()
             elif path == "/alter":
                 self._alter()
+            elif path == "/analytics":
+                self._analytics()
             elif path == "/admin/export":
                 self._admin_export()
             elif path == "/admin/shutdown":
@@ -609,6 +612,30 @@ class _Handler(BaseHTTPRequestHandler):
         self.node.set_memory_budget(mb * (1 << 20))
         stats = self.node.enforce_memory(mb * (1 << 20))
         self._send(200, json.dumps({"code": "Success", **stats}).encode())
+
+    def _analytics(self):
+        """POST /analytics — whole-graph OLAP over one predicate's tablet
+        (docs/ops.md "Analytics"). Body: {"kind": "pagerank"|"cc"|
+        "triangles", "pred": "<predicate>", ...knobs}; ?timeoutMs= rides
+        the query string like every other endpoint."""
+        j = json.loads(self._read_body() or "{}")
+        kind = str(j.get("kind", ""))
+        pred = str(j.get("pred", ""))
+        if not kind or not pred:
+            raise ValueError('body must carry "kind" and "pred"')
+        qs = self._qs()
+        timeout_ms = qs.get("timeoutMs")
+        t0 = time.perf_counter_ns()
+        out = self.node.analytics(
+            kind, pred,
+            damping=float(j.get("damping", 0.85)),
+            tol=float(j.get("tol", 1e-6)),
+            max_iters=int(j.get("maxIters", j.get("max_iters", 100))),
+            top=int(j.get("top", 20)),
+            timeout_ms=float(timeout_ms) if timeout_ms else None,
+            start_ts=int(j["startTs"]) if j.get("startTs") else None)
+        ext = {"server_latency": {"total_ns": time.perf_counter_ns() - t0}}
+        self._send(200, _envelope_ok({"analytics": out}, ext))
 
     def _query(self):
         body = self._read_body()

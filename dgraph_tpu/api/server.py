@@ -833,6 +833,57 @@ class Node:
                 "elapsed_ms": total["wall_ms"],
                 **flag})
 
+    def analytics(self, kind: str, pred: str, *, damping: float = 0.85,
+                  tol: float = 1e-6, max_iters: int = 100, top: int = 20,
+                  timeout_ms: float | None = None,
+                  start_ts: int | None = None) -> dict:
+        """Whole-graph analytics over one uid predicate's tablet
+        (query/analytics.py): PageRank / connected components / triangle
+        count as device-resident while_loop programs on the mesh, host
+        oracle fallback when the tablet is overlay/residency-deferred or
+        the node runs without a mesh. Same request discipline as query():
+        span + deadline scope + cost ledger + DispatchGate."""
+        from dgraph_tpu.query import analytics as an
+
+        sp = self._span("analytics", kind=kind, pred=pred)
+        m = self.metrics
+        m.meter("analytics").mark()
+        t0 = time.perf_counter()
+        lg = costs.CostLedger(endpoint="analytics",
+                              shape=f"analytics:{kind}:{pred}") \
+            if self.cost_ledger else None
+        try:
+            with sp, self._deadline_scope(timeout_ms), costs.scope(lg):
+                read_ts, snap = self._read_view(start_ts)
+                sp.set(read_ts=int(read_ts))
+                rev = pred.startswith("~")
+                pd = snap.pred(pred[1:] if rev else pred)
+                csr = (pd.rev_csr if rev else pd.csr) \
+                    if pd is not None else None
+                if csr is None:
+                    raise ValueError(
+                        f"analytics: predicate {pred!r} has no uid "
+                        f"edges")
+                if self.residency.enabled:
+                    self.residency.prefetch(
+                        [pred[1:] if rev else pred], snap)
+                lga = costs.current()
+                if lga is not None:
+                    lga.add_task(pred[1:] if rev else pred, 0)
+                out = an.run(kind, csr, mesh=self.mesh_exec,
+                             gate=self.dispatch_gate, metrics=m,
+                             damping=damping, tol=tol,
+                             max_iters=max_iters, top=top)
+                out["pred"] = pred
+                sp.set(device=out["device"], nodes=out["nodes"],
+                       edges=out["edges"])
+                return out
+        finally:
+            m.histogram("dgraph_analytics_latency_s").observe(
+                time.perf_counter() - t0,
+                exemplar=sp.trace_id or None)
+            self._finish_cost(lg, sp)
+
     def upsert(self, q: str, mutations: list[dict],
                variables: dict | None = None, start_ts: int | None = None,
                commit_now: bool = False) -> tuple[dict, dict, TxnContext]:

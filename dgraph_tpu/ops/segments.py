@@ -7,11 +7,22 @@ segment ids and every group's aggregate computes in ONE
 jax.ops.segment_* call over the flat member array — the canonical
 segment-reduction mapping of SURVEY.md §7 step 5.
 
-Host-facing entry: `group_reduce(op, seg_ids, values, num_groups)` takes
-numpy arrays (the engine's group assembly is host work), runs the fused
-device reduction, and returns a numpy vector of per-group results with NaN
-for empty groups (the caller drops them, matching the reference's
-"aggregate of no values is absent" behavior).
+Two exactness regimes, mirroring ops/vector.py's candidate/finalize split:
+
+  * the DEVICE stage reduces f32 CANDIDATES (sum/min/max) plus an exact
+    valid-value count, with segment ids derived ON DEVICE from the
+    per-group length vector (a cumsum + searchsorted — no host
+    ``np.repeat`` tail) and padding/dead rows masked into a dump segment;
+  * the HOST finalizes in f64: avg is always ``f64(sum)/f64(count)``, empty
+    segments collapse to NaN, and the caller's f32-exactness rule
+    (all-int values, |sum| < 2**24 — see groupby._batch_aggregates)
+    guarantees the f32 candidates are bit-exact where they are used.
+
+Host-facing entries: `group_reduce(op, seg_ids, values, num_groups)` (host
+segment ids, one op) and `fused_group_reduce(ops, values, lens, num_groups)`
+(device segment ids from lengths, many ops in one dispatch). Both return
+numpy vectors with NaN for empty groups (count yields 0), matching the
+reference's "aggregate of no values is absent" behavior.
 """
 
 from __future__ import annotations
@@ -23,6 +34,15 @@ import jax
 import jax.numpy as jnp
 
 _OPS = ("sum", "min", "max", "avg", "count")
+
+# below this many lookups the numpy searchsorted wins (no transfer/jit);
+# above it the device rank kernel amortizes
+_RANK_DEVICE_MIN = 1 << 18
+
+
+def seg_capacity(n: int) -> int:
+    """Pow2 padding capacity: bounds jit retraces across input sizes."""
+    return 1 << max(int(np.ceil(np.log2(max(int(n), 1)))), 4)
 
 
 @partial(jax.jit, static_argnames=("op", "num_segments"))
@@ -52,9 +72,27 @@ def segment_reduce(values: jax.Array, seg_ids: jax.Array, *, op: str,
     return jnp.where(empty, jnp.nan, out)
 
 
+@partial(jax.jit, static_argnames=("num_segments",))
+def _sum_count(values: jax.Array, seg_ids: jax.Array, *,
+               num_segments: int) -> tuple[jax.Array, jax.Array]:
+    """f32 sum candidate + exact valid count in one dispatch (avg feeds
+    the host-f64 finalize from these instead of dividing on device)."""
+    valid = ~jnp.isnan(values)
+    s = jax.ops.segment_sum(jnp.where(valid, values, 0.0), seg_ids,
+                            num_segments)
+    cnt = jax.ops.segment_sum(valid.astype(jnp.float32), seg_ids,
+                              num_segments)
+    return s, cnt
+
+
 def group_reduce(op: str, seg_ids: np.ndarray, values: np.ndarray,
                  num_groups: int) -> np.ndarray:
-    """numpy → device → numpy wrapper (empty input → all-NaN/0 vector)."""
+    """numpy → device → numpy wrapper (empty input → all-NaN/0 vector).
+
+    avg finalizes on the host in f64 from the device's (sum, count)
+    candidates — byte-identical to a host f64 tail whenever the sum is
+    f32-exact.
+    """
     if op not in _OPS:
         raise ValueError(f"unknown segment op {op!r}")
     if num_groups == 0:
@@ -64,8 +102,133 @@ def group_reduce(op: str, seg_ids: np.ndarray, values: np.ndarray,
         if op == "count":
             out[:] = 0.0
         return out
-    res = segment_reduce(
-        jnp.asarray(np.asarray(values, dtype=np.float32)),
-        jnp.asarray(np.asarray(seg_ids, dtype=np.int32)),
-        op=op, num_segments=int(num_groups))
+    vals = jnp.asarray(np.asarray(values, dtype=np.float32))
+    segs = jnp.asarray(np.asarray(seg_ids, dtype=np.int32))
+    if op == "avg":
+        s, cnt = _sum_count(vals, segs, num_segments=int(num_groups))
+        s64 = np.asarray(s, dtype=np.float64)
+        c64 = np.asarray(cnt, dtype=np.float64)
+        return np.where(c64 == 0, np.nan, s64 / np.maximum(c64, 1.0))
+    res = segment_reduce(vals, segs, op=op, num_segments=int(num_groups))
     return np.asarray(res)
+
+
+# ---------------------------------------------------------------------------
+# fused multi-op reduce with device-derived segment ids
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("ops", "num_segments"))
+def _lens_reduce(values: jax.Array, lens: jax.Array, total: jax.Array, *,
+                 ops: tuple, num_segments: int) -> dict:
+    """Reduce with segment ids built ON DEVICE from per-group lengths.
+
+    values: f32[cap] flat member values, caller-padded (NaN = missing)
+    lens:   i32[gcap] per-group member counts, zero-padded
+    total:  i32 scalar — the live prefix of `values`
+
+    Position p belongs to the group whose cumulative-length window covers
+    it; padding/dead rows (p >= total) and overflow land in a dump segment
+    `num_segments` that is sliced off. Returns f32 candidate arrays per
+    requested op plus the exact valid count.
+    """
+    cap = values.shape[0]
+    # int32 positions: total member count is bounded far below 2**31 by
+    # the engine's traversed-edge budget (x64 stays off on device)
+    ends = jnp.cumsum(lens, dtype=jnp.int32)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    seg = jnp.searchsorted(ends, pos, side="right").astype(jnp.int32)
+    live = pos < total
+    seg = jnp.where(live & (seg < num_segments), seg, num_segments)
+    valid = live & ~jnp.isnan(values)
+    ns = num_segments + 1
+    out = {"count": jax.ops.segment_sum(
+        valid.astype(jnp.float32), seg, ns)[:num_segments]}
+    if "sum" in ops or "avg" in ops:
+        out["sum"] = jax.ops.segment_sum(
+            jnp.where(valid, values, 0.0), seg, ns)[:num_segments]
+    if "min" in ops:
+        out["min"] = jax.ops.segment_min(
+            jnp.where(valid, values, jnp.inf), seg, ns)[:num_segments]
+    if "max" in ops:
+        out["max"] = jax.ops.segment_max(
+            jnp.where(valid, values, -jnp.inf), seg, ns)[:num_segments]
+    return out
+
+
+def fused_group_reduce(ops, values: np.ndarray, lens,
+                       num_groups: int) -> dict:
+    """All requested ops over one flat value vector in ONE device dispatch.
+
+    values: float per-member values in group-concatenation order (NaN =
+    member has no value); lens: per-group member counts (their cumsum
+    defines the segments — the device derives ids, no host np.repeat).
+    Returns {op: float64[num_groups]} finalized on the host: sum/min/max
+    widen the f32 candidates, avg = f64(sum)/f64(count), empty → NaN
+    (count → 0).
+    """
+    for op in ops:
+        if op not in _OPS:
+            raise ValueError(f"unknown segment op {op!r}")
+    ng = int(num_groups)
+    if ng == 0:
+        return {op: np.zeros(0, dtype=np.float64) for op in ops}
+    n = len(values)
+    if n == 0:
+        return {op: (np.zeros(ng) if op == "count"
+                     else np.full(ng, np.nan)) for op in ops}
+    cap = seg_capacity(n)
+    gcap = seg_capacity(ng)
+    vp = np.full(cap, np.nan, dtype=np.float32)
+    vp[:n] = np.asarray(values, dtype=np.float32)
+    lp = np.zeros(gcap, dtype=np.int32)
+    lp[:ng] = np.asarray(lens, dtype=np.int32)
+    dev_ops = tuple(sorted(set(ops)))
+    res = _lens_reduce(jnp.asarray(vp), jnp.asarray(lp), jnp.int32(n),
+                       ops=dev_ops, num_segments=ng)
+    cnt = np.asarray(res["count"], dtype=np.float64)
+    empty = cnt == 0
+    out = {}
+    for op in ops:
+        if op == "count":
+            out[op] = cnt
+        elif op == "avg":
+            s = np.asarray(res["sum"], dtype=np.float64)
+            out[op] = np.where(empty, np.nan, s / np.maximum(cnt, 1.0))
+        else:
+            cand = np.asarray(res[op], dtype=np.float64)
+            out[op] = np.where(empty, np.nan, cand)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rank-space coding against a distinct-target table
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _rank_kernel(table: jax.Array, values: jax.Array):
+    nt = table.shape[0]
+    pos = jnp.clip(jnp.searchsorted(table, values), 0, max(nt - 1, 0))
+    return pos, jnp.take(table, pos) == values
+
+
+def rank_in_table(table: np.ndarray, values: np.ndarray):
+    """(pos, hit): rank of each value in a SORTED table — the group-code
+    primitive (codes = ranks in the tablet's distinct-target table, no
+    per-query np.unique sort). Host numpy below _RANK_DEVICE_MIN lookups,
+    device searchsorted above.
+    """
+    nv = len(values)
+    if len(table) == 0 or nv == 0:
+        return (np.zeros(nv, dtype=np.int64),
+                np.zeros(nv, dtype=bool))
+    if nv >= _RANK_DEVICE_MIN:
+        cap = seg_capacity(nv)
+        vp = np.full(cap, table[0], dtype=np.int64)
+        vp[:nv] = values
+        pos, hit = _rank_kernel(jnp.asarray(np.asarray(table, np.int64)),
+                                jnp.asarray(vp))
+        return (np.asarray(pos[:nv], dtype=np.int64),
+                np.asarray(hit[:nv]))
+    pos = np.searchsorted(table, values)
+    posc = np.minimum(pos, len(table) - 1)
+    return posc.astype(np.int64), table[posc] == values

@@ -473,13 +473,22 @@ class MeshExecutor:
 
     # -- whole-plan fused program: N hops + filters + pagination, ONE dispatch
 
-    def _plan_program(self, fcap0: int, meta: tuple):
+    def _plan_program(self, fcap0: int, meta: tuple, term: tuple = None):
         """meta: per hop (ecap, rows_per, nd, formula, nsets, has_pag).
         The compiled program ships back ONLY the per-hop dest rank masks
         (replicated bool [nd]) and raw edge totals — the host replays
         uidMatrix rows from its own mirrors, so no sharded result
-        columns ever cross the device boundary."""
-        key = ("plan", fcap0, meta)
+        columns ever cross the device boundary.
+
+        term: optional (ecap, rows_per, ndt, ops) TERMINAL segmented-
+        reduce stage (fusedplan.TerminalIR): the groupby key tablet
+        expands from the final hop's mask and reduces per key-target
+        rank — int32 member counts (posting lists hold no duplicate
+        edges, so edge counts ARE distinct-member counts) plus one
+        (f32 candidate, f32 valid-count) pair per __agg_* op. The
+        per-agg reductions cost extra collectives (psum / pmin / pmax)
+        but stay inside the same single dispatch."""
+        key = ("plan", fcap0, meta, term)
         prog = self._progs.get(key)
         if prog is not None:
             return prog
@@ -487,6 +496,8 @@ class MeshExecutor:
         mesh = self.mesh
         nargs = 1 + sum(2 + m[4] + (3 if m[5] else 0) + (1 if h else 0)
                         for h, m in enumerate(meta)) + 1
+        if term is not None:
+            nargs += 3 + len(term[3])
 
         def run2(*args):
             sub0 = args[0]
@@ -533,6 +544,48 @@ class MeshExecutor:
                 mask = tot[:nd] > 0
                 outs += [mask, tot[nd]]
                 carry_mext = jnp.concatenate([mask, jnp.zeros(1, bool)])
+            if term is not None:
+                _ecap_t, rows_per_t, ndt, ops = term
+                erow_t, erank_t, prow_t = args[i: i + 3]
+                i += 3
+                act = jnp.concatenate([
+                    jnp.take(carry_mext, jnp.clip(prow_t[0], 0,
+                                                  carry_mext.shape[0] - 1)),
+                    jnp.zeros(1, bool)])
+                ae = jnp.take(act, erow_t[0])              # [ecap_t]
+                iv_all = jnp.where(ae, erank_t[0], ndt)
+                contrib = jnp.zeros((ndt + 1,), jnp.int32).at[iv_all].add(
+                    1, mode="drop")
+                trav = jnp.sum(ae, dtype=jnp.int32)
+                cnt = lax.psum(jnp.concatenate([contrib[:ndt], trav[None]]),
+                               "shard")
+                outs += [cnt[:ndt], cnt[ndt]]
+                for a, op in enumerate(ops):
+                    av = args[i + a]
+                    avx = jnp.concatenate([av[0],
+                                           jnp.full(1, jnp.nan, jnp.float32)])
+                    v = jnp.take(avx, erow_t[0])
+                    ok = ae & ~jnp.isnan(v)
+                    iv = jnp.where(ok, erank_t[0], ndt)
+                    if op == "min":
+                        cand = lax.pmin(jnp.full((ndt + 1,), jnp.inf,
+                                                 jnp.float32).at[iv].min(
+                            jnp.where(ok, v, jnp.inf), mode="drop"),
+                            "shard")[:ndt]
+                    elif op == "max":
+                        cand = lax.pmax(jnp.full((ndt + 1,), -jnp.inf,
+                                                 jnp.float32).at[iv].max(
+                            jnp.where(ok, v, -jnp.inf), mode="drop"),
+                            "shard")[:ndt]
+                    else:        # sum / avg share the f32 sum candidate
+                        cand = lax.psum(jnp.zeros((ndt + 1,),
+                                                  jnp.float32).at[iv].add(
+                            jnp.where(ok, v, 0.0), mode="drop"),
+                            "shard")[:ndt]
+                    cntv = lax.psum(jnp.zeros((ndt + 1,),
+                                              jnp.float32).at[iv].add(
+                        jnp.where(ok, 1.0, 0.0), mode="drop"), "shard")[:ndt]
+                    outs += [cand, cntv]
             return tuple(outs)
 
         in_specs: list = [P("shard")]
@@ -543,8 +596,11 @@ class MeshExecutor:
             in_specs += [P()] * nsets
             if has_pag:
                 in_specs += [P("shard"), P(), P()]
-        in_specs.append(P())
         out_specs = (P(), P()) * len(meta)
+        if term is not None:
+            in_specs += [P("shard")] * (3 + len(term[3]))
+            out_specs += (P(), P()) + (P(), P()) * len(term[3])
+        in_specs.append(P())
         # the seed frontier buffer is donated (SNIPPETS [1]
         # donate_argnums): the program reuses its HBM for the first hop's
         # row scatter instead of allocating fresh
@@ -555,7 +611,7 @@ class MeshExecutor:
         self._progs[key] = prog
         return prog
 
-    def run_plan(self, hops: list, seeds: np.ndarray):
+    def run_plan(self, hops: list, seeds: np.ndarray, terminal=None):
         """Execute a whole fused chain — root frontier through every hop's
         filter/pagination/expansion — as ONE device dispatch.
 
@@ -565,7 +621,15 @@ class MeshExecutor:
         caller replays the pruned uidMatrix rows from the host mirrors
         (fusedplan.replay_hop), byte-identical to the classic loop. Dense
         rank masks cannot truncate, so there is no capacity class to
-        outgrow."""
+        outgrow.
+
+        terminal: optional (csr, ops, avals) groupby/aggregation stage
+        (fusedplan.TerminalIR) — csr is the key predicate's tablet, ops a
+        tuple of agg op names, avals one host f32 [S, rows_per] value
+        plane per op (NaN = subject has no value). When given, returns
+        (levels, {"table", "counts", "traversed", "aggs"}) with per-rank
+        member counts and f32 (candidate, valid-count) pairs, still ONE
+        dispatch."""
         seeds = np.asarray(seeds, dtype=np.int64)
         fcap0 = _fcap_for(len(seeds))
         meta = []
@@ -589,10 +653,24 @@ class MeshExecutor:
                 args += [self._local_ptr(csr), jnp.int32(first),
                          jnp.int32(offset)]
             prev_tgt = tgt
+        term = None
+        tgt_t = None
+        if terminal is not None:
+            tcsr, ops, avals = terminal
+            tgt_t = _target_table(tcsr)
+            erank_t, _ = self._dense_maps(tcsr, tgt_t)
+            _er2, prow_t = self._dense_maps(tcsr, prev_tgt)
+            ecap_t = int(tcsr.sharded.indices.shape[-1])
+            term = (ecap_t, tcsr.rows_per, len(tgt_t), tuple(ops))
+            args += [_edge_rows(tcsr), erank_t, prow_t]
+            from jax.sharding import NamedSharding
+            shd = NamedSharding(self.mesh, P("shard"))
+            args += [jax.device_put(av, shd) for av in avals]
         args.append(jnp.asarray(pad_frontier(seeds, fcap0)))
-        prog = self._plan_program(fcap0, tuple(meta))
+        prog = self._plan_program(fcap0, tuple(meta), term)
         with otrace.span("device_kernel", kernel="mesh.plan",
-                         hops=len(hops), devices=self.n_devices) as sp:
+                         hops=len(hops), terminal=bool(term),
+                         devices=self.n_devices) as sp:
             with self.mesh:
                 flat = prog(*args)
             flat = jax.device_get(flat)  # ONE host round trip, at the end
@@ -609,9 +687,27 @@ class MeshExecutor:
                              frontier=len(frontier), dest=len(nxt))
                 levels.append((frontier, trav, nxt))
                 frontier = nxt
+            term_out = None
+            if term is not None:
+                base = 2 * len(hops)
+                counts = np.asarray(flat[base], dtype=np.int64)
+                ttrav = int(flat[base + 1])
+                total += ttrav
+                aggs = [(np.asarray(flat[base + 2 + 2 * a]),
+                         np.asarray(flat[base + 3 + 2 * a]))
+                        for a in range(len(term[3]))]
+                otrace.event("mesh_hop", hop=len(hops), edges=ttrav,
+                             frontier=len(frontier),
+                             dest=int(np.count_nonzero(counts)),
+                             terminal=True)
+                term_out = {"table": tgt_t.astype(np.int64),
+                            "counts": counts, "traversed": ttrav,
+                            "aggs": aggs}
             self._c_edges.inc(total)
             if sp:
                 sp.set(edges=total)
+        if terminal is not None:
+            return levels, term_out
         return levels
 
     # -- fused @recurse: edge-dedup levels, ONE dispatch ---------------------
@@ -958,3 +1054,218 @@ class MeshExecutor:
             if sp:
                 sp.set(cands=int((scores_h > -np.inf).sum()))
         return rows_h[scores_h > -np.inf]
+
+    # -- whole-graph analytics: device-resident while_loop programs ----------
+    #
+    # PageRank / connected components iterate entirely on device (the
+    # run_bfs idiom: lax.while_loop over edge-sharded scatter + ONE
+    # collective per iteration); only the converged vector crosses the
+    # host boundary. Edges arrive as rank pairs into a node table built
+    # by query/analytics._graph_arrays; padding edges scatter into a
+    # dropped slot (edst = ncap, mode="drop").
+
+    def _shard_edges(self, esrc: np.ndarray, edst: np.ndarray, ncap: int):
+        from jax.sharding import NamedSharding
+
+        S = self.n_devices
+        E = len(esrc)
+        epc = _fcap_for(-(-E // S) if E else 1)
+        es = np.zeros((S, epc), dtype=np.int32)
+        ed = np.full((S, epc), ncap, dtype=np.int32)
+        es.reshape(-1)[:E] = esrc
+        ed.reshape(-1)[:E] = edst
+        sh = NamedSharding(self.mesh, P("shard"))
+        return jax.device_put(es, sh), jax.device_put(ed, sh), epc
+
+    def _pagerank_program(self, epc: int, ncap: int):
+        key = ("pagerank", epc, ncap)
+        pr_prog = self._progs.get(key)
+        if pr_prog is not None:
+            return pr_prog
+        self._c_compiles.inc()
+        mesh = self.mesh
+
+        def run(esrc, edst, outdeg, dangling, live, rank0, n, damping,
+                tol, maxit):
+            def cond(c):
+                _r, it, delta = c
+                return (it < maxit) & (delta > tol)
+
+            def body(c):
+                r, it, _ = c
+                w = jnp.take(r, esrc[0]) / jnp.take(outdeg, esrc[0])
+                contrib = lax.psum(
+                    jnp.zeros((ncap + 1,), jnp.float32).at[edst[0]].add(
+                        w, mode="drop"), "shard")[:ncap]
+                dang = jnp.sum(r * dangling)
+                new = jnp.where(
+                    live > 0,
+                    (1.0 - damping) / n + damping * (contrib + dang / n),
+                    0.0)
+                delta = jnp.sum(jnp.abs(new - r))
+                return new, it + 1, delta
+
+            r, it, _ = lax.while_loop(
+                cond, body, (rank0, jnp.int32(0), jnp.float32(jnp.inf)))
+            return r, it
+
+        pr_prog = jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=(P("shard"), P("shard")) + (P(),) * 8,
+            out_specs=(P(), P()), check_rep=False),
+            donate_argnums=(5,))
+        self._progs[key] = pr_prog
+        return pr_prog
+
+    def run_pagerank(self, esrc: np.ndarray, edst: np.ndarray, n: int, *,
+                     damping: float = 0.85, tol: float = 1e-6,
+                     max_iters: int = 100):
+        """Power iteration over rank-space edges, edge-sharded across the
+        mesh. esrc/edst: int32[E] node ranks (0..n). Returns (float32[n]
+        ranks, iterations). Host finalization (sort/top-k) stays with the
+        caller; the f32 iterate is checked against a NetworkX-tolerance
+        oracle, not bitwise."""
+        ncap = _fcap_for(max(n, 1))
+        es, ed, epc = self._shard_edges(esrc, edst, ncap)
+        outdeg = np.zeros(ncap, dtype=np.float32)
+        deg = np.bincount(esrc, minlength=n).astype(np.float32) \
+            if len(esrc) else np.zeros(n, np.float32)
+        outdeg[:n] = deg[:n]
+        dangling = np.zeros(ncap, dtype=np.float32)
+        dangling[:n] = (outdeg[:n] == 0).astype(np.float32)
+        outdeg = np.maximum(outdeg, 1.0)
+        live = np.zeros(ncap, dtype=np.float32)
+        live[:n] = 1.0
+        rank0 = np.zeros(ncap, dtype=np.float32)
+        rank0[:n] = 1.0 / max(n, 1)
+        pr_prog = self._pagerank_program(epc, ncap)
+        with otrace.span("device_kernel", kernel="mesh.pagerank",
+                         nodes=n, edges=len(esrc),
+                         devices=self.n_devices) as sp:
+            with self.mesh:
+                r, it = pr_prog(es, ed, jnp.asarray(outdeg),
+                             jnp.asarray(dangling), jnp.asarray(live),
+                             jnp.asarray(rank0), jnp.float32(max(n, 1)),
+                             jnp.float32(damping), jnp.float32(tol),
+                             jnp.int32(max_iters))
+            r_h, it_h = jax.device_get((r, it))
+            # own the bytes: device_get can hand back a zero-copy view of
+            # the program output, which aliases the donated carry buffer —
+            # its memory is reclaimed once `r` drops, so a view would decay
+            # to garbage under later allocation churn
+            r_h = np.array(r_h[:n], copy=True)
+            self._c_dispatch.inc()
+            self._c_edges.inc(len(esrc) * int(it_h))
+            if sp:
+                sp.set(iterations=int(it_h))
+        return r_h, int(it_h)
+
+    def _cc_program(self, epc: int, ncap: int):
+        key = ("cc", epc, ncap)
+        cc_prog = self._progs.get(key)
+        if cc_prog is not None:
+            return cc_prog
+        self._c_compiles.inc()
+        mesh = self.mesh
+
+        def run(esrc, edst, lab0, maxit):
+            def cond(c):
+                _l, it, ch = c
+                return ch & (it < maxit)
+
+            def body(c):
+                l, it, _ = c
+                le = jnp.take(l, esrc[0], mode="clip")
+                te = jnp.take(l, edst[0], mode="clip")
+                cand = jnp.full((ncap + 1,), jnp.int32(ncap))
+                cand = cand.at[edst[0]].min(le, mode="drop")
+                cand = cand.at[esrc[0]].min(te, mode="drop")
+                cand = lax.pmin(cand, "shard")[:ncap]
+                new = jnp.minimum(l, cand)
+                return new, it + 1, jnp.any(new != l)
+
+            l, it, _ = lax.while_loop(
+                cond, body, (lab0, jnp.int32(0), jnp.bool_(True)))
+            return l, it
+
+        cc_prog = jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=(P("shard"), P("shard"), P(), P()),
+            out_specs=(P(), P()), check_rep=False),
+            donate_argnums=(2,))
+        self._progs[key] = cc_prog
+        return cc_prog
+
+    def run_cc(self, esrc: np.ndarray, edst: np.ndarray, n: int, *,
+               max_iters: int = 0):
+        """Min-label propagation (undirected: both edge directions each
+        iteration) until fixpoint. Returns (int32[n] labels — the minimum
+        node rank of each component, so EXACT vs any host oracle,
+        iterations)."""
+        ncap = _fcap_for(max(n, 1))
+        es, ed, epc = self._shard_edges(esrc, edst, ncap)
+        lab0 = np.arange(ncap, dtype=np.int32)
+        maxit = max_iters or (n + 2)
+        cc_prog = self._cc_program(epc, ncap)
+        with otrace.span("device_kernel", kernel="mesh.cc",
+                         nodes=n, edges=len(esrc),
+                         devices=self.n_devices) as sp:
+            with self.mesh:
+                l, it = cc_prog(es, ed, jnp.asarray(lab0), jnp.int32(maxit))
+            l_h, it_h = jax.device_get((l, it))
+            # see run_pagerank: the labels view aliases the donated lab0
+            l_h = np.array(l_h[:n], copy=True)
+            self._c_dispatch.inc()
+            self._c_edges.inc(2 * len(esrc) * int(it_h))
+            if sp:
+                sp.set(iterations=int(it_h))
+        return l_h, int(it_h)
+
+    def _tri_program(self, rows_per: int, ncap: int):
+        key = ("tri", rows_per, ncap)
+        tri_prog = self._progs.get(key)
+        if tri_prog is not None:
+            return tri_prog
+        self._c_compiles.inc()
+        mesh = self.mesh
+
+        def run(arow, afull):
+            # trace(A^3) row-sharded: each shard contracts its row block
+            # against the replicated adjacency; /6 happens on the host
+            b = arow[0] @ afull
+            return lax.psum(jnp.sum(arow[0] * b), "shard")
+
+        tri_prog = jax.jit(shard_map(
+            run, mesh=mesh, in_specs=(P("shard"), P()),
+            out_specs=P(), check_rep=False))
+        self._progs[key] = tri_prog
+        return tri_prog
+
+    def run_triangles(self, esrc: np.ndarray, edst: np.ndarray, n: int):
+        """Dense trace(A^3)/6 on the mesh — row-sharded matmul over the
+        symmetrized 0/1 adjacency. Exact (counts are small ints in f32
+        range); the caller gates on n (dense A is O(n^2) replicated)."""
+        from jax.sharding import NamedSharding
+
+        S = self.n_devices
+        ncap = max(_fcap_for(max(n, 1)), S)
+        a = np.zeros((ncap, ncap), dtype=np.float32)
+        a[esrc, edst] = 1.0
+        a[edst, esrc] = 1.0
+        np.fill_diagonal(a, 0.0)
+        rows_per = ncap // S
+        sh = NamedSharding(self.mesh, P("shard"))
+        arow = jax.device_put(a.reshape(S, rows_per, ncap), sh)
+        tri_prog = self._tri_program(rows_per, ncap)
+        with otrace.span("device_kernel", kernel="mesh.triangles",
+                         nodes=n, edges=len(esrc),
+                         devices=self.n_devices) as sp:
+            with self.mesh:
+                t = tri_prog(arow, jnp.asarray(a))
+            t_h = float(jax.device_get(t))
+            self._c_dispatch.inc()
+            self._c_edges.inc(len(esrc))
+            tri = int(round(t_h / 6.0))
+            if sp:
+                sp.set(triangles=tri)
+        return tri

@@ -795,7 +795,28 @@ class Executor:
             csrs.append(csr)
         if hops:
             self._mesh_touched = True
-        if len(hops) < 2:
+        # terminal stage eligibility: the groupby rides only when the
+        # whole chain fused up to it AND the key tablet is mesh-owned —
+        # otherwise the hops still fuse and the groupby assembles classic
+        term = ir.terminal if (ir.terminal is not None
+                               and len(hops) == len(ir.hops)) else None
+        tcsr = None
+        if term is not None:
+            tpd = self.snap.pred(term.key_attr)
+            kc = tpd.csr if tpd is not None else None
+            if kc is not None and self.mesh.owns(kc):
+                tcsr = kc
+            else:
+                from dgraph_tpu.storage.delta import OverlayCSR
+
+                if isinstance(kc, OverlayCSR):
+                    reason = reason or fp.REASON_OVERLAY
+                elif getattr(kc, "_mesh_deferred", False):
+                    reason = reason or fp.REASON_BUDGET
+                term = None
+        # one hop + a terminal reduce still beats two dispatches; a bare
+        # single hop does not
+        if len(hops) < (1 if tcsr is not None else 2):
             if reason is not None:
                 self._mesh_miss(reason)
             return False
@@ -808,12 +829,52 @@ class Executor:
             # frontier, which is exactly the semantics to preserve
             self._mesh_miss(fp.REASON_FILTER)
             return False
+        terminal = None
+        kept_aggs: list = []
+        if tcsr is not None:
+            # per-agg value planes in the key tablet's sharded row layout
+            # (local row j of shard s ↔ host mirror row s*rows_per+j);
+            # non-numeric val vars (datetime/string) drop that agg from
+            # the device ops — the host computes it anyway
+            from dgraph_tpu.utils.types import to_device_scalar
+
+            subs_h, _ip, _ix = tcsr.host_arrays()
+            rows_cap = self.mesh.n_devices * tcsr.rows_per
+            tops: list = []
+            tavals: list = []
+            for op, ref, cgq in term.aggs:
+                plane = np.full(rows_cap, np.nan, dtype=np.float32)
+                vv = self.vars.get(ref)
+                vals = getattr(vv, "vals", None) if vv is not None else None
+                if vals:
+                    try:
+                        u = np.asarray(list(vals.keys()), dtype=np.int64)
+                        v = np.asarray(
+                            [float(to_device_scalar(x)) if isinstance(x, Val)
+                             else float(x) for x in vals.values()],
+                            dtype=np.float64)
+                    except (TypeError, ValueError):
+                        continue
+                    r_ = us.host_rank_of(subs_h, np.sort(u), -1)
+                    order_ = np.argsort(u, kind="stable")
+                    hit_ = r_ >= 0
+                    plane[r_[hit_]] = v[order_][hit_].astype(np.float32)
+                tops.append(op)
+                tavals.append(plane.reshape(self.mesh.n_devices,
+                                            tcsr.rows_per))
+                kept_aggs.append((op, ref, cgq))
+            terminal = (tcsr, tuple(tops), tavals)
         with costs.kernel("mesh.plan") as ck:
-            levels = self.gated(
-                lambda: self.mesh.run_plan(
-                    [(c, h.formula, s, h.first, h.offset)
-                     for c, h, s in zip(csrs, hops, sets)], frontier),
-                klass="mesh")
+            run = lambda: self.mesh.run_plan(
+                [(c, h.formula, s, h.first, h.offset)
+                 for c, h, s in zip(csrs, hops, sets)], frontier,
+                terminal=terminal)
+            got = self.gated(run, klass="mesh")
+        term_out = None
+        if terminal is not None:
+            levels, term_out = got
+        else:
+            levels = got
         lg = costs.current()
         if lg is not None and ck.ms > 0:
             # ONE launch traversed every hop: apportion its device ms to
@@ -821,10 +882,14 @@ class Executor:
             # /debug/top?group=pred points at the tablet actually burning
             # the device instead of whichever predicate led the chain
             trav = [max(int(lv[1]), 0) for lv in levels[: len(hops)]]
+            preds = [hop.gq.attr for hop in hops]
+            if term_out is not None:
+                trav.append(max(int(term_out["traversed"]), 0))
+                preds.append(term.key_attr)
             tot = float(sum(trav))
-            for hop, t in zip(hops, trav):
-                frac = (t / tot) if tot > 0 else 1.0 / len(hops)
-                lg.attribute_pred_ms(hop.gq.attr, ck.ms * frac)
+            for a, t in zip(preds, trav):
+                frac = (t / tot) if tot > 0 else 1.0 / len(preds)
+                lg.attribute_pred_ms(a, ck.ms * frac)
         self._mesh_fused += 1
         parent = sg
         fr = frontier
@@ -883,6 +948,20 @@ class Executor:
                 # the host replay would mean a program bug — the host
                 # mirrors are the truth the classic path serves from
                 raise QueryError("mesh fused frontier diverged")
+        if term_out is not None:
+            # the device terminal's per-rank member counts + f32 agg
+            # candidates ride to the host groupby assembly (which stays
+            # authoritative) for the byte-identity cross-check
+            parent._fused_gb = {
+                "table": term_out["table"],
+                "counts": term_out["counts"],
+                "aggs": {id(cgq): {"op": op,
+                                   "cand": term_out["aggs"][i][0],
+                                   "cntv": term_out["aggs"][i][1]}
+                         for i, (op, _ref, cgq) in enumerate(kept_aggs)},
+            }
+            self.mesh.metrics.counter(
+                "dgraph_agg_terminal_ops_total").inc()
         # the last chain hop's own subtree (and @cascade) continues classic
         if hops[-1].gq.children or hops[-1].gq.cascade:
             self._finish_level(parent, is_root=False)

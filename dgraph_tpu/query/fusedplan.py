@@ -62,7 +62,11 @@ REASON_LANG = "lang"              # @lang on a uid expansion
 REASON_CASCADE = "cascade"        # @cascade on an intermediate hop
 REASON_BUDGET = "budget"          # residency deferred the tablet's shards
 REASON_VAR = "var"                # filter reads a var defined in this block
-REASON_SHAPE = "shape"            # branching chains / groupby / expand()
+REASON_SHAPE = "shape"            # branching chains / expand()
+REASON_GROUPBY = "groupby"        # groupby shape outside the terminal regime
+#                                   (multi-key / value key / lang / cascade)
+REASON_AGG = "agg"                # aggregation child outside the terminal
+#                                   ops (datetime min/max, string vals, ...)
 REASON_DEPTH = "depth"            # recurse depth past the fused scan cap
 REASON_MULTI_PRED = "multi_pred"  # multi-predicate @recurse (depth-first
 #                                   dedup order is inherently sequential)
@@ -115,17 +119,35 @@ class HopIR:
 
 
 @dataclass
+class TerminalIR:
+    """The chain's terminal segmented-reduce stage: a single-uid-key
+    @groupby whose count(uid) / numeric __agg_* children reduce ON DEVICE
+    into the key tablet's rank space as one more stage of the same mesh
+    dispatch. The host assembly (query/groupby.py) stays authoritative —
+    the device per-rank member counts and f32 agg candidates ride back
+    for the byte-identity cross-check, and "top posters among
+    friends-of-friends" becomes ONE dispatch end to end."""
+
+    gq: dql.GraphQuery        # the groupby-bearing hop (id() keys plans)
+    key_attr: str             # the single uid-type group-key predicate
+    aggs: list = field(default_factory=list)  # [(op, val_ref, child_gq)]
+    has_count: bool = False
+
+
+@dataclass
 class ChainIR:
     """A maximal fusable chain below one block level. hops < 2 means the
-    fused program buys nothing over the single per-task dispatch; the
-    stop reason (when set) names the feature that truncated the walk —
-    recorded as a labeled fallback only when it actually cost fusion."""
+    fused program buys nothing over the single per-task dispatch (one hop
+    + a terminal stage does); the stop reason (when set) names the
+    feature that truncated the walk — recorded as a labeled fallback only
+    when it actually cost fusion."""
 
     hops: list[HopIR] = field(default_factory=list)
     stop_reason: str | None = None
     # True when the rejected/terminal node's subtree holds MORE fusable
     # expansions — i.e. the stop reason truncated a real chain
     stop_cost: bool = False
+    terminal: TerminalIR | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -291,6 +313,36 @@ def _subtree_has_expansion(gq: dql.GraphQuery, schema) -> bool:
                _subtree_has_expansion(c, schema) for c in gq.children)
 
 
+def _terminal_ir(cont: dql.GraphQuery, schema):
+    """(TerminalIR, None) when the groupby can compile as a terminal
+    segmented-reduce stage, else (None, labeled reason). Eligible shape:
+    exactly one plain uid-type group key (the rank space) and children
+    limited to count(uid) plus __agg_* sum/min/max/avg over val vars —
+    type/exactness gating of each agg happens at execution (the host
+    stays authoritative either way)."""
+    gb = cont.groupby
+    if len(gb.attrs) != 1 or cont.cascade:
+        return None, REASON_GROUPBY
+    _alias, attr, lang = gb.attrs[0]
+    if lang or attr.startswith("~") or \
+            schema.type_of(attr) != TypeID.UID:
+        return None, REASON_GROUPBY
+    aggs: list = []
+    has_count = False
+    for c in cont.children:
+        if c.is_uid_node and c.is_count:
+            has_count = True
+            continue
+        if c.attr.startswith("__agg_") and c.val_ref:
+            op = c.attr[len("__agg_"):]
+            if op in ("sum", "min", "max", "avg"):
+                aggs.append((op, c.val_ref, c))
+                continue
+        return None, REASON_AGG
+    return TerminalIR(gq=cont, key_attr=attr, aggs=aggs,
+                      has_count=has_count), None
+
+
 def chain_ir(gq: dql.GraphQuery, schema) -> ChainIR:
     """The maximal fusable chain under one root block: walk the unique
     uid-expansion continuation per level, compiling each into a HopIR.
@@ -320,8 +372,23 @@ def chain_ir(gq: dql.GraphQuery, schema) -> ChainIR:
                     _subtree_has_expansion(cands[0], schema)
         cont = cands[0]
         if cont.groupby is not None:
-            ir.stop_reason = ir.stop_reason or REASON_SHAPE
-            ir.stop_cost = ir.stop_cost or bool(ir.hops)
+            # terminal regime: a single-uid-key groupby whose children
+            # are count(uid) / numeric __agg_* rides the chain as a
+            # TERMINAL segmented-reduce stage; every other groupby shape
+            # stays classic under its own labeled reason
+            term, why = _terminal_ir(cont, schema)
+            hop = None
+            if term is not None:
+                try:
+                    hop = _hop_ir(cont, schema, defined)
+                except Unfusable as e:
+                    term, why = None, e.reason
+            if term is None:
+                ir.stop_reason = ir.stop_reason or why
+                ir.stop_cost = ir.stop_cost or bool(ir.hops)
+                break
+            ir.hops.append(hop)
+            ir.terminal = term
             break
         try:
             hop = _hop_ir(cont, schema, defined)

@@ -29,14 +29,28 @@ VECTORIZE = True    # tests flip to force the per-uid reference path
 # device dispatch's fixed + sync latency (~100-150 ms through the relay)
 _HOST_AGG_MAX = 1 << 17
 
+# groupby key expansions pin the HOST mirrors (resolve_leaf's "task"
+# idiom): under whole-plan fusion the aggregation already reduced on the
+# mesh — the host assembly must not cost a second device dispatch
+_PIN_HOST = 1 << 62
+
 
 def process_groupby(ex, sg) -> None:
     """Fill sg.group_result for a level with @groupby."""
     gq = sg.gq
+    sg.group_result = _build_group_rows(ex, sg)
+    if ex.plan is not None:
+        # EXPLAIN: the planner's groupby terminal step (keyed on the
+        # GroupBy AST node) records the actual group count
+        ex.plan.record(gq.groupby, len(sg.group_result), ex.explain)
+
+
+def _build_group_rows(ex, sg) -> list[dict]:
+    gq = sg.gq
+    fused = getattr(sg, "_fused_gb", None)
     uids = np.sort(sg.dest_uids)
     if len(uids) == 0:
-        sg.group_result = []
-        return
+        return []
 
     # vectorized fast path: a single NUMERIC value key groups via one
     # searchsorted + np.unique over the exact float64 mirror — no per-uid
@@ -45,9 +59,8 @@ def process_groupby(ex, sg) -> None:
     fast = _numeric_single_key_groups(ex, gq, uids)
     if fast is not None:
         keys_sorted, members_per, alias = fast
-        sg.group_result = _assemble_rows(
-            ex, gq, [{alias: kv} for kv in keys_sorted], members_per)
-        return
+        return _assemble_rows(
+            ex, gq, [{alias: kv} for kv in keys_sorted], members_per, fused)
 
     # vectorized GENERAL path (r5): every column — string/bool/datetime
     # value keys and multi-valued uid keys alike — factorizes to dense int
@@ -60,8 +73,7 @@ def process_groupby(ex, sg) -> None:
         vec = _vectorized_groups(ex, gq, uids)
         if vec is not None:
             row_seeds, members_per = vec
-            sg.group_result = _assemble_rows(ex, gq, row_seeds, members_per)
-            return
+            return _assemble_rows(ex, gq, row_seeds, members_per, fused)
 
     # group keys per uid, one column per groupby attr
     columns: list[tuple[str, dict[int, Any]]] = []  # (alias, uid -> key val)
@@ -70,7 +82,8 @@ def process_groupby(ex, sg) -> None:
         pd = ex.snap.pred(attr)
         tid = ex.schema.type_of(attr)
         if tid == TypeID.UID or (pd is not None and pd.csr is not None):
-            res = ex._dispatch(TaskQuery(attr, frontier=uids))
+            res = ex._dispatch(TaskQuery(attr, frontier=uids,
+                                         cutover=_PIN_HOST))
             for u, targets in zip(uids, res.uid_matrix):
                 for t in targets:
                     col.setdefault(int(u), []).append(int(t))
@@ -114,7 +127,7 @@ def process_groupby(ex, sg) -> None:
         for (alias, _col), kv in zip(columns, key):
             row[alias] = kv if not isinstance(kv, tuple) else kv[1]
         seeds.append(row)
-    sg.group_result = _assemble_rows(ex, gq, seeds, members_per)
+    return _assemble_rows(ex, gq, seeds, members_per, fused)
 
 
 def _pred_value_codes(pd):
@@ -149,6 +162,27 @@ def _pred_value_codes(pd):
         codes[i] = c
     pd._gb_codes = (vsub, codes, displays, ok)
     return pd._gb_codes
+
+
+def _uid_key_table(pd):
+    """(sorted distinct-target table int64, hex display list) of a uid-key
+    predicate — cached once per immutable CSR. Group codes become one
+    rank lookup per edge against this table; it is also the rank space the
+    fused mesh terminal reduces into, so host group order and device
+    segment ids agree by construction."""
+    csr = pd.csr if pd is not None else None
+    if csr is None:
+        return None
+    got = getattr(csr, "_gb_tgt", None)
+    if got is not None:
+        return got
+    try:
+        _sub, _ptr, idx = csr.host_arrays()
+    except (AttributeError, ValueError):
+        return None
+    tbl = np.unique(np.asarray(idx, dtype=np.int64))
+    csr._gb_tgt = (tbl, [hex(int(t)) for t in tbl])
+    return csr._gb_tgt
 
 
 def _cartesian_join(a_uidx, a_code, b_uidx, b_code, kb: int, n_uids: int):
@@ -204,14 +238,29 @@ def _vectorized_groups(ex, gq, uids: np.ndarray):
         pd = ex.snap.pred(attr)
         tid = ex.schema.type_of(attr)
         if tid == TypeID.UID or (pd is not None and pd.csr is not None):
-            res = ex._dispatch(TaskQuery(attr, frontier=uids))
+            res = ex._dispatch(TaskQuery(attr, frontier=uids,
+                                         cutover=_PIN_HOST))
             counts = np.asarray([len(r) for r in res.uid_matrix], np.int64)
             flat = (np.concatenate([np.asarray(r, np.int64)
                                     for r in res.uid_matrix])
                     if counts.sum() else np.zeros(0, np.int64))
             uidx = np.repeat(np.arange(n), counts)
-            targets, code = np.unique(flat, return_inverse=True)
-            displays = [hex(int(t)) for t in targets]
+            # rank-space coding: codes are ranks in the tablet's cached
+            # distinct-target table (one searchsorted — host below the
+            # device cutover, segments._rank_kernel above it) instead of a
+            # fresh per-query np.unique sort; targets the table does not
+            # know (overlay-added edges) fall back to the sort
+            code = displays = None
+            tbl = _uid_key_table(pd)
+            if tbl is not None and len(flat):
+                from dgraph_tpu.ops import segments as segs
+
+                pos, hitt = segs.rank_in_table(tbl[0], flat)
+                if hitt.all():
+                    code, displays = pos, tbl[1]
+            if code is None:
+                targets, code = np.unique(flat, return_inverse=True)
+                displays = [hex(int(t)) for t in targets]
             single = False          # multi-valued: dedup members later
         else:
             vsub, vcodes, displays, vok = _pred_value_codes(pd)
@@ -296,11 +345,24 @@ def _host_segment_reduce(op: str, seg: np.ndarray, vals: np.ndarray,
     return np.where(cnt == 0, np.nan, out)
 
 
-def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
+def _count_metric(ex, name: str) -> None:
+    m = getattr(ex.snap, "metrics", None)
+    if m is not None:
+        m.counter(name).inc()
+
+
+def _batch_aggregates(ex, children, members_per: list[np.ndarray],
+                      fused=None, ranks=None) -> dict:
     """Per-child batched aggregation: {id(child): [row_dict per group]}.
 
     Children whose op/type can't run on the float64 lattice are omitted —
-    the caller falls back to the per-group path for those."""
+    the caller falls back to the per-group path for those.
+
+    fused/ranks: the stashed device terminal of a whole-plan mesh fusion
+    (engine._mesh_fused_plan) plus each group's rank in its key table.
+    The host stays authoritative (no second dispatch); wherever the
+    f32-exactness rule holds the device candidates are cross-checked
+    against the host result and any disagreement is a hard error."""
     from dgraph_tpu.ops import segments as segs
     from dgraph_tpu.query.outputnode import _val_json
     from dgraph_tpu.utils.types import to_device_scalar
@@ -308,8 +370,7 @@ def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
     ng = len(members_per)
     if ng == 0:
         return {}
-    seg_ids = np.repeat(np.arange(ng, dtype=np.int32),
-                        [len(m) for m in members_per])
+    lens = np.asarray([len(m) for m in members_per], dtype=np.int64)
     flat = np.concatenate(members_per) if ng else np.zeros(0, np.int64)
     out: dict = {}
     for cgq in children:
@@ -335,17 +396,24 @@ def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
         posc = np.clip(pos, 0, max(len(vuids) - 1, 0))
         hit = (len(vuids) > 0) & (vuids[posc] == flat)
         all_int = tids <= {TypeID.INT}
-        if (all_int and np.abs(vals64).sum() < 2 ** 24
-                and len(flat) > _HOST_AGG_MAX):
-            # exact in f32: one fused device reduction (only worth the
+        f32_exact = all_int and np.abs(vals64).sum() < 2 ** 24
+        if fused is None and f32_exact and len(flat) > _HOST_AGG_MAX:
+            # exact in f32: one fused device reduction with segment ids
+            # derived ON DEVICE from the group lengths (only worth the
             # fixed dispatch+sync cost above the host crossover — the
             # same size-adaptive rule as task.HOST_EXPAND_MAX)
             x = np.where(hit, vals64[posc], np.nan).astype(np.float32)
-            res = segs.group_reduce(op, seg_ids, x, ng)
+            res = segs.fused_group_reduce((op,), x, lens, ng)[op]
+            _count_metric(ex, "dgraph_agg_device_reduces_total")
         else:
             # float64 exactness the device lattice can't give (x64 off):
             # vectorized host segmented reduction, same semantics
-            res = _host_segment_reduce(op, seg_ids[hit], vals64[posc[hit]], ng)
+            seg_ids = np.repeat(np.arange(ng, dtype=np.int32), lens)
+            res = _host_segment_reduce(op, seg_ids[hit], vals64[posc[hit]],
+                                       ng)
+            _count_metric(ex, "dgraph_agg_host_reduces_total")
+        if fused is not None and ranks is not None:
+            _check_fused_agg(fused, cgq, op, res, ranks, f32_exact)
         name = cgq.alias or f"{op}(val({cgq.val_ref}))"
         rows = []
         for g in range(ng):
@@ -364,11 +432,58 @@ def _batch_aggregates(ex, children, members_per: list[np.ndarray]) -> dict:
     return out
 
 
+def _check_fused_agg(fused, cgq, op, res, ranks, f32_exact) -> None:
+    """Cross-check a device terminal agg candidate against the host's
+    authoritative f64 result. Only where the f32-exactness rule holds —
+    outside it the candidates are best-effort and skipped."""
+    cand = fused.get("aggs", {}).get(id(cgq))
+    if cand is None or not f32_exact:
+        return
+    from dgraph_tpu.query.engine import QueryError
+
+    vals = np.asarray(cand["cand"], dtype=np.float64)[ranks]
+    cntv = np.asarray(cand["cntv"], dtype=np.float64)[ranks]
+    empty = np.isnan(res)
+    if np.any(empty & (cntv != 0)):
+        raise QueryError("mesh fused aggregation diverged (empty groups)")
+    got = vals
+    if op == "avg":
+        got = vals / np.maximum(cntv, 1.0)
+    if not np.array_equal(got[~empty], res[~empty]):
+        raise QueryError("mesh fused aggregation diverged")
+
+
+def _fused_check_counts(fused, row_seeds, members_per) -> np.ndarray:
+    """Map each host group to its rank in the device terminal's key table
+    and require the device per-rank member counts to agree EXACTLY with
+    the host replay — the byte-identity invariant of the fused terminal.
+    Returns the per-group rank vector for the agg cross-checks."""
+    from dgraph_tpu.query.engine import QueryError
+
+    table = fused["table"]
+    counts = np.asarray(fused["counts"], dtype=np.int64)
+    keys = np.asarray(
+        [int(next(iter(r.values()), "0x0"), 16) for r in row_seeds],
+        dtype=np.int64)
+    pos = np.searchsorted(table, keys)
+    bad = (pos >= len(table)) | (pos < 0)
+    if bad.any() or (len(keys) and not np.array_equal(table[pos], keys)):
+        raise QueryError("mesh fused groupby terminal diverged (keys)")
+    host_counts = np.asarray([len(m) for m in members_per], dtype=np.int64)
+    if not np.array_equal(counts[pos], host_counts) \
+            or np.count_nonzero(counts) != len(keys):
+        raise QueryError("mesh fused groupby terminal diverged (counts)")
+    return pos
+
+
 def _assemble_rows(ex, gq, row_seeds: list[dict],
-                   members_per: list[np.ndarray]) -> list[dict]:
+                   members_per: list[np.ndarray], fused=None) -> list[dict]:
     """Attach each group's child aggregates to its key row (shared by the
     vectorized and generic grouping paths)."""
-    batched = _batch_aggregates(ex, gq.children, members_per)
+    ranks = None
+    if fused is not None:
+        ranks = _fused_check_counts(fused, row_seeds, members_per)
+    batched = _batch_aggregates(ex, gq.children, members_per, fused, ranks)
     for gi, row in enumerate(row_seeds):
         for cgq in gq.children:
             got = batched.get(id(cgq))

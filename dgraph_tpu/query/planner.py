@@ -399,16 +399,50 @@ def _plan_block(plan: Plan, gq, snap, schema, metrics, trace,
         plan.fused_chains[id(gq)] = fusedplan.chain_ir(gq, schema)
     children = _plan_children(plan, gq, snap, schema, metrics, trace,
                               max(dest_est, 1))
-    return {"block": gq.alias or gq.attr or "q",
-            "root": _step_ref(gq, root_step),
-            "est_dest": int(dest_est),
-            "filters": filt_steps,
-            "children": children}
+    out = {"block": gq.alias or gq.attr or "q",
+           "root": _step_ref(gq, root_step),
+           "est_dest": int(dest_est),
+           "filters": filt_steps,
+           "children": children}
+    if frontier_est is None and gq.groupby is not None:
+        out["groupby"] = _plan_groupby(plan, gq, snap, schema, metrics,
+                                       int(dest_est))
+    return out
 
 
 def _step_ref(node, step: Step) -> dict:
     return {"sid": id(node), "desc": step.desc, "est": step.est,
             **step.extra}
+
+
+def _plan_groupby(plan: Plan, gq, snap, schema, metrics,
+                  members_est: int) -> dict:
+    """EXPLAIN step for a @groupby terminal: estimated group count =
+    product of the key predicates' distinct-target cardinalities (uid
+    keys: the reverse tablet's subject count; value keys: the value-table
+    cardinality), capped by the member estimate — a level can't produce
+    more non-empty groups than members. Recorded against the GroupBy AST
+    node (query/groupby.process_groupby), so est-vs-actual renders like
+    every other step."""
+    est = 1
+    for _alias, attr, _lang in gq.groupby.attrs:
+        rev = attr.startswith("~")
+        pd = snap.pred(attr[1:] if rev else attr)
+        if pd is None:
+            card = 1
+        else:
+            st = stmod.pred_stats(pd, metrics)
+            card = (st.fwd.n_subjects if rev else st.rev.n_subjects) \
+                or st.value_count or 1
+        est *= max(int(card), 1)
+    est = int(min(est, max(members_est, 1)))
+    keys = ",".join(a for _x, a, _l in gq.groupby.attrs) or "()"
+    naggs = sum(1 for c in gq.children
+                if c.attr.startswith("__agg_") or
+                (c.is_uid_node and c.is_count))
+    step = Step("groupby", keys, est, {"aggs": naggs})
+    plan.nodes[id(gq.groupby)] = step
+    return _step_ref(gq.groupby, step)
 
 
 def _maybe_swap_root(plan: Plan, gq, snap, schema, metrics, trace,
@@ -563,6 +597,9 @@ def _plan_children(plan: Plan, gq, snap, schema, metrics, trace,
         ref = _step_ref(cgq, step)
         if cut:
             ref["cutover"] = cut
+        if cgq.groupby is not None:
+            ref["groupby"] = _plan_groupby(plan, cgq, snap, schema,
+                                           metrics, est_edges)
         # nested levels: plan the grandchildren's filters/expansions too
         if cgq.children or cgq.filter is not None:
             child_frontier = max(min(est_edges,

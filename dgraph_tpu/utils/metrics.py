@@ -392,7 +392,17 @@ class Registry:
                      "dgraph_fold_inline_total",
                      "dgraph_fold_pending_tablets",
                      "dgraph_cold_open_ms",
-                     "dgraph_first_query_ms"):
+                     "dgraph_first_query_ms",
+                     # device aggregation + whole-graph analytics
+                     # (ops/segments.py, query/groupby.py,
+                     # query/analytics.py; ISSUE 17)
+                     "dgraph_agg_device_reduces_total",
+                     "dgraph_agg_host_reduces_total",
+                     "dgraph_agg_terminal_ops_total",
+                     "dgraph_analytics_runs_total",
+                     "dgraph_analytics_host_fallbacks_total",
+                     "dgraph_analytics_iterations_total",
+                     "dgraph_analytics_edges_total"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
@@ -428,7 +438,9 @@ class Registry:
                      "dgraph_http_mutate_latency_s",
                      "dgraph_http_commit_latency_s",
                      "dgraph_http_abort_latency_s",
-                     "dgraph_http_alter_latency_s"):
+                     "dgraph_http_alter_latency_s",
+                     "dgraph_analytics_latency_s",
+                     "dgraph_http_analytics_latency_s"):
             self.histograms[name] = Histogram(
                 buckets=default_buckets(name))
 

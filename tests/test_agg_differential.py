@@ -1,0 +1,181 @@
+"""Differential aggregation property test (ISSUE 17 satellite): the
+device segmented-reduce suite (ops/segments) against the reference typed
+aggregator (query/aggregator.aggregate) across an int / float / datetime /
+empty-group / missing-value grid; pins the f32-exactness crossover
+(all-int values, |sum| < 2**24) that gates the device path in
+query/groupby._batch_aggregates, and the NaN-for-empty contract."""
+
+import datetime as dt
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.ops import segments as segs
+from dgraph_tpu.query import groupby as gbmod
+from dgraph_tpu.query.aggregator import aggregate
+from dgraph_tpu.utils.types import TypeID, Val, to_device_scalar
+
+OPS = ("sum", "min", "max", "avg", "count")
+
+
+def _scenarios():
+    rng = np.random.default_rng(24)
+    out = []
+    for kind in ("int_small", "int_edge", "float", "missing"):
+        groups = []
+        for g in range(9):
+            k = int(rng.integers(0, 7))      # group 0.. may be empty
+            vals = []
+            for _ in range(k):
+                if kind == "int_small":
+                    vals.append(Val(TypeID.INT, int(rng.integers(-1000, 1000))))
+                elif kind == "int_edge":
+                    vals.append(Val(TypeID.INT, int(rng.integers(0, 1 << 20))))
+                elif kind == "float":
+                    vals.append(Val(TypeID.FLOAT,
+                                    float(rng.normal()) * 10.0))
+                else:   # missing: ~40% of members carry no value
+                    vals.append(None if rng.random() < 0.4 else
+                                Val(TypeID.INT, int(rng.integers(0, 100))))
+            groups.append(vals)
+        out.append((kind, groups))
+    return out
+
+
+def _device(op, groups):
+    """groups of Val|None → fused_group_reduce over the NaN-coded flat
+    vector, exactly as groupby._batch_aggregates feeds it."""
+    lens = [len(g) for g in groups]
+    flat = np.asarray([np.nan if v is None else float(to_device_scalar(v))
+                       for g in groups for v in g], dtype=np.float64)
+    return segs.fused_group_reduce((op,), flat, lens, len(groups))[op]
+
+
+@pytest.mark.parametrize("op", OPS)
+@pytest.mark.parametrize("kind,groups", _scenarios(),
+                         ids=[k for k, _ in _scenarios()])
+def test_device_matches_reference_aggregator(op, kind, groups):
+    got = _device(op, groups)
+    for g, vals in enumerate(groups):
+        live = [v for v in vals if v is not None]
+        if op == "count":
+            assert got[g] == len(live)       # count: 0 for empty, exact
+            continue
+        ref = aggregate(op, vals)
+        if ref is None:
+            assert np.isnan(got[g]), "empty group must yield NaN"
+            continue
+        want = float(ref.value)
+        all_int = all(v.tid == TypeID.INT for v in live)
+        exact = all_int and sum(abs(float(v.value)) for v in live) < 2 ** 24
+        if exact:
+            assert got[g] == want, (op, kind, g)
+        else:
+            assert got[g] == pytest.approx(want, rel=1e-5, abs=1e-5)
+
+
+def test_group_reduce_matches_fused_path():
+    """The single-op host-segment-id entry agrees with the fused
+    device-derived-segment-id entry bit-for-bit."""
+    _kind, groups = _scenarios()[0]
+    lens = np.asarray([len(g) for g in groups])
+    seg_ids = np.repeat(np.arange(len(groups)), lens)
+    flat = np.asarray([float(to_device_scalar(v)) for g in groups
+                       for v in g])
+    for op in OPS:
+        a = np.asarray(segs.group_reduce(op, seg_ids, flat, len(groups)),
+                       np.float64)
+        b = np.asarray(_device(op, groups), np.float64)
+        assert np.array_equal(a, b, equal_nan=True), op
+
+
+def test_datetime_min_max_stays_on_reference_path():
+    """min/max over datetimes returns the original Val (the device f32
+    lattice can't); the epoch ordering still matches, so the device
+    candidate — if it ever ran — would pick the same element."""
+    vals = [Val(TypeID.DATETIME, dt.datetime(2020 + i, 3, 1 + 2 * i))
+            for i in (3, 0, 5, 1)]
+    ref = aggregate("min", vals)
+    assert ref.tid == TypeID.DATETIME and ref.value.year == 2020
+    epochs = [to_device_scalar(v) for v in vals]
+    got = _device("min", [vals])
+    assert float(got[0]) == pytest.approx(min(epochs))
+    # groupby's execution gate: min/max over non-numeric tids skips the
+    # device branch so the original Val survives in the response
+    assert not ({v.tid for v in vals} <= {TypeID.INT, TypeID.FLOAT})
+
+
+def test_f32_crossover_pin():
+    """|sum| >= 2**24 is exactly where f32 accumulation starts dropping
+    units — the gate in groupby._batch_aggregates must sit there."""
+    assert gbmod._HOST_AGG_MAX == 1 << 17
+    below = [Val(TypeID.INT, (1 << 24) - 2), Val(TypeID.INT, 1)]
+    above = [Val(TypeID.INT, 1 << 24), Val(TypeID.INT, 1)]
+    assert float(np.float32((1 << 24) - 2) + np.float32(1)) == \
+        float((1 << 24) - 1)
+    assert float(np.float32(1 << 24) + np.float32(1)) != (1 << 24) + 1
+    s_below = sum(abs(float(v.value)) for v in below)
+    s_above = sum(abs(float(v.value)) for v in above)
+    assert s_below < 2 ** 24 <= s_above
+    # the fused device path itself is exact right up to the boundary
+    assert _device("sum", [below])[0] == (1 << 24) - 1
+
+
+def _fake_ex(vals_by_uid, metrics=None):
+    vv = SimpleNamespace(vals=vals_by_uid)
+    return SimpleNamespace(vars={"x": vv},
+                           snap=SimpleNamespace(metrics=metrics))
+
+
+def _agg_child(op):
+    return SimpleNamespace(attr=f"__agg_{op}", val_ref="x", alias=None,
+                           is_uid_node=False, is_count=False)
+
+
+class _Counter:
+    def __init__(self):
+        self.n = {}
+
+    def counter(self, name):
+        c = self.n.setdefault(name, SimpleNamespace(v=0))
+        return SimpleNamespace(inc=lambda k=1, c=c: setattr(c, "v", c.v + k))
+
+
+def test_batch_aggregates_routes_device_vs_host(monkeypatch):
+    """Below the crossover (and past the size floor) the device reduce
+    answers; at/above it, or for float values, the f64 host lattice
+    does — observable via the device/host reduce counters."""
+    monkeypatch.setattr(gbmod, "_HOST_AGG_MAX", 0)
+    members = [np.asarray([1, 2], np.int64), np.asarray([3], np.int64)]
+
+    m = _Counter()
+    ex = _fake_ex({1: Val(TypeID.INT, 5), 2: Val(TypeID.INT, 7),
+                   3: Val(TypeID.INT, 11)}, metrics=m)
+    child = _agg_child("sum")
+    out = gbmod._batch_aggregates(ex, [child], members)
+    rows = out[id(child)]
+    assert rows[0] == {"sum(val(x))": 12} and rows[1] == {"sum(val(x))": 11}
+    assert m.n["dgraph_agg_device_reduces_total"].v == 1
+
+    m2 = _Counter()
+    ex2 = _fake_ex({1: Val(TypeID.INT, 1 << 24), 2: Val(TypeID.INT, 1),
+                    3: Val(TypeID.INT, 2)}, metrics=m2)
+    child2 = _agg_child("sum")
+    out2 = gbmod._batch_aggregates(ex2, [child2], members)
+    assert out2[id(child2)][0] == {"sum(val(x))": (1 << 24) + 1}
+    assert "dgraph_agg_device_reduces_total" not in m2.n
+    assert m2.n["dgraph_agg_host_reduces_total"].v == 1
+
+
+def test_batch_aggregates_empty_group_omits_row(monkeypatch):
+    """NaN-for-empty surfaces as an empty row dict — the aggregate key is
+    absent, matching the reference's 'aggregate of nothing is absent'."""
+    monkeypatch.setattr(gbmod, "_HOST_AGG_MAX", 0)
+    members = [np.asarray([1], np.int64), np.asarray([9], np.int64)]
+    ex = _fake_ex({1: Val(TypeID.FLOAT, 2.5)})   # uid 9 carries no value
+    for op in ("sum", "min", "max", "avg"):
+        child = _agg_child(op)
+        rows = gbmod._batch_aggregates(ex, [child], members)[id(child)]
+        assert rows[1] == {}, op
+        assert list(rows[0].values()) == [2.5], op

@@ -1,0 +1,241 @@
+"""Device aggregation terminal stage (ISSUE 17): @groupby blocks whose
+children are count(uid) / numeric __agg_* compile as TERMINAL
+segmented-reduce ops of the whole-plan mesh program — byte-identical to
+classic, ONE dispatch for the whole chain including the aggregation,
+labeled fallback reasons for every non-terminal groupby shape, and
+EXPLAIN est-vs-actual rows for the aggregation step.
+
+Needs the conftest-provided 8-virtual-device CPU mesh."""
+
+import json
+
+import numpy as np
+import pytest
+import jax
+
+from dgraph_tpu.api.server import Node
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the conftest-provided 8-virtual-device CPU mesh")
+
+
+SCHEMA = """
+name: string @index(exact) .
+rating: float @index(float) .
+score: int @index(int) .
+p0: [uid] .
+p1: [uid] .
+p2: [uid] @reverse .
+follows: [uid] .
+"""
+
+
+def _quads():
+    rng = np.random.default_rng(17)
+    quads = [f'_:n{i} <name> "node{i}" .' for i in range(80)]
+    quads += [f'_:n{i} <rating> "{(i * 13) % 100 / 10}"^^<xs:float> .'
+              for i in range(80)]
+    # integer values on a subset only: some groupby members carry no
+    # value (the NaN-for-missing path) and some groups end up empty
+    quads += [f'_:n{i} <score> "{(i * 7) % 50}"^^<xs:int> .'
+              for i in range(80) if i % 5]
+    for i in range(80):
+        for attr, mul, off in (("p0", 3, 1), ("p1", 5, 2), ("p2", 7, 3)):
+            for k in range(3):
+                t = (i * mul + off + k) % 80
+                quads.append(f"_:n{i} <{attr}> _:n{t} .")
+        for j in sorted(rng.choice(80, size=3, replace=False)):
+            if j != i:
+                quads.append(f"_:n{i} <follows> _:n{j} .")
+    return "\n".join(quads)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    nodes = []
+    for mesh in (0, 8):
+        n = Node(mesh_devices=mesh, mesh_min_edges=1)
+        n.alter(schema_text=SCHEMA)
+        n.mutate(set_nquads=_quads(), commit_now=True)
+        n.task_cache = n.result_cache = None
+        nodes.append(n)
+    return nodes
+
+
+def _same(plain, mesh, q):
+    a, _ = plain.query(q)
+    b, _ = mesh.query(q)
+    assert json.dumps(a, sort_keys=True, default=str) == \
+        json.dumps(b, sort_keys=True, default=str), q
+    return a
+
+
+def _reasons(mesh):
+    return mesh.metrics.keyed("dgraph_mesh_fallbacks_total",
+                              labels=("reason",)).snapshot()
+
+
+# ---------------------------------------------------------------------------
+# terminal shapes: byte identity + ONE dispatch for chain + aggregation
+# ---------------------------------------------------------------------------
+
+TERMINAL_BATTERY = [
+    # count-only terminals at depth 1 and 2
+    '{ q(func: eq(name, "node3")) { p0 @groupby(p2) { count(uid) } } }',
+    '{ q(func: eq(name, "node3")) { p0 { p1 @groupby(p2) '
+    '{ count(uid) } } } }',
+    # filters upstream of the terminal
+    '{ q(func: eq(name, "node3")) { p0 @filter(ge(rating, 2.0)) '
+    '{ p1 @groupby(p2) { count(uid) } } } }',
+    # float aggregates over a val var (separate defining block)
+    '{ var(func: has(name)) { r as rating } '
+    '  q(func: eq(name, "node3")) { p0 { p1 @groupby(p2) '
+    '{ count(uid) s: sum(val(r)) m: min(val(r)) x: max(val(r)) '
+    '  a: avg(val(r)) } } } }',
+    # int aggregates with missing members (score absent on i % 5 == 0)
+    '{ var(func: has(name)) { sc as score } '
+    '  q(func: eq(name, "node3")) { p0 { p1 @groupby(p2) '
+    '{ count(uid) t: sum(val(sc)) mn: min(val(sc)) } } } }',
+    # aggregate-only terminal, no count child
+    '{ var(func: has(name)) { r as rating } '
+    '  q(func: eq(name, "node3")) { p0 @groupby(p2) '
+    '{ x: max(val(r)) } } }',
+]
+
+
+def test_terminal_battery_byte_identical_one_dispatch(pair):
+    plain, mesh = pair
+    c = mesh.metrics.counter("dgraph_mesh_dispatches_total")
+    t = mesh.metrics.counter("dgraph_agg_terminal_ops_total")
+    for q in TERMINAL_BATTERY:
+        a, _ = plain.query(q)
+        d0, t0 = c.value, t.value
+        b, _ = mesh.query(q)
+        assert c.value - d0 == 1, f"not one dispatch: {q}"
+        assert t.value - t0 == 1, f"no terminal op: {q}"
+        assert json.dumps(a, sort_keys=True, default=str) == \
+            json.dumps(b, sort_keys=True, default=str), q
+
+
+def test_terminal_cross_check_runs_and_groups_nonempty(pair, monkeypatch):
+    """Guard against vacuous identity: the device terminal's key table /
+    counts really reach the host cross-check, over a non-trivial group
+    set (nested @groupby rows don't render in JSON — the byte-identity
+    invariant for terminals IS the exact count/agg cross-check)."""
+    from dgraph_tpu.query import groupby as gbmod
+
+    _plain, mesh = pair
+    seen = []
+    orig = gbmod._fused_check_counts
+
+    def spy(fused, row_seeds, members_per):
+        seen.append((len(fused["table"]), len(row_seeds)))
+        return orig(fused, row_seeds, members_per)
+
+    monkeypatch.setattr(gbmod, "_fused_check_counts", spy)
+    mesh.query(TERMINAL_BATTERY[3])
+    assert seen and seen[0][0] >= 2 and seen[0][1] >= 2
+
+
+def test_terminal_cross_check_has_teeth(pair, monkeypatch):
+    """A corrupted device count vector must be a hard error, not a
+    silent wrong answer."""
+    from dgraph_tpu.query import groupby as gbmod
+    from dgraph_tpu.query.engine import QueryError
+
+    _plain, mesh = pair
+    orig = gbmod._fused_check_counts
+
+    def corrupt(fused, row_seeds, members_per):
+        fused = dict(fused, counts=np.asarray(fused["counts"]) + 1)
+        return orig(fused, row_seeds, members_per)
+
+    monkeypatch.setattr(gbmod, "_fused_check_counts", corrupt)
+    with pytest.raises(QueryError):
+        mesh.query(TERMINAL_BATTERY[0])
+
+
+def test_terminal_fuzz_roots(pair):
+    """Terminal stage across root selectivities and both key tablets."""
+    plain, mesh = pair
+    for root in ('eq(name, "node1")', 'eq(name, "node42")', 'uid(0x1)',
+                 'uid(0x1, 0x9, 0x20)'):
+        for key in ("p2", "p1"):
+            q = ('{ var(func: has(name)) { r as rating } '
+                 '  q(func: %s) { p0 { follows @groupby(%s) '
+                 '{ count(uid) s: sum(val(r)) } } } }' % (root, key))
+            _same(plain, mesh, q)
+
+
+# ---------------------------------------------------------------------------
+# labeled fallbacks: reason=groupby / reason=agg
+# ---------------------------------------------------------------------------
+
+def test_value_key_groupby_falls_back_labeled(pair):
+    plain, mesh = pair
+    q = ('{ q(func: eq(name, "node3")) { p0 { p1 @groupby(name) '
+         '{ count(uid) } } } }')
+    before = _reasons(mesh).get("groupby", 0)
+    _same(plain, mesh, q)
+    assert _reasons(mesh).get("groupby", 0) > before
+
+
+def test_multi_key_groupby_falls_back_labeled(pair):
+    plain, mesh = pair
+    q = ('{ q(func: eq(name, "node3")) { p0 { p1 @groupby(p2, follows) '
+         '{ count(uid) } } } }')
+    before = _reasons(mesh).get("groupby", 0)
+    _same(plain, mesh, q)
+    assert _reasons(mesh).get("groupby", 0) > before
+
+
+def test_non_agg_child_falls_back_labeled(pair):
+    plain, mesh = pair
+    # a plain pred child inside the groupby block is outside the
+    # terminal ops vocabulary (classic skips it; both paths identical)
+    q = ('{ q(func: eq(name, "node3")) { p0 { p1 @groupby(p2) '
+         '{ count(uid) name } } } }')
+    before = _reasons(mesh).get("agg", 0)
+    _same(plain, mesh, q)
+    assert _reasons(mesh).get("agg", 0) > before
+
+
+def test_non_numeric_val_var_stays_host_side(pair):
+    """A string-valued var under __agg_min is structurally terminal but
+    execution drops the device candidate — host answers, byte-identical."""
+    plain, mesh = pair
+    q = ('{ var(func: has(name)) { nm as name } '
+         '  q(func: eq(name, "node3")) { p0 @groupby(p2) '
+         '{ count(uid) w: min(val(nm)) } } }')
+    _same(plain, mesh, q)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN: the aggregation terminal renders est vs actual
+# ---------------------------------------------------------------------------
+
+def test_explain_groupby_rows(pair):
+    plain, _mesh = pair
+    out, _ = plain.query(
+        '{ q(func: has(name)) @groupby(p2) { count(uid) } }',
+        explain=True)
+    blk = out["explain"]["blocks"][0]
+    gb = blk["groupby"]
+    assert gb["desc"] == "p2"
+    assert gb["est"] >= 1
+    assert gb["actual"] == len(out["q"][0]["@groupby"])
+    assert gb["aggs"] == 1
+
+
+def test_explain_groupby_child_level(pair):
+    plain, _mesh = pair
+    out, _ = plain.query(
+        '{ var(func: has(name)) { r as rating } '
+        '  q(func: eq(name, "node3")) { p0 @groupby(p2) '
+        '{ count(uid) s: sum(val(r)) } } }', explain=True)
+    q_blk = [b for b in out["explain"]["blocks"] if b["block"] == "q"][0]
+    child = q_blk["children"][0]
+    assert child["groupby"]["desc"] == "p2"
+    assert child["groupby"]["aggs"] == 2
+    assert child["groupby"]["actual"] is not None
