@@ -1,0 +1,146 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a short live-query subscription check (ISSUE 18).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1 to
+# skip when the full suite already ran in an earlier CI stage).
+# Step 2 runs the bench.py bench_live battery at reduced scale and asserts
+#   * byte identity — every result-bearing notification equals re-running
+#     the query at its carried watermark,
+#   * commit-to-notify p50 under the 50 ms gate,
+#   * foreground warm QPS retained (>= 0.90 of the subscriptions-off
+#     sandwich baseline, interleaved A/B/A rounds),
+# then exercises subscribe/notify/resync end-to-end both embedded
+# (Node.subscribe iterator) and over the wire (POST /subscribe SSE), with
+# byte-identity asserts on exactly the payloads a client would receive,
+# and checks the "journal" + "subscriptions" sections of /debug/metrics.
+# Runs entirely on the XLA host platform — no TPU needed.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-860}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== live-query subscription smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+from bench import bench_live
+
+# reduced scale: does not clobber the full-scale LIVE_r18.json artifact
+r = bench_live(n_subs=400, n_queries=8, rounds=5, round_s=0.8, samples=6)
+print(f"  {r['n_subs']} subs: retention {r['fg_retention']} "
+      f"(pairs {r['pair_ratios']}), notify p50 "
+      f"{r['commit_notify_p50_s'] * 1e3:.1f}ms, "
+      f"{r['notifications']} notifications over {r['windows']} windows, "
+      f"identity {r['identity_checked']} checked")
+assert r["identical"] and r["identity_checked"] > 0, \
+    "a notification diverged from re-running its query at its watermark"
+assert r["commit_notify_p50_s"] < 0.050, \
+    f"commit-to-notify p50 blew the 50ms gate: {r['commit_notify_p50_s']}"
+assert r["fg_retention"] >= 0.90, \
+    f"foreground QPS degraded > 10% with subscriptions on: {r}"
+
+# -- embedded + wire battery --------------------------------------------
+import json
+import threading
+import time
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.live.diff import canon
+
+Q = "{ q(func: has(name), orderasc: name) { uid name } }"
+
+node = Node()
+node.alter(schema_text="name: string @index(term) .")
+node.mutate(set_nquads='<0x1> <name> "alice" .', commit_now=True)
+
+# embedded: init -> diff -> byte identity at the carried watermark
+sub = node.subscribe(Q)
+ev = sub.next(timeout=5)
+assert ev["type"] == "init" and ev["sub"] == sub.id, ev
+node.mutate(set_nquads='<0x2> <name> "bob" .', commit_now=True)
+ev = sub.next(timeout=10)
+assert ev["type"] == "diff" and "sub" not in ev, ev
+assert ev["diff"]["q"]["added"] == [{"uid": "0x2", "name": "bob"}], ev
+rerun = node.query(Q, start_ts=ev["at"], read_only=True)[0]
+assert canon(ev["result"]) == canon(rerun), "embedded diff not byte-identical"
+
+# resync path: a stale cursor below the journal floor forces a full result
+stale = node.subscribe(Q, cursor=0)
+ev2 = stale.next(timeout=5)
+assert ev2["type"] in ("init", "resync"), ev2
+assert canon(ev2["result"]) == canon(
+    node.query(Q, start_ts=ev2["at"], read_only=True)[0])
+stale.cancel()
+sub.cancel()
+print("  embedded: init/diff/resync byte-identical at carried watermarks")
+
+# wire: POST /subscribe SSE — identity holds on exactly the client bytes
+from dgraph_tpu.api.http import _serving_metrics, make_server
+
+srv = make_server(node, port=0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+port = srv.server_address[1]
+
+import http.client
+
+
+def read_frame(fp):
+    lines = []
+    while True:
+        ln = fp.readline().decode("utf-8").rstrip("\n")
+        if ln == "":
+            if lines:
+                return lines
+            continue
+        lines.append(ln)
+
+
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+conn.request("POST", "/subscribe", json.dumps({"query": Q}),
+             {"Content-Type": "application/json"})
+resp = conn.getresponse()
+assert resp.status == 200, resp.status
+assert resp.getheader("Content-Type") == "text/event-stream"
+fr = read_frame(resp.fp)
+assert fr[0] == "event: init", fr
+node.mutate(set_nquads='<0x3> <name> "carol" .', commit_now=True)
+while True:
+    fr = read_frame(resp.fp)
+    if not fr[0].startswith(":"):
+        break
+assert fr[0] == "event: diff", fr
+ev = json.loads(fr[1][len("data: "):])
+assert ev["diff"]["q"]["added"] == [{"uid": "0x3", "name": "carol"}], ev
+rerun = node.query(Q, start_ts=ev["at"], read_only=True)[0]
+assert canon(ev["result"]) == canon(rerun), "SSE diff not byte-identical"
+conn.close()
+deadline = time.monotonic() + 10          # server reaps the dropped client
+while time.monotonic() < deadline and node.live.stats()["active"]:
+    time.sleep(0.05)
+print("  wire: SSE init/diff byte-identical on the client payload")
+
+m = _serving_metrics(node)
+j, s = m["journal"], m["subscriptions"]
+assert "keys" in j and "pinned_floor" in j, j
+assert s["notifications"] >= 2 and s["evals"] >= 1, s
+assert s["sheds"] == 0, s
+node.close()
+srv.shutdown()
+print(f"  /debug/metrics: journal keys {j['keys']}, "
+      f"{s['notifications']} notifications, {s['evals']} evals, 0 sheds")
+print("OK: bench gates, embedded battery, wire battery, metrics sections")
+PY
+echo "== smoke passed =="

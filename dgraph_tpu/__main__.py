@@ -55,7 +55,8 @@ def cmd_serve(args) -> int:
                 residency_pin=args.residency_pin,
                 cost_ledger=not args.no_cost_ledger,
                 cost_regression_factor=args.cost_regression_factor,
-                lazy_folds=not args.no_lazy_folds)
+                lazy_folds=not args.no_lazy_folds,
+                delta_journal_max_keys=args.delta_journal_max_keys or None)
     if args.faults or args.faults_seed is not None:
         from dgraph_tpu.utils import faults as faults_mod
 
@@ -179,7 +180,8 @@ def cmd_worker(args) -> int:
     from dgraph_tpu.utils.schema import parse_schema
 
     lg = log.get_logger("worker")
-    store = Store(args.postings)
+    store = Store(args.postings,
+                  max_delta_keys=args.delta_journal_max_keys or None)
     if args.schema:
         with open(args.schema) as f:
             for e in parse_schema(f.read()):
@@ -472,6 +474,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="overlay age before background rollup (default 30)")
     sp.add_argument("--no_background_rollup", action="store_true",
                     help="disable the background overlay compaction loop")
+    sp.add_argument("--delta_journal_max_keys", type=int, default=0,
+                    help="per-predicate delta-journal key bound (0 = "
+                         "default 8192); size to the working set a live "
+                         "subscriber may fall behind by — overflow forces "
+                         "affected subscriptions through a full resync")
     sp.add_argument("--fold_workers", type=int, default=0,
                     help="parallel tablet-fold threads (0 = auto)")
     sp.add_argument("--no_lazy_folds", action="store_true",
@@ -604,6 +611,9 @@ def build_parser() -> argparse.ArgumentParser:
     wp.add_argument("--no_lazy_folds", action="store_true",
                     help="fold every tablet eagerly at snapshot assembly "
                          "instead of on-demand at first read")
+    wp.add_argument("--delta_journal_max_keys", type=int, default=0,
+                    help="per-predicate delta-journal key bound (0 = "
+                         "default 8192)")
     wp.set_defaults(fn=cmd_worker)
 
     zp = sub.add_parser("zero", help="run the cluster coordinator process")

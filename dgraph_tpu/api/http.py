@@ -349,6 +349,27 @@ def _serving_metrics(node: Node) -> dict:
             "edges": m.histogram("dgraph_query_cost_edges").snapshot(),
             "bytes": m.histogram("dgraph_query_cost_bytes").snapshot(),
         },
+        # delta-journal retention (ISSUE 18): the completeness window live
+        # subscriptions and O(Δ) stamping both depend on — keys held,
+        # per-attr bound, overflow count, and the subscription pin
+        "journal": node.store.delta_log_stats(),
+        # live queries (ISSUE 18, dgraph_tpu/live/): standing subscription
+        # registry + the notifier's window/wake/eval/delivery counters —
+        # the coalescing ratio is wakeups/evals, the health signal is
+        # sheds/resyncs staying near zero
+        "subscriptions": {
+            **node.live.stats(),
+            "notifications": c("dgraph_subs_notifications_total"),
+            "wakeups": c("dgraph_subs_wakeups_total"),
+            "evals": c("dgraph_subs_evals_total"),
+            "sheds": c("dgraph_subs_sheds_total"),
+            "resyncs": c("dgraph_subs_resyncs_total"),
+            "expired": c("dgraph_subs_expired_total"),
+            "reaped": c("dgraph_subs_reaped_total"),
+            "heartbeats": c("dgraph_subs_heartbeats_total"),
+            "notify_latency_s": m.histogram(
+                "dgraph_subs_notify_latency_s").snapshot(),
+        },
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),
                  "meter_dropped": m.meter(f"http_{ep}").dropped,
@@ -409,7 +430,8 @@ class _Handler(BaseHTTPRequestHandler):
         "/debug/top": "live cost profiler: rank plan shapes / predicates "
                       "/ endpoints by device ms, bytes, or edges over a "
                       "sliding window (?window=60&by=device_ms&"
-                      "group=shape&n=20)",
+                      "group=shape&n=20; &endpoint=live isolates "
+                      "standing-subscription load)",
         "/debug/faults": "fault-injection registry (GET snapshot; POST "
                          '{"install": {...}} / {"spec": "..."} / '
                          '{"clear": true} / {"seed": N} — chaos tests)',
@@ -476,7 +498,8 @@ class _Handler(BaseHTTPRequestHandler):
                 window_s=float(qs.get("window", "60")),
                 by=qs.get("by", "device_ms"),
                 group=qs.get("group", "shape"),
-                n=int(qs.get("n", "20"))), default=str).encode())
+                n=int(qs.get("n", "20")),
+                endpoint=qs.get("endpoint")), default=str).encode())
         elif path == "/debug/faults":
             self._send(200, json.dumps(faults.GLOBAL.snapshot()).encode())
         elif path in ("", "/ui"):
@@ -498,6 +521,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/query":
                 self._query()
+            elif path == "/subscribe":
+                self._subscribe()
             elif path == "/mutate":
                 self._mutate()
             elif path == "/commit":
@@ -664,6 +689,60 @@ class _Handler(BaseHTTPRequestHandler):
             # extensions, keeping "data" byte-identical to a plain query
             ext["explain"] = out.pop("explain", None)
         self._send(200, _envelope_ok(out, ext))
+
+    def _subscribe(self):
+        """POST /subscribe — live query over Server-Sent Events (ISSUE
+        18). Body: {"query": "...", "vars": {...}, "cursor": ts,
+        "heartbeat_s": s}. Each frame is `event: <init|ack|diff|resync|
+        expire>` + `data: <canonical JSON>`; every data payload carries
+        the commit watermark `at` it reflects. Comment-only heartbeat
+        frames (`: hb`) flow after heartbeat_s of silence — the
+        keep-alive a long-lived response otherwise lacks — and a failed
+        write REAPS the subscription so a vanished client cannot pin its
+        queue, cursor, or the journal retention floor forever."""
+        from dgraph_tpu.live.diff import canon
+
+        body = self._read_body()
+        j = json.loads(body) if body.strip() else {}
+        if not isinstance(j, dict):
+            raise ValueError("subscribe body must be a JSON object")
+        q = j.get("query", "")
+        variables = j.get("vars") or j.get("variables")
+        cursor = j.get("cursor")
+        hb = float(j.get("heartbeat_s") or self.node.live.heartbeat_s)
+        m = self.node.metrics
+        t0 = time.perf_counter()
+        # registration (parse/validate/initial eval) errors surface as the
+        # normal JSON error envelope — the stream only starts on success
+        sub = self.node.subscribe(
+            q, variables, cursor=int(cursor) if cursor is not None else None)
+        m.meter("http_subscribe").mark()
+        m.histogram("dgraph_http_subscribe_latency_s").observe(
+            time.perf_counter() - t0)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("X-Accel-Buffering", "no")
+        self.end_headers()
+        self.close_connection = True   # SSE has no Content-Length
+        try:
+            while True:
+                try:
+                    ev = sub.next(hb)
+                except StopIteration:
+                    break
+                if ev is None:
+                    self.wfile.write(b": hb\n\n")
+                    self.wfile.flush()
+                    m.counter("dgraph_subs_heartbeats_total").inc()
+                    continue
+                self.wfile.write(
+                    f"event: {ev['type']}\ndata: {canon(ev)}\n\n".encode())
+                self.wfile.flush()
+        except (OSError, ConnectionError):
+            self.node.live.reap(sub.id)
+        finally:
+            sub.cancel()
 
     def _mutate(self):
         body = self._read_body()

@@ -107,12 +107,17 @@ class Node:
                  residency_pin: str = "",
                  cost_ledger: bool = True,
                  cost_regression_factor: float = 4.0,
-                 lazy_folds: bool = True) -> None:
+                 lazy_folds: bool = True,
+                 delta_journal_max_keys: int | None = None,
+                 live_queue_max: int = 256,
+                 live_idle_timeout_s: float = 300.0,
+                 live_heartbeat_s: float = 15.0) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
                            memory_budget=(memory_mb * (1 << 20))
-                           if memory_mb else None)
+                           if memory_mb else None,
+                           max_delta_keys=delta_journal_max_keys)
         self.zero = Zero(n_groups)
         self.metrics = metrics.Registry()
         # checkpoint/ingest gauges (peak transient bytes etc.) land in this
@@ -278,6 +283,25 @@ class Node:
         self.cost_ledger = bool(cost_ledger)
         self.cost_book = costs.CostBook(
             regression_factor=cost_regression_factor)
+        # live queries (ISSUE 18, dgraph_tpu/live/): standing subscriptions
+        # re-derived O(Δ) per commit window. Re-evals run read-only at the
+        # window's watermark through the normal query path — same caches,
+        # same DeviceBatcher — ranked under endpoint="live" in /debug/top.
+        from dgraph_tpu.live import LiveManager
+
+        self.live = LiveManager(
+            eval_fn=lambda q, v, ts: self.query(
+                q, v, start_ts=ts, read_only=True,
+                _cost_endpoint="live")[0],
+            watermark_fn=lambda: self.store.max_seen_commit_ts,
+            parse_fn=self._parse,
+            stores=[self.store],
+            metrics=self.metrics,
+            queue_max=live_queue_max,
+            idle_timeout_s=live_idle_timeout_s,
+            heartbeat_s=live_heartbeat_s,
+            batcher=self.batcher)
+        self.store.on_delta_overflow = self.live.on_journal_overflow
 
     def set_memory_budget(self, budget_bytes: int) -> None:
         """Install/retarget the memory budget and ensure the background
@@ -440,6 +464,11 @@ class Node:
                 self.metrics.counter("dgraph_num_aborts_total").inc()
                 raise
             ctx.commit_ts = commit_ts
+            # live-query wake (ISSUE 18): outside every lock, after the
+            # apply is visible. One truthiness check when nobody subscribes.
+            live = self.live
+            if live is not None and live.active:
+                live.notify_commit(commit_ts, ctx.preds)
             self.metrics.counter("dgraph_num_commits_total").inc()
             self.metrics.histogram("dgraph_commit_latency_s").observe(
                 time.perf_counter() - t0)
@@ -616,7 +645,8 @@ class Node:
               read_only: bool = False,
               edge_limit: int | None = None,
               explain: bool = False,
-              timeout_ms: float | None = None) -> tuple[dict, TxnContext]:
+              timeout_ms: float | None = None,
+              _cost_endpoint: str = "query") -> tuple[dict, TxnContext]:
         """Parse + execute a DQL request (edgraph/server.go:373).
 
         read_only treats start_ts purely as a snapshot timestamp: it never
@@ -643,7 +673,9 @@ class Node:
         # per-request cost ledger: the plan-shape key is the DQL text —
         # exactly what qcache.plan_key keys on — so /debug/top aggregates
         # replays of one shape across variable bindings
-        lg = costs.CostLedger(endpoint="query", shape=q) \
+        # _cost_endpoint="live" tags standing-subscription re-evals so
+        # /debug/top?endpoint=live ranks them next to foreground shapes
+        lg = costs.CostLedger(endpoint=_cost_endpoint, shape=q) \
             if self.cost_ledger else None
         try:
           with sp, self._deadline_scope(timeout_ms), costs.scope(lg):
@@ -1218,6 +1250,18 @@ class Node:
                 "residency_evicted": res_evicted,
                 "residency": self.residency.usage()}
 
+    # -- live queries (ISSUE 18) --------------------------------------------
+
+    def subscribe(self, q: str, variables: dict | None = None, *,
+                  cursor: int | None = None, queue_max: int | None = None):
+        """Register a standing query (the gRPC/embedded surface): returns a
+        live.Subscription iterator whose first event is init (full result
+        at its watermark), ack (reconnect cursor proven unchanged by the
+        delta journal), or a typed resync; subsequent events are diffs at
+        the commit watermark they reflect. See docs/query-language.md."""
+        return self.live.subscribe(q, variables, cursor=cursor,
+                                   queue_max=queue_max)
+
     # -- ops -----------------------------------------------------------------
 
     def health(self) -> dict:
@@ -1228,6 +1272,9 @@ class Node:
         return self.zero.state()
 
     def close(self) -> None:
+        live = getattr(self, "live", None)
+        if live is not None:
+            live.close()
         self._rollup_stop.set()
         self.slow_log.close()
         self.residency.close()

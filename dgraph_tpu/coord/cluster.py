@@ -145,6 +145,15 @@ class Cluster:
             for g, kb in keys_by_group.items():
                 self.stores[g].commit(start_ts, commit_ts, kb)
             self._ship_replica_deltas(start_ts, commit_ts, keys_by_group)
+            # live-query wake (ISSUE 18): the wire-mode seam — workers
+            # applied, the querying node's manager re-evaluates. Touched
+            # predicates derive from the committed keys themselves, so
+            # the wake filter sees exactly what the journal recorded.
+            live = getattr(self, "_live", None)
+            if live is not None and live.active:
+                preds = {K.kind_attr_of(kb)[1]
+                         for kbs in keys_by_group.values() for kb in kbs}
+                live.notify_commit(commit_ts, preds)
             return commit_ts
 
     def _ship_replica_deltas(self, start_ts: int, commit_ts: int,
@@ -184,18 +193,23 @@ class Cluster:
 
     # -- query ---------------------------------------------------------------
 
-    def query(self, q: str, variables: dict | None = None) -> dict:
+    def query(self, q: str, variables: dict | None = None,
+              read_ts: int | None = None) -> dict:
         """Federated read: each predicate's snapshot arrays come from its
         owning group's store (ProcessTaskOverNetwork routes the same way),
         through per-store incremental assemblers — a commit touching one
         predicate re-folds one predicate, not the world per query
-        (VERDICT r3 weak#9; posting/lists.go:243 read-through)."""
+        (VERDICT r3 weak#9; posting/lists.go:243 read-through).
+
+        read_ts pins the snapshot timestamp (live-query re-evaluation at
+        a notification's carried watermark); None reads the newest."""
         serving: dict[str, int] = {}
         with self._lock:
             # read_ts under the lock: a move completing in between would make
             # the moved predicate invisible (streamed copy commits above our
             # ts, source copy already deleted)
-            read_ts = self.zero.oracle.read_ts()
+            if read_ts is None:
+                read_ts = self.zero.oracle.read_ts()
             if not hasattr(self, "_assemblers"):
                 from dgraph_tpu.storage.csr_build import SnapshotAssembler
 
@@ -247,6 +261,31 @@ class Cluster:
                 row[3] += dt
         return Executor(snap, self.schema,
                         on_task=on_task).execute(dql.parse(q, variables))
+
+    # -- live queries (ISSUE 18) --------------------------------------------
+
+    def subscribe(self, q: str, variables: dict | None = None, *,
+                  cursor: int | None = None, queue_max: int | None = None):
+        """Wire-mode standing query: each group's store applies its
+        tablets' writes, the querying node's manager re-evaluates the
+        federated read at the commit watermark and streams diffs — the
+        same fan-out seam as query(). Lazy: the manager (and its notifier
+        thread) exists only once something subscribes."""
+        live = getattr(self, "_live", None)
+        if live is None:
+            from dgraph_tpu.live import LiveManager
+
+            live = LiveManager(
+                eval_fn=lambda qq, vv, ts: self.query(qq, vv, read_ts=ts),
+                watermark_fn=lambda: max(
+                    (s.max_seen_commit_ts for s in self.stores), default=0),
+                parse_fn=dql.parse,
+                stores=self.stores)
+            self._live = live
+            for s in self.stores:
+                s.on_delta_overflow = live.on_journal_overflow
+        return live.subscribe(q, variables, cursor=cursor,
+                              queue_max=queue_max)
 
     # -- predicate move ------------------------------------------------------
 
@@ -449,6 +488,9 @@ class Cluster:
         self._rebalance_thread.start()
 
     def close(self) -> None:
+        live = getattr(self, "_live", None)
+        if live is not None:
+            live.close()
         ev = getattr(self, "_stop_rebalance", None)
         if ev is not None:
             ev.set()

@@ -532,14 +532,20 @@ class CostBook:
             return len(self._ring)
 
     def top(self, window_s: float = 60.0, by: str = "device_ms",
-            group: str = "shape", n: int = 20) -> dict:
+            group: str = "shape", n: int = 20,
+            endpoint: str | None = None) -> dict:
         """Rank shapes/predicates/endpoints by summed cost over the
-        trailing window. The /debug/top payload."""
+        trailing window. The /debug/top payload. `endpoint` restricts the
+        window to records from that endpoint first (?endpoint=live ranks
+        standing-subscription re-evals by shape, next to — but separable
+        from — foreground query load)."""
         cutoff = time.monotonic() - max(window_s, 0.0)
         agg: dict[str, dict] = {}
         seen = 0
         with self._lock:
-            entries = [e for e in self._ring if e[0] >= cutoff]
+            entries = [e for e in self._ring
+                       if e[0] >= cutoff
+                       and (endpoint is None or e[2] == endpoint)]
             baselines = {s: (b[0], b[1])
                          for s, b in self._baseline.items()}
         for _ts, shape, ep, tid, rec in entries:
@@ -592,5 +598,6 @@ class CostBook:
                         * max(bl[0], self.BASELINE_FLOOR_MS))
             out.append(row)
         return {"window_s": window_s, "by": by, "group": group,
+                "endpoint": endpoint,
                 "records_in_window": seen, "flagged_total": self.flagged,
                 "top": out}

@@ -253,6 +253,12 @@ class DeviceBatcher:
         self._lock = locks.Lock("batch.DeviceBatcher._lock")
         self._open: dict[tuple, _Batch] = {}
         self._own_inflight = 0
+        # hint_burst(): until this monotonic instant, leaders wait the
+        # window even on an idle device — a caller that KNOWS compatible
+        # companions are imminent (the live notifier re-evaluating a
+        # coalesced commit window) trades one window of latency for
+        # packing instead of firing the first re-eval solo
+        self._burst_until = 0.0
         m = self.metrics
         self._formed = m.counter("dgraph_batch_formed_total")
         self._tasks = m.counter("dgraph_batch_tasks_total")
@@ -293,6 +299,12 @@ class DeviceBatcher:
         if self.gate is not None:
             return self.gate.busy()
         return self._own_inflight > 0
+
+    def hint_burst(self) -> None:
+        """Declare that a burst of concurrent submissions is imminent
+        (within ~one window): leaders arriving before the hint expires
+        hold the collection window open even when the device is idle."""
+        self._burst_until = time.perf_counter() + max(self.window_s, 0.0)
 
     def _deadline_bypasses(self, kind: str) -> bool:
         """True when the caller's remaining budget cannot cover the window
@@ -369,7 +381,8 @@ class DeviceBatcher:
             return entry.result
         try:
             if self.window_s > 0 and \
-                    not (self.idle_fire and not self._busy()):
+                    not (self.idle_fire and not self._busy()
+                         and time.perf_counter() >= self._burst_until):
                 self._window_waits.inc()
                 t0 = time.perf_counter()
                 # dgraph: allow(deadline-wait) leader window wait is

@@ -138,6 +138,18 @@ def plan_attrs(req) -> list[str] | None:
     return sorted(out)
 
 
+def subscription_attrs(req) -> frozenset | None:
+    """The live-query touch test (ISSUE 18): the predicate set whose
+    commits can change this request's result, or None when not statically
+    derivable (the subscription then wakes on EVERY commit window —
+    over-notification is correct, a stale feed is not). This is exactly
+    plan_attrs — the same read-set derivation the per-predicate result-
+    cache tokens key on — so cache invalidation and notification can
+    never disagree about what a commit touched."""
+    attrs = plan_attrs(req)
+    return None if attrs is None else frozenset(attrs)
+
+
 def result_token(req, snap) -> object:
     """Whole-query cache version: the per-predicate token tuple of the
     plan's read set when statically known, else the snapshot object token.

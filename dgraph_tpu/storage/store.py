@@ -377,12 +377,20 @@ class Store:
     """One group's posting store (the `pstore` of a server node)."""
 
     def __init__(self, dirpath: str | None = None,
-                 memory_budget: int | None = None) -> None:
+                 memory_budget: int | None = None,
+                 max_delta_keys: int | None = None) -> None:
         """memory_budget (bytes): enables PAGED mode — the snapshot is
         mmap'd, posting lists materialize lazily per key, and clean lists
         are evicted once the resident estimate exceeds the budget. The
-        badger-LSM role: the dataset no longer has to fit in host RAM."""
+        badger-LSM role: the dataset no longer has to fit in host RAM.
+
+        max_delta_keys: per-attr delta-journal bound (--delta_journal_max_keys
+        on the CLI); shadows the MAX_DELTA_KEYS class default. Size it to
+        the working set a live subscriber may fall behind by — overflow
+        forces affected subscriptions through a full resync."""
         self.dir = dirpath
+        if max_delta_keys:
+            self.MAX_DELTA_KEYS = int(max_delta_keys)
         self.paged = memory_budget is not None
         self.memory_budget = int(memory_budget or 0)
         self._segments: dict[tuple[int, str], SegmentRun] = {}
@@ -419,6 +427,14 @@ class Store:
         self._delta_log: dict[str, dict[bytes, int]] = {}
         self._delta_floor: dict[str, int] = {}
         self._delta_base_floor = 0   # commits at/below this live in bases
+        # live-query retention: the oldest active subscription cursor pins
+        # prune_delta so a reconnect-with-cursor stays provable; overflow
+        # (the bound above still wins over the pin) notifies the live
+        # manager which predicates lost completeness. The callback runs
+        # INSIDE the commit critical section and must not take locks.
+        self._delta_pin: int | None = None
+        self._delta_overflows = 0
+        self.on_delta_overflow = None
         # cold-open fold accelerator: per-(kind, attr) CONTIGUOUS packed
         # columns captured at snapshot load (the DGTS2 layout is already
         # tablet-ordered). While an entry survives — dropped on the first
@@ -725,6 +741,12 @@ class Store:
             self._delta_floor[attr] = max(
                 self.pred_commit_ts.get(attr, 0),
                 self._delta_floor.get(attr, 0))
+            self._delta_overflows += 1
+            if self.metrics is not None:
+                self.metrics.counter("dgraph_delta_journal_overflows").inc()
+            cb = self.on_delta_overflow
+            if cb is not None:   # lock-free by contract (see __init__)
+                cb(attr)
 
     # -- delta journal (overlay stamping feed, storage/delta.py) ------------
 
@@ -744,8 +766,13 @@ class Store:
             return {kb: ts for kb, ts in log.items() if ts > base_ts}
 
     def prune_delta(self, attr: str, upto_ts: int) -> None:
-        """A full fold at upto_ts subsumes journal entries at/below it."""
+        """A full fold at upto_ts subsumes journal entries at/below it.
+        Clamped at the subscription pin: retained extra entries are
+        harmless for stamping but keep reconnect cursors provable."""
         with self._lock:
+            pin = self._delta_pin
+            if pin is not None and upto_ts > pin:
+                upto_ts = pin
             if upto_ts < self._delta_floor_for(attr):
                 return
             log = self._delta_log.get(attr)
@@ -755,10 +782,27 @@ class Store:
             self._delta_floor[attr] = max(
                 self._delta_floor.get(attr, 0), upto_ts)
 
+    def pin_delta_floor(self, ts: int | None) -> None:
+        """Retention pin from the live manager: prune_delta will not erase
+        journal entries above `ts` (None unpins). The per-attr bound still
+        wins — a subscriber cannot make the journal unbounded, it can only
+        be told (via on_delta_overflow) that its cursor became unprovable."""
+        with self._lock:
+            self._delta_pin = None if ts is None else int(ts)
+            if self.metrics is not None:
+                self.metrics.counter("dgraph_delta_journal_pinned_floor") \
+                    .set(0 if ts is None else int(ts))
+
     def delta_log_stats(self) -> dict:
         with self._lock:
             keys = sum(len(v) for v in self._delta_log.values())
-            return {"attrs": len(self._delta_log), "keys": keys}
+            if self.metrics is not None:
+                self.metrics.counter("dgraph_delta_journal_keys").set(keys)
+            return {"attrs": len(self._delta_log), "keys": keys,
+                    "max_keys": self.MAX_DELTA_KEYS,
+                    "overflows": self._delta_overflows,
+                    "pinned_floor": self._delta_pin,
+                    "base_floor": self._delta_base_floor}
 
     def applied_mark(self, attr: str):
         """The predicate's applied watermark (done_until mirrors

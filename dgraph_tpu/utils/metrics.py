@@ -402,7 +402,24 @@ class Registry:
                      "dgraph_analytics_runs_total",
                      "dgraph_analytics_host_fallbacks_total",
                      "dgraph_analytics_iterations_total",
-                     "dgraph_analytics_edges_total"):
+                     "dgraph_analytics_edges_total",
+                     # delta-journal retention (storage/store.py; ISSUE 18):
+                     # keys/pinned_floor are gauges refreshed on scrape
+                     "dgraph_delta_journal_keys",
+                     "dgraph_delta_journal_overflows",
+                     "dgraph_delta_journal_pinned_floor",
+                     # live queries (live/manager.py, api/http.py; ISSUE 18)
+                     "dgraph_subs_active",
+                     "dgraph_subs_registered_total",
+                     "dgraph_subs_notifications_total",
+                     "dgraph_subs_wakeups_total",
+                     "dgraph_subs_evals_total",
+                     "dgraph_subs_windows_total",
+                     "dgraph_subs_sheds_total",
+                     "dgraph_subs_resyncs_total",
+                     "dgraph_subs_expired_total",
+                     "dgraph_subs_reaped_total",
+                     "dgraph_subs_heartbeats_total"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
@@ -440,7 +457,11 @@ class Registry:
                      "dgraph_http_abort_latency_s",
                      "dgraph_http_alter_latency_s",
                      "dgraph_analytics_latency_s",
-                     "dgraph_http_analytics_latency_s"):
+                     "dgraph_http_analytics_latency_s",
+                     # live queries (ISSUE 18): commit-to-notify latency +
+                     # subscribe registration time (SSE setup to first ack)
+                     "dgraph_subs_notify_latency_s",
+                     "dgraph_http_subscribe_latency_s"):
             self.histograms[name] = Histogram(
                 buckets=default_buckets(name))
 

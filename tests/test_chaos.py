@@ -722,3 +722,87 @@ def test_group_commit_chaos_wal_fault_and_kill_mid_window(tmp_path):
     assert rows3 <= set(rows)          # only whole records survived
     n3.close()
     node.close()
+
+
+# -- live queries under chaos (ISSUE 18) -------------------------------------
+# The subscription contract under faults: a client receives a TYPED
+# resync event and converges to the correct result — never a silent gap,
+# never a stale feed. Lockdep stays armed via the module fixture: the
+# notifier's lock (live.LiveManager._lock) must stay acyclic against the
+# store/gate/batcher locks it composes with.
+
+def test_live_eval_fault_typed_resync_then_convergence():
+    """Seeded kill of the re-evaluation seam mid-subscription (the
+    embedded analog of a worker crash during the fan-out): the notifier
+    must retry with backoff and, once the seam heals, deliver a typed
+    resync whose result is byte-identical at its watermark."""
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.live.diff import canon
+
+    n = Node()
+    try:
+        for e in parse_schema(SCHEMA):
+            n.store.set_schema(e)
+        n.mutate(set_nquads='<0x1> <name> "p1" .', commit_now=True)
+        q = "{ q(func: has(name)) { uid name } }"
+        sub = n.subscribe(q)
+        assert sub.next(5)["type"] == "init"
+        faults.GLOBAL.reseed(31)
+        faults.GLOBAL.install("device.dispatch", "error", p=1.0)
+        n.mutate(set_nquads='<0x2> <name> "p2" .', commit_now=True)
+        # the wake is pending but every re-eval dies at the dispatch gate
+        assert sub.next(0.9) is None
+        assert n.live.stats()["pending"] == 1
+        faults.GLOBAL.clear()
+        ev = sub.next(10)
+        assert ev is not None and ev["type"] == "resync", ev
+        assert ev["reason"] == "error"
+        assert {e2["name"] for e2 in ev["result"]["q"]} == {"p1", "p2"}
+        rerun = n.query(q, start_ts=ev["at"], read_only=True)[0]
+        assert canon(ev["result"]) == canon(rerun)
+        assert n.live.stats()["pending"] == 0
+        sub.cancel()
+    finally:
+        faults.GLOBAL.clear()
+        n.close()
+
+
+def test_live_journal_overflow_mid_subscription_wire_cluster():
+    """Journal overflow mid-subscription on the 2-group embedded wire
+    topology: the overflowed predicate's subscribers get a typed
+    `overflow` resync and converge; an untouched-predicate subscriber
+    sees nothing. Lockdep armed throughout (manager lock vs the cluster
+    commit path)."""
+    from dgraph_tpu.coord.cluster import Cluster
+    from dgraph_tpu.live.diff import canon
+
+    cl = Cluster(n_groups=2)
+    try:
+        for st in cl.stores:
+            st.MAX_DELTA_KEYS = 4          # force overflow cheaply
+            for e in parse_schema(SCHEMA):
+                st.set_schema(e)
+        cl.mutate(set_nquads='<0x1> <name> "p1" .')
+        q = "{ q(func: has(name)) { uid name } }"
+        sub = cl.subscribe(q)
+        assert sub.next(5)["type"] == "init"
+        q_age = "{ a(func: has(age)) { uid age } }"
+        bystander = cl.subscribe(q_age)
+        assert bystander.next(5)["type"] == "init"
+        # one commit touching >4 distinct `name` keys overflows group 0's
+        # journal inside the commit critical section
+        quads = "\n".join(f'<0x{i + 16:x}> <name> "o{i}" .'
+                          for i in range(8))
+        cl.mutate(set_nquads=quads)
+        ev = sub.next(10)
+        assert ev is not None and ev["type"] == "resync", ev
+        assert ev["reason"] == "overflow"
+        assert len(ev["result"]["q"]) == 1 + 8
+        rerun = cl.query(q, read_ts=ev["at"])
+        assert canon(ev["result"]) == canon(rerun)
+        # the untouched predicate's subscription saw no event at all
+        assert bystander.next(0.8) is None
+        sub.cancel()
+        bystander.cancel()
+    finally:
+        cl.close()

@@ -1,0 +1,166 @@
+"""qcache.plan_attrs as the live-query touch test (ISSUE 18, satellite).
+
+plan_attrs was born as a cache-invalidation key; live queries make it
+load-bearing for CORRECTNESS: a commit to a predicate the plan reads but
+plan_attrs omits would leave a standing subscription silently stale.
+These tests pin the contract across the non-chain roots — @recurse,
+shortest, similar_to, @groupby terminals, reverse edges, order/filter
+trees — with a differential oracle on top: for every shape whose attr
+set claims to be exact (not None), mutating any predicate that CHANGES
+the query's result must be a predicate in the set. Under-approximation
+is a test failure here, not a stale feed in production."""
+
+import pytest
+
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.live.diff import canon
+from dgraph_tpu.query import dql, qcache
+
+SCHEMA = """
+name: string @index(term) .
+age: int @index(int) .
+score: float .
+friend: [uid] @reverse .
+emb: float32vector @index(vector(dim: 2, metric: l2)) .
+"""
+
+
+def attrs_of(q: str):
+    return qcache.subscription_attrs(dql.parse(q))
+
+
+# -- static shape coverage ---------------------------------------------------
+
+def test_recurse_covers_recursed_predicates():
+    a = attrs_of('{ q(func: eq(name, "a")) @recurse(depth: 3) '
+                 "{ name friend } }")
+    assert a is not None and {"name", "friend"} <= a
+
+
+def test_recurse_with_loop_and_filter():
+    a = attrs_of('{ q(func: has(name)) @recurse(depth: 2, loop: true) '
+                 "{ name friend @filter(ge(age, 10)) } }")
+    assert a is not None and {"name", "friend", "age"} <= a
+
+
+def test_shortest_is_wildcard_not_underapproximated():
+    # shortest reads path predicates dynamically; the only safe static
+    # answer is None (wake on every commit) — a concrete set that missed
+    # the traversed edge would be silently stale
+    a = attrs_of("{ path as shortest(from: 0x1, to: 0x4) { friend } }")
+    assert a is None
+
+
+def test_similar_to_covers_vector_predicate():
+    a = attrs_of('{ q(func: similar_to(emb, "[0.1, 0.2]", 4)) '
+                 "{ uid name } }")
+    assert a is not None and {"emb", "name"} <= a
+
+
+def test_groupby_covers_grouped_attr():
+    a = attrs_of("{ q(func: has(name)) @groupby(age) { count(uid) } }")
+    assert a is not None and {"name", "age"} <= a
+
+
+def test_groupby_with_val_aggregate():
+    a = attrs_of("{ var(func: has(name)) { s as score } "
+                 "q(func: has(name)) @groupby(age) "
+                 "{ count(uid) m : max(val(s)) } }")
+    assert a is not None and {"name", "age", "score"} <= a
+
+
+def test_reverse_edge_strips_to_forward_attr():
+    a = attrs_of("{ q(func: has(name)) { uid ~friend { name } } }")
+    assert a is not None and "friend" in a and "~friend" not in a
+
+
+def test_order_and_nested_filter_tree():
+    a = attrs_of('{ q(func: has(name), orderasc: age) '
+                 "@filter(ge(score, 0.5) OR (has(friend) AND "
+                 'anyofterms(name, "x"))) { uid } }')
+    assert a is not None and {"name", "age", "score", "friend"} <= a
+
+
+def test_uids_and_expand_are_wildcards():
+    assert attrs_of("{ q(func: uid(0x1)) { name } }") is None
+    assert attrs_of("{ q(func: has(name)) { expand(_all_) } }") is None
+
+
+# -- differential oracle -----------------------------------------------------
+
+SHAPES = [
+    '{ q(func: eq(name, "root")) @recurse(depth: 3) { name friend } }',
+    "{ q(func: has(name)) @groupby(age) { count(uid) } }",
+    '{ q(func: similar_to(emb, "[0.5, 0.5]", 3)) { uid name } }',
+    "{ q(func: has(age)) { uid ~friend { name } } }",
+    "{ q(func: has(name), orderasc: age) @filter(ge(score, 0.0)) "
+    "{ uid name score } }",
+]
+
+# every predicate any differential probe below mutates
+PROBE_PREDS = ("name", "age", "score", "friend", "emb")
+
+PROBES = {
+    "name": '<0x51> <name> "probe" .',
+    "age": '<0x52> <age> "77" .',
+    "score": '<0x53> <score> "0.25" .',
+    "friend": "<0x54> <friend> <0x1> .",
+    "emb": '<0x55> <emb> "[0.9, 0.1]"^^<xs:float32vector> .',
+}
+
+
+@pytest.fixture(scope="module")
+def seeded_node():
+    n = Node()
+    n.alter(SCHEMA)
+    n.mutate(set_nquads="\n".join([
+        '<0x1> <name> "root" .', '<0x1> <age> "30" .',
+        '<0x1> <score> "1.5" .', '<0x2> <name> "leaf" .',
+        '<0x2> <age> "20" .', '<0x2> <score> "0.5" .',
+        "<0x1> <friend> <0x2> .", '<0x1> <emb> "[0.5, 0.5]"^^<xs:float32vector> .',
+        '<0x2> <emb> "[0.4, 0.6]"^^<xs:float32vector> .',
+    ]), commit_now=True)
+    yield n
+    n.close()
+
+
+@pytest.mark.parametrize("q", SHAPES)
+def test_no_underapproximation_differential(seeded_node, q):
+    """If mutating predicate P changes the query's result, P MUST be in
+    the subscription attr set (or the set must be the None wildcard).
+    This is exactly the property notification correctness rests on."""
+    n = seeded_node
+    attrs = attrs_of(q)
+    if attrs is None:
+        return                  # wildcard wakes on everything: safe
+    for pred in PROBE_PREDS:
+        before = canon(n.query(q)[0])
+        n.mutate(set_nquads=PROBES[pred], commit_now=True)
+        after = canon(n.query(q)[0])
+        n.mutate(del_nquads=_del_form(PROBES[pred]), commit_now=True)
+        if before != after:
+            assert pred in attrs, (
+                f"mutating {pred!r} changed the result of {q!r} but "
+                f"plan_attrs={sorted(attrs)} omits it — a live "
+                f"subscription would go silently stale")
+
+
+def _del_form(set_quad: str) -> str:
+    subj, pred, _rest = set_quad.split(None, 2)
+    return f"{subj} {pred} * ."
+
+
+def test_differential_catches_a_lying_attr_set(seeded_node):
+    """Sanity on the oracle itself: a deliberately under-approximated set
+    trips the same assertion the real shapes are held to."""
+    n = seeded_node
+    q = "{ q(func: has(name)) { uid name } }"
+    lying = frozenset({"age"})          # pretends `name` is not read
+    before = canon(n.query(q)[0])
+    n.mutate(set_nquads=PROBES["name"], commit_now=True)
+    after = canon(n.query(q)[0])
+    n.mutate(del_nquads=_del_form(PROBES["name"]), commit_now=True)
+    assert before != after
+    assert "name" not in lying          # the under-approximation is real
+    real = attrs_of(q)
+    assert real is not None and "name" in real
