@@ -1,0 +1,165 @@
+#!/usr/bin/env bash
+# CI smoke: tier-1 verify + a CPU-only end-to-end device-runtime
+# observatory check (ISSUE 19).
+#
+# Step 1 runs the tier-1 verify line from ROADMAP.md (set SMOKE_SKIP_T1=1
+# to skip when the full suite already ran in an earlier CI stage).
+# Step 2 asserts, on an embedded node with forced device dispatches:
+#   * /debug/compiles and /debug/timeline parse, the timeline ring holds
+#     every gated dispatch exactly once with a program-family label, and
+#     /debug/metrics carries the devprof summary section;
+#   * a seeded shape-churn workload (one family rebuilt under distinct
+#     trigger shapes inside the window) MUST trip the retrace-storm
+#     detector into /debug/slow (root=retrace_storm) and onto
+#     dgraph_xla_retrace_storms_total;
+#   * the armed-vs-disarmed warm replay stays under the 2% overhead gate
+#     (same bar the tracer and cost ledger met);
+#   * --no_devprof leaves every seam detached (gate profiler None, module
+#     fan-out empty, /debug/compiles honest about being off).
+# Runs entirely on the XLA host platform — no TPU required.
+
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+SMOKE_MIN_DOTS="${SMOKE_MIN_DOTS:-480}"
+if [ "${SMOKE_SKIP_T1:-0}" != "1" ]; then
+  echo "== tier-1 verify =="
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || true
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+  echo "DOTS_PASSED=$dots (floor $SMOKE_MIN_DOTS)"
+  if [ "$dots" -lt "$SMOKE_MIN_DOTS" ]; then
+    echo "tier-1 regressed below the seed floor" >&2
+    exit 1
+  fi
+fi
+
+echo "== device-runtime observatory smoke (CPU) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import random
+import threading
+import time
+import urllib.request
+
+from dgraph_tpu.api.http import make_server
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.obs import devprof as devprof_mod
+from dgraph_tpu.obs import prom
+from dgraph_tpu.query import task as taskmod
+
+taskmod.HOST_EXPAND_MAX = 0          # force real device dispatches
+
+SCHEMA = ("name: string @index(exact) .\n"
+          "follows: [uid] @reverse .")
+
+
+def get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        assert r.status == 200, (path, r.status)
+        return r.read()
+
+
+# -- armed node: /debug surfaces + exactly-once timeline -------------------
+node = Node(span_sample=1.0, trace_rng=random.Random(4))
+node.alter(schema_text=SCHEMA)
+node.mutate(set_nquads='_:a <name> "ann" .\n_:b <name> "bob" .\n'
+                       '_:a <follows> _:b .', commit_now=True)
+srv = make_server(node, "127.0.0.1", 0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{srv.server_address[1]}"
+for i in range(3):
+    node.query('{ q(func: eq(name, "ann")) { name follows { name } } }',
+               variables={"$i": str(i)})
+
+disp = node.metrics.counter("dgraph_devprof_dispatches_total").value
+assert disp > 0, "no gated dispatches reached the profiler"
+raw = json.loads(get(base, "/debug/timeline?view=raw&n=4096"))
+assert len(raw) == disp, (len(raw), disp)       # exactly once
+assert all(r["family"] for r in raw), raw[:3]
+ct = json.loads(get(base, "/debug/timeline"))
+assert ct["displayTimeUnit"] == "ms" and ct["otherData"]["records"] == disp
+assert any(e["ph"] == "X" for e in ct["traceEvents"])
+comp = json.loads(get(base, "/debug/compiles"))
+assert comp["enabled"] is True and isinstance(comp["cache_sizes"], dict)
+dm = json.loads(get(base, "/debug/metrics"))
+assert dm["devprof"]["enabled"] is True
+assert dm["devprof"]["dispatches"] == disp
+prom.parse(get(base, "/metrics").decode())      # new series still parse
+print(f"  timeline: {disp} dispatches, each exactly once, "
+      f"families={sorted({r['family'] for r in raw})}")
+
+# -- seeded retrace storm MUST flag ----------------------------------------
+# (the forced-device warmup above may already have flagged a genuinely
+# churning family — assert the DELTA from the seeded fixture)
+storms0 = node.metrics.counter("dgraph_xla_retrace_storms_total").value
+for cap in (64, 128, 256, 512, 1024):
+    node.devprof.on_build("mesh.plan", ("plan", cap))
+storms = node.metrics.counter("dgraph_xla_retrace_storms_total").value
+assert storms == storms0 + 1, (storms0, storms)
+slow = json.loads(get(base, "/debug/slow?n=16"))
+roots = [e.get("root") for e in slow]
+assert "retrace_storm" in roots, roots
+comp = json.loads(get(base, "/debug/compiles"))
+assert comp["families"]["mesh.plan"]["storms"] == 1
+print(f"  retrace storm flagged into /debug/slow "
+      f"(builds={comp['families']['mesh.plan']['builds']})")
+srv.shutdown()
+node.close()
+assert devprof_mod._PROFILERS == ()
+
+# -- armed-overhead gate (< 2%, interleaved warm replay) -------------------
+node = Node()
+node.alter(schema_text=SCHEMA)
+node.mutate(set_nquads="\n".join(
+    f'_:n{i} <name> "n{i}" .' for i in range(300)), commit_now=True)
+q = '{ q(func: eq(name, "n7")) { name } }'
+
+
+def one_batch():
+    t0 = time.perf_counter()
+    for _ in range(600):
+        node.query(q)
+    return 600 / (time.perf_counter() - t0)
+
+
+node.set_devprof(False)
+one_batch()                                     # warmup
+samples = {"off": [], "on": []}
+# interleaved rounds so scheduler/GC drift hits both modes equally; the
+# PEAK of each mode is the noise-robust throughput estimator here (both
+# modes replay the identical warm-cache loop)
+for _ in range(9):
+    for label, armed in (("off", False), ("on", True)):
+        node.set_devprof(armed)
+        samples[label].append(one_batch())
+best = {k: max(v) for k, v in samples.items()}
+overhead = 100.0 * (1.0 - best["on"] / best["off"])
+print(f"  armed overhead: {overhead:.2f}% "
+      f"(off={best['off']:.0f} qps, on={best['on']:.0f} qps)")
+assert overhead < 2.0, f"armed overhead {overhead:.2f}% breaches the gate"
+node.close()
+
+# -- --no_devprof leaves every seam detached -------------------------------
+node = Node(devprof=False)
+node.alter(schema_text=SCHEMA)
+node.mutate(set_nquads='_:a <name> "ann" .', commit_now=True)
+node.query('{ q(func: eq(name, "ann")) { name } }')
+assert node.devprof is None
+assert node.dispatch_gate.profiler is None
+assert devprof_mod._PROFILERS == ()
+assert node.metrics.counter("dgraph_devprof_dispatches_total").value == 0
+srv = make_server(node, "127.0.0.1", 0)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{srv.server_address[1]}"
+assert json.loads(get(base, "/debug/compiles")) == {"enabled": False}
+assert json.loads(get(base, "/debug/timeline")) == {"enabled": False}
+srv.shutdown()
+node.close()
+print("  --no_devprof: every seam detached, surfaces honest")
+print("device-observatory smoke OK")
+PY
+echo "smoke_devobs OK"

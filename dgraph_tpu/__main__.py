@@ -55,6 +55,7 @@ def cmd_serve(args) -> int:
                 residency_pin=args.residency_pin,
                 cost_ledger=not args.no_cost_ledger,
                 cost_regression_factor=args.cost_regression_factor,
+                devprof=not args.no_devprof,
                 lazy_folds=not args.no_lazy_folds,
                 delta_journal_max_keys=args.delta_journal_max_keys or None)
     if args.faults or args.faults_seed is not None:
@@ -439,6 +440,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="flag a query into /debug/slow when its device "
                          "cost exceeds this multiple of its plan-shape's "
                          "EWMA baseline (needs 8 warmup samples)")
+    sp.add_argument("--no_devprof", action="store_true",
+                    help="disable the device-runtime observatory (XLA "
+                         "compile/retrace tracking, HBM telemetry, "
+                         "/debug/compiles + /debug/timeline; zero overhead "
+                         "when off)")
     sp.add_argument("--plan_cache", type=int, default=256,
                     help="parsed-plan cache entries (0 disables)")
     sp.add_argument("--task_cache_mb", type=int, default=64,

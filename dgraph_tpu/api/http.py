@@ -370,12 +370,19 @@ def _serving_metrics(node: Node) -> dict:
             "notify_latency_s": m.histogram(
                 "dgraph_subs_notify_latency_s").snapshot(),
         },
+        # device-runtime observatory (ISSUE 19, obs/devprof.py): XLA
+        # compile/retrace tracking, HBM high-water marks, and the
+        # dispatch-timeline utilization meters — the full per-family
+        # breakdown lives on /debug/compiles and /debug/timeline
+        "devprof": (node.devprof.summary() if node.devprof is not None
+                    else {"enabled": False}),
         "endpoints": {
             ep: {"qps": m.meter(f"http_{ep}").rate(),
                  "meter_dropped": m.meter(f"http_{ep}").dropped,
                  "latency": m.histogram(
                      f"dgraph_http_{ep}_latency_s").snapshot()}
-            for ep in ("query", "mutate", "commit", "abort", "alter")
+            for ep in ("query", "mutate", "commit", "abort", "alter",
+                       "analytics")
         },
         "node_qps": {"query": m.meter("query").rate(),
                      "mutate": m.meter("mutate").rate()},
@@ -435,6 +442,13 @@ class _Handler(BaseHTTPRequestHandler):
         "/debug/faults": "fault-injection registry (GET snapshot; POST "
                          '{"install": {...}} / {"spec": "..."} / '
                          '{"clear": true} / {"seed": N} — chaos tests)',
+        "/debug/compiles": "XLA compile observatory: per-program-family "
+                           "build/compile counts, cumulative compile ms, "
+                           "live jit-cache sizes, last-trigger shapes, "
+                           "retrace-storm flags",
+        "/debug/timeline": "device dispatch timeline ring as Chrome "
+                           "trace-event JSON (load in Perfetto; ?view=raw "
+                           "for the record list, ?n=256 bounds it)",
         "/metrics": "Prometheus text exposition of the metrics registry",
     }
 
@@ -500,6 +514,22 @@ class _Handler(BaseHTTPRequestHandler):
                 group=qs.get("group", "shape"),
                 n=int(qs.get("n", "20")),
                 endpoint=qs.get("endpoint")), default=str).encode())
+        elif path == "/debug/compiles":
+            prof = self.node.devprof
+            body = (prof.compiles_snapshot() if prof is not None
+                    else {"enabled": False})
+            self._send(200, json.dumps(body, default=str).encode())
+        elif path == "/debug/timeline":
+            prof = self.node.devprof
+            if prof is None:
+                self._send(200, json.dumps({"enabled": False}).encode())
+            elif self._qs().get("view") == "raw":
+                n = int(self._qs().get("n", "256"))
+                self._send(200, json.dumps(
+                    prof.timeline_snapshot(n), default=str).encode())
+            else:
+                self._send(200, json.dumps(
+                    prof.timeline_chrome(), default=str).encode())
         elif path == "/debug/faults":
             self._send(200, json.dumps(faults.GLOBAL.snapshot()).encode())
         elif path in ("", "/ui"):

@@ -111,7 +111,8 @@ class Node:
                  delta_journal_max_keys: int | None = None,
                  live_queue_max: int = 256,
                  live_idle_timeout_s: float = 300.0,
-                 live_heartbeat_s: float = 15.0) -> None:
+                 live_heartbeat_s: float = 15.0,
+                 devprof: bool = True) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -290,9 +291,9 @@ class Node:
         from dgraph_tpu.live import LiveManager
 
         self.live = LiveManager(
-            eval_fn=lambda q, v, ts: self.query(
+            eval_fn=lambda q, v, ts, subs=(): self.query(
                 q, v, start_ts=ts, read_only=True,
-                _cost_endpoint="live")[0],
+                _cost_endpoint="live", _cost_subs=subs)[0],
             watermark_fn=lambda: self.store.max_seen_commit_ts,
             parse_fn=self._parse,
             stores=[self.store],
@@ -302,6 +303,74 @@ class Node:
             heartbeat_s=live_heartbeat_s,
             batcher=self.batcher)
         self.store.on_delta_overflow = self.live.on_journal_overflow
+        # device-runtime observatory (ISSUE 19, obs/devprof.py): XLA
+        # compile/retrace tracking, HBM telemetry, and the dispatch
+        # timeline, attached at the gate/mesh seams plus the module
+        # fan-out for process-global build sites. --no_devprof never
+        # constructs it — the seams read one None attribute / one empty
+        # tuple, so the disarmed path is byte-identical to pre-19.
+        self._device_budget_bytes = int(device_budget_mb) << 20
+        self.devprof = None
+        if devprof:
+            self._arm_devprof()
+
+    def _arm_devprof(self) -> None:
+        from dgraph_tpu.obs import devprof as devprof_mod
+        from dgraph_tpu.obs.devprof import DevProfiler
+
+        prof = DevProfiler(self.metrics, slow_log=self.slow_log,
+                           budget_bytes=self._device_budget_bytes,
+                           residency=self.residency)
+        prof.add_cache_probe("mesh.programs",
+                             lambda: len(self.mesh_exec._progs)
+                             if self.mesh_exec is not None else 0)
+
+        def dist_caches():
+            import sys
+
+            d = sys.modules.get("dgraph_tpu.parallel.dist")
+            if d is None:
+                return {}
+            return {"dist.expand":
+                    d._expand_program.cache_info().currsize,
+                    "dist.k_hop":
+                    d._k_hop_program.cache_info().currsize}
+
+        def ops_jit_caches():
+            # only modules ALREADY imported by an executed path — the
+            # probe must not pull jax kernels in on a scrape
+            import sys
+
+            out = {}
+            for name in ("segments", "vector", "pallas_bfs",
+                         "traversal"):
+                m = sys.modules.get(f"dgraph_tpu.ops.{name}")
+                for fam, fn in getattr(m, "JIT_PROGRAMS", {}).items():
+                    size = getattr(fn, "_cache_size", None)
+                    out[fam] = size() if size is not None else -1
+            return out
+
+        prof.add_cache_probe("dist", dist_caches)
+        prof.add_cache_probe("ops.jit", ops_jit_caches)
+        self.devprof = prof
+        self.dispatch_gate.profiler = prof
+        if self.mesh_exec is not None:
+            self.mesh_exec._prof = prof
+        devprof_mod.register(prof)
+
+    def set_devprof(self, on: bool) -> None:
+        """Arm/disarm the device-runtime observatory live (bench.py's
+        armed-vs-disarmed A/B runs toggle this between battery passes)."""
+        from dgraph_tpu.obs import devprof as devprof_mod
+
+        if on and self.devprof is None:
+            self._arm_devprof()
+        elif not on and self.devprof is not None:
+            devprof_mod.unregister(self.devprof)
+            self.dispatch_gate.profiler = None
+            if self.mesh_exec is not None:
+                self.mesh_exec._prof = None
+            self.devprof = None
 
     def set_memory_budget(self, budget_bytes: int) -> None:
         """Install/retarget the memory budget and ensure the background
@@ -646,7 +715,8 @@ class Node:
               edge_limit: int | None = None,
               explain: bool = False,
               timeout_ms: float | None = None,
-              _cost_endpoint: str = "query") -> tuple[dict, TxnContext]:
+              _cost_endpoint: str = "query",
+              _cost_subs: tuple = ()) -> tuple[dict, TxnContext]:
         """Parse + execute a DQL request (edgraph/server.go:373).
 
         read_only treats start_ts purely as a snapshot timestamp: it never
@@ -677,6 +747,12 @@ class Node:
         # /debug/top?endpoint=live ranks them next to foreground shapes
         lg = costs.CostLedger(endpoint=_cost_endpoint, shape=q) \
             if self.cost_ledger else None
+        if lg is not None and _cost_subs:
+            # per-subscription attribution (ISSUE 19): the live manager
+            # passes the ids of every subscription a coalesced re-eval
+            # serves; /debug/top?group=sub apportions the record's cost
+            # equally among them
+            lg.subs = tuple(_cost_subs)
         try:
           with sp, self._deadline_scope(timeout_ms), costs.scope(lg):
             req = self._parse(q, variables)
@@ -1275,6 +1351,10 @@ class Node:
         live = getattr(self, "live", None)
         if live is not None:
             live.close()
+        if getattr(self, "devprof", None) is not None:
+            from dgraph_tpu.obs import devprof as devprof_mod
+
+            devprof_mod.unregister(self.devprof)
         self._rollup_stop.set()
         self.slow_log.close()
         self.residency.close()

@@ -153,6 +153,19 @@ class LiveManager:
                  idle_timeout_s: float = 300.0, heartbeat_s: float = 15.0,
                  batcher=None, eval_workers: int = 4) -> None:
         self._eval = eval_fn
+        # per-subscription cost attribution (ISSUE 19): an eval_fn that
+        # accepts a 4th `subs` argument (Node's does) gets the ids of
+        # every subscription the evaluation serves, so the cost ledger
+        # can rank standing load in /debug/top?group=sub. Detected once
+        # here — 3-arg engines (older embedders) keep working unchanged.
+        import inspect
+
+        try:
+            params = inspect.signature(eval_fn).parameters
+            self._eval_takes_subs = len(params) >= 4 or \
+                "subs" in params
+        except (TypeError, ValueError):
+            self._eval_takes_subs = False
         self._watermark = watermark_fn
         self._parse = parse_fn
         self._stores = list(stores)
@@ -192,6 +205,11 @@ class LiveManager:
             metrics.counter("dgraph_subs_notifications_total")
         self._h_latency = None if metrics is None else \
             metrics.histogram("dgraph_subs_notify_latency_s")
+
+    def _eval_at(self, q, variables, ts, subs: tuple = ()):
+        if self._eval_takes_subs:
+            return self._eval(q, variables, ts, subs)
+        return self._eval(q, variables, ts)
 
     # -- metrics plumbing ----------------------------------------------------
 
@@ -249,7 +267,7 @@ class LiveManager:
             self._ensure_thread_locked()
         try:
             w0 = self._watermark()
-            c = canon(self._eval(q, variables, w0))
+            c = canon(self._eval_at(q, variables, w0, (sid,)))
         except BaseException:
             self.cancel(sid)
             raise
@@ -475,9 +493,10 @@ class LiveManager:
             if hint is not None:
                 hint()
 
-        def run_one(q, variables):
+        def run_one(q, variables, sub_ids):
             try:
-                return (True, canon(self._eval(q, variables, w)))
+                return (True,
+                        canon(self._eval_at(q, variables, w, sub_ids)))
             except Exception as e:       # retried with backoff, then resync
                 return (False, f"{type(e).__name__}: {e}")
 
@@ -486,13 +505,15 @@ class LiveManager:
         if pool is not None:
             # dgraph: allow(ctxvar-copy) re-evals mint their own ledgers/
             # deadlines; nothing context-bound crosses into the pool
-            futs = {key: pool.submit(run_one, key[0], variables)
-                    for key, (variables, _subs) in items}
+            futs = {key: pool.submit(run_one, key[0], variables,
+                                     tuple(s.id for s in subs))
+                    for key, (variables, subs) in items}
             for key, fut in futs.items():
                 results[key] = fut.result()
         else:
-            for key, (variables, _subs) in items:
-                results[key] = run_one(key[0], variables)
+            for key, (variables, subs) in items:
+                results[key] = run_one(key[0], variables,
+                                       tuple(s.id for s in subs))
         now_p = time.perf_counter()
         latency_s = max(now_p - t_first, 0.0)
         with self._cv:

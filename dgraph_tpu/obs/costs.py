@@ -38,6 +38,8 @@ import threading
 import time
 from collections import deque
 
+from . import devprof
+
 # gRPC trailing-metadata key for the shipped record (-bin carries bytes)
 WIRE_KEY = "dgt-cost-bin"
 
@@ -99,9 +101,9 @@ class CostLedger:
 
     __slots__ = ("_lock", "endpoint", "shape", "t0", "wall_ms",
                  "device_ms", "h2d_bytes", "d2h_bytes", "upload_bytes",
-                 "edges", "rows", "tasks", "gate_wait_ms",
-                 "outcomes", "per_pred", "kernels", "groups", "_attrs",
-                 "_kernel_depth")
+                 "edges", "rows", "tasks", "gate_wait_ms", "compile_ms",
+                 "subs", "outcomes", "per_pred", "kernels", "groups",
+                 "_attrs", "_kernel_depth")
 
     def __init__(self, endpoint: str = "", shape: str = "") -> None:
         self._lock = threading.Lock()
@@ -117,6 +119,8 @@ class CostLedger:
         self.rows = 0                 # value/index rows scanned host-side
         self.tasks = 0                # dispatched tasks
         self.gate_wait_ms = 0.0       # dispatch-gate queueing
+        self.compile_ms = 0.0         # XLA compiles this request triggered
+        self.subs: tuple = ()         # subscription ids (endpoint="live")
         self.outcomes: dict[str, int] = {}
         # attr -> [device_ms, edges, bytes, tasks]
         self.per_pred: dict[str, list] = {}
@@ -195,6 +199,15 @@ class CostLedger:
         with self._lock:
             self.gate_wait_ms += ms
 
+    def add_compile(self, ms: float) -> None:
+        """XLA compile wall ms this request triggered (the devprof
+        jax.monitoring listener books it) — kept SEPARATE from device_ms
+        so a first-touch compile doesn't poison the shape's EWMA
+        regression baseline, while /debug/top?by=compile_ms still ranks
+        the shapes paying for retraces."""
+        with self._lock:
+            self.compile_ms += ms
+
     def in_kernel(self) -> bool:
         """True while a kernel-timing window is open on this ledger — the
         dispatch gate consults it so injected device-latency faults are
@@ -243,7 +256,8 @@ class CostLedger:
                             a: (list(v) if isinstance(v, list) else v)
                             for a, v in rec[k].items()}
                 return
-            for k in ("device_ms", "wall_ms", "gate_wait_ms"):
+            for k in ("device_ms", "wall_ms", "gate_wait_ms",
+                      "compile_ms"):
                 g[k] = g.get(k, 0.0) + rec.get(k, 0.0)
             for k in ("h2d", "d2h", "upload", "edges", "rows", "tasks"):
                 g[k] = g.get(k, 0) + rec.get(k, 0)
@@ -271,6 +285,7 @@ class CostLedger:
         return {"wall_ms": round(self.wall_ms, 3),
                 "device_ms": round(self.device_ms, 3),
                 "gate_wait_ms": round(self.gate_wait_ms, 3),
+                "compile_ms": round(self.compile_ms, 3),
                 "h2d": self.h2d_bytes, "d2h": self.d2h_bytes,
                 "upload": self.upload_bytes,
                 "edges": self.edges, "rows": self.rows,
@@ -319,6 +334,8 @@ class CostLedger:
                 total["device_ms"] + g.get("device_ms", 0.0), 3)
             total["gate_wait_ms"] = round(
                 total["gate_wait_ms"] + g.get("gate_wait_ms", 0.0), 3)
+            total["compile_ms"] = round(
+                total["compile_ms"] + g.get("compile_ms", 0.0), 3)
             for k in ("h2d", "d2h", "upload", "rows"):
                 total[k] += g.get(k, 0)
             for k in gsum:
@@ -349,8 +366,11 @@ class CostLedger:
                          for a, r in pred.items()}
         total["out"] = out
         total["kern"] = kern
-        return {"endpoint": self.endpoint, "shape": self.shape,
+        out2 = {"endpoint": self.endpoint, "shape": self.shape,
                 "total": total, "local": local, "groups": groups}
+        if self.subs:
+            out2["subs"] = list(self.subs)
+        return out2
 
 
 class _KernelTimer:
@@ -370,7 +390,7 @@ class _KernelTimer:
     clamped at zero against concurrent hedge-thread waits)."""
 
     __slots__ = ("_lg", "_kernel", "_attr", "_t0", "_gw0", "h2d", "d2h",
-                 "ms")
+                 "ms", "_pushed")
 
     def __init__(self, kernel: str, attr: str | None = None) -> None:
         self._lg = _current.get()
@@ -379,6 +399,7 @@ class _KernelTimer:
         self.h2d = 0
         self.d2h = 0
         self.ms = 0.0          # charged wall ms, readable after exit
+        self._pushed = False
 
     def __enter__(self):
         lg = self._lg
@@ -386,6 +407,14 @@ class _KernelTimer:
             with lg._lock:
                 lg._kernel_depth += 1
                 self._gw0 = lg.gate_wait_ms
+            # devprof armed: the kernel name IS the program family — the
+            # thread-local stack lets the dispatch timeline and the XLA
+            # compile listener attribute their records to "mesh.plan" /
+            # "csr.expand" instead of the coarse gate class. One empty-
+            # tuple truthiness check when the observatory is off.
+            if devprof._PROFILERS:
+                devprof.push_family(self._kernel)
+                self._pushed = True
             self._t0 = time.perf_counter()
         return self
 
@@ -397,6 +426,8 @@ class _KernelTimer:
         lg = self._lg
         if lg is not None:
             dt = (time.perf_counter() - self._t0) * 1e3
+            if self._pushed:
+                devprof.pop_family()
             with lg._lock:
                 lg._kernel_depth -= 1
                 waited = lg.gate_wait_ms - self._gw0
@@ -551,6 +582,41 @@ class CostBook:
         for _ts, shape, ep, tid, rec in entries:
             total = rec.get("total", {})
             seen += 1
+            if group == "sub":
+                # per-subscription attribution (ISSUE 19 satellite of
+                # the PR 18 leftover): a live re-eval record carries the
+                # ids of every subscription its coalesced group served —
+                # the shared eval's cost apportions equally among them,
+                # so 10k standing copies of one feed don't multiply the
+                # booked device time
+                sids = rec.get("subs") or ()
+                if not sids:
+                    continue
+                share = 1.0 / len(sids)
+                for sid in sids:
+                    a = agg.setdefault(sid, {
+                        "device_ms": 0.0, "wall_ms": 0.0,
+                        "compile_ms": 0.0, "edges": 0.0, "bytes": 0.0,
+                        "records": 0, "shape": ""})
+                    a["device_ms"] = round(
+                        a["device_ms"]
+                        + float(total.get("device_ms", 0.0)) * share, 3)
+                    a["wall_ms"] = round(
+                        a["wall_ms"]
+                        + float(total.get("wall_ms", 0.0)) * share, 3)
+                    a["compile_ms"] = round(
+                        a["compile_ms"]
+                        + float(total.get("compile_ms", 0.0)) * share, 3)
+                    a["edges"] = round(
+                        a["edges"]
+                        + int(total.get("edges", 0)) * share, 1)
+                    a["bytes"] = round(
+                        a["bytes"] + (int(total.get("h2d", 0))
+                                      + int(total.get("d2h", 0)))
+                        * share, 1)
+                    a["records"] += 1
+                    a["shape"] = shape[:200]
+                continue
             if group == "pred":
                 for attr, row in total.get("pred", {}).items():
                     a = agg.setdefault(attr, {
@@ -564,12 +630,14 @@ class CostBook:
                 continue
             gkey = ep if group == "endpoint" else shape
             a = agg.setdefault(gkey, {
-                "device_ms": 0.0, "wall_ms": 0.0, "edges": 0,
-                "bytes": 0, "records": 0, "trace_id": ""})
+                "device_ms": 0.0, "wall_ms": 0.0, "compile_ms": 0.0,
+                "edges": 0, "bytes": 0, "records": 0, "trace_id": ""})
             a["device_ms"] = round(
                 a["device_ms"] + float(total.get("device_ms", 0.0)), 3)
             a["wall_ms"] = round(
                 a["wall_ms"] + float(total.get("wall_ms", 0.0)), 3)
+            a["compile_ms"] = round(
+                a["compile_ms"] + float(total.get("compile_ms", 0.0)), 3)
             a["edges"] += int(total.get("edges", 0))
             a["bytes"] += int(total.get("h2d", 0)) + \
                 int(total.get("d2h", 0))
@@ -577,10 +645,10 @@ class CostBook:
             if tid:
                 a["trace_id"] = tid      # newest sampled exemplar wins
         rank_key = {"device_ms": "device_ms", "edges": "edges",
-                    "bytes": "bytes", "wall_ms": "wall_ms"}.get(
-                        by, "device_ms")
-        if group == "pred" and rank_key == "wall_ms":
-            rank_key = "device_ms"
+                    "bytes": "bytes", "wall_ms": "wall_ms",
+                    "compile_ms": "compile_ms"}.get(by, "device_ms")
+        if group == "pred" and rank_key in ("wall_ms", "compile_ms"):
+            rank_key = "device_ms"     # pred rows carry neither
         ranked = sorted(agg.items(), key=lambda kv: kv[1].get(rank_key, 0),
                         reverse=True)[: max(n, 1)]
         out = []

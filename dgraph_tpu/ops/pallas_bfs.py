@@ -842,3 +842,19 @@ def recurse_fused_multi(in_src_pad, in_src_pad_d, in_iptr_rank, subjects,
             sm, depth=depth, chunks=chunks, chunks_d=chunks_d,
             allow_loop=allow_loop),
         seeds_masks)
+
+
+# device-runtime observatory (obs/devprof.py, ISSUE 19): jitted entry
+# points by program family, probed for live jit-cache size on
+# /debug/compiles (see ops/segments.py).
+JIT_PROGRAMS = {
+    "pb.active_prefix": active_prefix,
+    "pb.active_prefix_sparse": active_prefix_sparse,
+    "pb.k_hop": _k_hop_impl,
+    "pb.pack_mask_rows": pack_mask_rows,
+    "pb.pack_mask": pack_mask,
+    "pb.recurse_step": recurse_step,
+    "pb.bfs_dist": bfs_dist,
+    "pb.recurse_fused": recurse_fused,
+    "pb.recurse_fused_multi": recurse_fused_multi,
+}

@@ -232,3 +232,17 @@ def rank_in_table(table: np.ndarray, values: np.ndarray):
     pos = np.searchsorted(table, values)
     posc = np.minimum(pos, len(table) - 1)
     return posc.astype(np.int64), table[posc] == values
+
+
+# device-runtime observatory (obs/devprof.py, ISSUE 19): the module's
+# jitted entry points by program family. Node registers their live jit
+# cache sizes as /debug/compiles probes — a growing cache under steady
+# traffic is shape churn (retraces); compile wall ms itself is
+# attributed by the jax.monitoring listener under whatever costs.kernel
+# family is active at first dispatch.
+JIT_PROGRAMS = {
+    "segments.reduce": segment_reduce,
+    "segments.sum_count": _sum_count,
+    "segments.lens_reduce": _lens_reduce,
+    "segments.rank_kernel": _rank_kernel,
+}

@@ -199,3 +199,14 @@ def ann_expand(matrix, norms, qv, nrows, dead_rows, vec_subjects,
 __all__ = ["METRICS", "BLOCK_ROWS", "ExpandResult", "row_capacity",
            "k_capacity", "host_distances", "topk_candidates",
            "topk_candidates_batch", "ivf_topk", "ann_expand"]
+
+
+# device-runtime observatory (obs/devprof.py, ISSUE 19): jitted entry
+# points by program family, probed for live jit-cache size on
+# /debug/compiles (see ops/segments.py).
+JIT_PROGRAMS = {
+    "vector.topk": topk_candidates,
+    "vector.topk_batch": topk_candidates_batch,
+    "vector.ivf_topk": ivf_topk,
+    "vector.ann_expand": ann_expand,
+}

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dgraph_tpu.parallel.mesh import shard_map
+from dgraph_tpu.obs import devprof
 from dgraph_tpu.ops.uidset import sentinel, _dedup_sorted
 from dgraph_tpu.ops.csr import expand
 
@@ -119,6 +120,10 @@ def _expand_program(mesh: Mesh, fcap: int, edge_cap: int):
     back to XLA for the next merge instead of re-allocating HBM every
     hop — expand_matrix always re-stages from the call's OUTPUT, so the
     consumed input is never touched again."""
+    # process-global build seam (no node in scope): the devprof module
+    # fan-out notes the cache miss — the lru decorator means this body
+    # only runs when a program is actually (re)built
+    devprof.note_build("dist.expand", (fcap, edge_cap))
 
     @partial(
         shard_map, mesh=mesh,
@@ -262,6 +267,8 @@ def _k_hop_program(mesh: Mesh, hops: int, frontier_cap: int, num_nodes: int,
     dist_k_hop made EVERY call a fresh function identity, so jax retraced
     the whole hop loop per query (the dominant fixed cost of the
     MULTICHIP_r0* dryruns)."""
+    devprof.note_build("dist.k_hop",
+                       (hops, frontier_cap, num_nodes, edge_cap))
 
     def step(sub, ptr, idx, frontier, visited):
         # sub/ptr/idx are this shard's blocks (leading axis stripped by shard_map)

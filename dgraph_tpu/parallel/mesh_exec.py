@@ -194,6 +194,11 @@ class MeshExecutor:
         self._c_fused_q = m.counter("dgraph_mesh_fused_queries_total")
         self._c_unfused_q = m.counter("dgraph_mesh_unfused_queries_total")
         self._c_compiles = m.counter("dgraph_mesh_program_builds_total")
+        # device-runtime observatory (obs/devprof.py, ISSUE 19): the
+        # node attaches its DevProfiler here so every program-cache miss
+        # notes its family + triggering shape key (retrace-storm input);
+        # None (--no_devprof) costs one attribute load per build.
+        self._prof = None
         m.counter("dgraph_mesh_devices").set(self.n_devices)
         m.counter("dgraph_mesh_sharded_tablets").set(0)
         m.counter("dgraph_mesh_replicated_tablets").set(0)
@@ -493,6 +498,8 @@ class MeshExecutor:
         if prog is not None:
             return prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.plan", key)
         mesh = self.mesh
         nargs = 1 + sum(2 + m[4] + (3 if m[5] else 0) + (1 if h else 0)
                         for h, m in enumerate(meta)) + 1
@@ -720,6 +727,8 @@ class MeshExecutor:
         if prog is not None:
             return prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.recurse", key)
         mesh = self.mesh
 
         def run(sub, erow, erank, rrank, *rest):
@@ -840,6 +849,8 @@ class MeshExecutor:
         if prog is not None:
             return prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.bfs", key)
         mesh = self.mesh
         P_n = len(shapes)
 
@@ -973,6 +984,8 @@ class MeshExecutor:
         if prog is not None:
             return prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.vector_topk", key)
         mesh = self.mesh
 
         def run(mat, nrm, valid, qv):
@@ -1083,6 +1096,8 @@ class MeshExecutor:
         if pr_prog is not None:
             return pr_prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.pagerank", key)
         mesh = self.mesh
 
         def run(esrc, edst, outdeg, dangling, live, rank0, n, damping,
@@ -1166,6 +1181,8 @@ class MeshExecutor:
         if cc_prog is not None:
             return cc_prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.cc", key)
         mesh = self.mesh
 
         def run(esrc, edst, lab0, maxit):
@@ -1227,6 +1244,8 @@ class MeshExecutor:
         if tri_prog is not None:
             return tri_prog
         self._c_compiles.inc()
+        if self._prof is not None:
+            self._prof.on_build("mesh.triangles", key)
         mesh = self.mesh
 
         def run(arow, afull):

@@ -404,6 +404,12 @@ class DispatchGate:
         self._wlock = locks.Lock(
             "qcache.DispatchGate._wlock")  # guards the _waiting count
         self._waiting = 0                  # queued acquirers
+        # device-runtime observatory (obs/devprof.py, ISSUE 19): the node
+        # attaches its DevProfiler here — run() is the ONE chokepoint
+        # every device dispatch (solo task, batch leader, analytics,
+        # mesh program) passes, so the timeline sees each exactly once.
+        # None (--no_devprof) costs a single attribute load per dispatch.
+        self.profiler = None
         self._step_ewma = 0.0              # expected device-step seconds
         # per-kernel-class EWMAs (ISSUE 9): one global estimate spans ~1ms
         # host-cutover expands and ~100ms mesh/vector steps, making shed
@@ -485,6 +491,9 @@ class DispatchGate:
 
     def run(self, fn, klass: str | None = None):
         tf = time.perf_counter()
+        prof = self.profiler
+        blg = costs.current() if prof is not None else None
+        b0 = (blg.h2d_bytes + blg.d2h_bytes) if blg is not None else 0
         faults.fire("device.dispatch", m=self.metrics)
         df = time.perf_counter() - tf
         if df > 1e-4:
@@ -523,6 +532,17 @@ class DispatchGate:
                     (1 - self._EWMA_ALPHA) * cur + self._EWMA_ALPHA * dt)
             self._inflight.dec()
             self._sem.release()
+            if prof is not None:
+                # timeline record: queue-entry (run() start) -> launch
+                # (slot acquired) -> fence (fn returned/raised). Bytes
+                # moved = the ledger's transfer delta across the window
+                # (0 when the kernel timer books after the gate exits —
+                # the batch runners book inside, so batched dispatches
+                # carry theirs).
+                b1 = (blg.h2d_bytes + blg.d2h_bytes) \
+                    if blg is not None else 0
+                prof.record_dispatch(klass, tf, t0, t0 + dt,
+                                     bytes_moved=max(b1 - b0, 0))
 
 
 # ---------------------------------------------------------------------------

@@ -419,7 +419,17 @@ class Registry:
                      "dgraph_subs_resyncs_total",
                      "dgraph_subs_expired_total",
                      "dgraph_subs_reaped_total",
-                     "dgraph_subs_heartbeats_total"):
+                     "dgraph_subs_heartbeats_total",
+                     # device-runtime observatory (obs/devprof.py;
+                     # ISSUE 19) — created by the profiler too, but a
+                     # node with --no_devprof must still expose them at
+                     # 0 (the pre-registration invariant)
+                     "dgraph_xla_compiles_total",
+                     "dgraph_xla_retrace_storms_total",
+                     "dgraph_devprof_dispatches_total",
+                     "dgraph_devprof_hbm_pressure_total",
+                     "dgraph_device_utilization",
+                     "dgraph_devprof_hbm_budget_bytes"):
             self.counters[name] = Counter()
         # per-endpoint breaker state (0 closed / 1 half-open / 2 open)
         self.keyed_gauges["dgraph_breaker_state"] = KeyedGauge()
@@ -435,6 +445,8 @@ class Registry:
         self.keyed_gauges["dgraph_overlay_depth"] = KeyedGauge()
         self.keyed_gauges["dgraph_residency_tier_bytes"] = KeyedGauge(
             labels=("tier",))
+        self.keyed_gauges["dgraph_devprof_hbm_highwater_bytes"] = \
+            KeyedGauge(labels=("tier",))
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s", "dgraph_compaction_s",
                      "dgraph_planner_est_error_log2",
@@ -461,7 +473,13 @@ class Registry:
                      # live queries (ISSUE 18): commit-to-notify latency +
                      # subscribe registration time (SSE setup to first ack)
                      "dgraph_subs_notify_latency_s",
-                     "dgraph_http_subscribe_latency_s"):
+                     "dgraph_http_subscribe_latency_s",
+                     # device-runtime observatory (obs/devprof.py;
+                     # ISSUE 19): real XLA compile wall ms, gate
+                     # queue-entry-to-launch gap, fenced dispatch ms
+                     "dgraph_xla_compile_ms",
+                     "dgraph_device_queue_gap_ms",
+                     "dgraph_device_dispatch_ms"):
             self.histograms[name] = Histogram(
                 buckets=default_buckets(name))
 

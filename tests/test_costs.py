@@ -364,7 +364,9 @@ def test_debug_surfaces_concurrent_with_mixed_workload(node):
                  ("/debug/top", False), (f"/debug/traces/{tid}", False),
                  ("/metrics", True), ("/debug/metrics", False),
                  ("/debug/top?by=edges&group=pred", False),
-                 ("/debug/vars", False)):
+                 ("/debug/vars", False),
+                 ("/debug/compiles", False),
+                 ("/debug/timeline", False)):
         threads.append(threading.Thread(target=hammer, args=spec,
                                         daemon=True))
     for t in threads:
