@@ -57,6 +57,10 @@ loops; the reference's own inner loops are scalar Go over bp128 blocks).
     interactive short reads + the 3-hop friends-of-friends complex read
     with result-UID-set equality across host/gRPC/mesh/tiered paths,
     traversed edges/sec per path, warm-QPS parity. Writes LDBC_r15.json.
+  * `qos` — the multi-tenant QoS round (ISSUE 20): weighted fair-share
+    convergence on a saturated dispatch gate and the noisy-neighbor
+    protection gate in interleaved qos-off/on rounds (armed victim p99
+    within 10% of hog-free solo). Writes QOS_r20.json.
 
 Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "band", "query_path", "query_configs", "throughput", "freshness",
@@ -2372,6 +2376,198 @@ def bench_live(n_subs=10000, n_queries=24, rounds=9, round_s=1.5,
     return out
 
 
+QOS_ARTIFACT = "QOS_r20.json"
+
+
+def bench_qos(window_s=2.0, round_s=1.0, delay_s=0.02, seed=20260807):
+    """ISSUE 20 multi-tenant QoS battery (embedded Node, CPU):
+
+      * fair_share — three tenants with weights 1/2/4 saturating a
+        width-1 dispatch gate (an injected device.step delay makes the
+        device genuinely scarce on CPU: every dispatch holds its slot
+        for ~delay_s and the ledger charges it as device time). The
+        per-tenant device-ms granted over a steady-state window must
+        converge to the weight split; gated on max relative error.
+      * noisy_neighbor — one victim tenant vs an abusive tenant offering
+        ~100x the device time its quota grants, probed in INTERLEAVED
+        rounds (off, on, off, on, off — QoS disarmed/armed alternately
+        with the hog hammering throughout; the A/B/A sandwich cancels
+        host drift). Gates: armed-round victim p99 within 10% of its
+        hog-free solo baseline (the ISSUE 20 acceptance claim) and the
+        sandwich ratio p99(off)/p99(on) above 1.25 — disarming QoS must
+        measurably hurt, or the "protection" is just noise.
+    """
+    import threading
+
+    from dgraph_tpu import tenancy as tnc
+    from dgraph_tpu.api.server import Node
+    from dgraph_tpu.utils import faults
+    from dgraph_tpu.utils.deadline import DeadlineExceeded, \
+        ResourceExhausted
+
+    q = "{ q(func: has(name), first: 4) { name } }"
+
+    def seed_ns(node, tenant):
+        with tnc.scope(tenant):
+            node.alter(schema_text="name: string @index(exact) .")
+            node.mutate(set_nquads="\n".join(
+                f'<0x{i:x}> <name> "{tenant}-{i}" .' for i in range(1, 5)),
+                commit_now=True)
+
+    def p99(xs):
+        return sorted(xs)[int(0.99 * (len(xs) - 1))]
+
+    faults.GLOBAL.reseed(seed)
+    faults.GLOBAL.install("device.step", "delay", p=1.0, delay_s=delay_s)
+    try:
+        # -- fair-share convergence -------------------------------------
+        weights = {"w1": 1.0, "w2": 2.0, "w4": 4.0}
+        node = Node(dispatch_width=1, task_cache_mb=0, result_cache_mb=0,
+                    tenants={"tenants": {t: {"weight": w}
+                                         for t, w in weights.items()}})
+        for t in weights:
+            seed_ns(node, t)
+        stop = threading.Event()
+
+        def pump(tenant):
+            with tnc.scope(tenant):
+                while not stop.is_set():
+                    node.query(q)
+
+        threads = [threading.Thread(target=pump, args=(t,))
+                   for t in weights for _ in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(0.5)                       # let the vtime clocks settle
+        gauge = node.metrics.keyed("dgraph_tenant_device_ms_total")
+        g0 = gauge.snapshot()
+        time.sleep(window_s)
+        g1 = gauge.snapshot()
+        stop.set()
+        for th in threads:
+            th.join(timeout=30.0)
+        node.close()
+        granted = {t: max(g1.get(t, 0) - g0.get(t, 0), 0) for t in weights}
+        total = max(sum(granted.values()), 1)
+        wsum = sum(weights.values())
+        fair = {
+            "window_s": window_s,
+            "granted_device_ms": granted,
+            "share": {t: round(granted[t] / total, 3) for t in weights},
+            "ideal": {t: round(w / wsum, 3) for t, w in weights.items()},
+        }
+        fair["max_rel_err"] = round(max(
+            abs(granted[t] / total - w / wsum) / (w / wsum)
+            for t, w in weights.items()), 3)
+
+        # -- noisy neighbor, interleaved qos off/on rounds ----------------
+        node = Node(dispatch_width=1, task_cache_mb=0, result_cache_mb=0,
+                    tenants={"tenants": {
+                        "victim": {"weight": 1.0},
+                        # ~30ms of burst vs ~40ms/request of injected
+                        # device time: one granted dispatch, then ~30s of
+                        # typed shedding at the admission edge
+                        "hog": {"weight": 1.0, "device_ms_per_s": 1.0,
+                                "burst_s": 30.0},
+                    }})
+        seed_ns(node, "victim")
+        seed_ns(node, "hog")
+
+        def victim_round(dur):
+            lats = []
+            end = time.perf_counter() + dur
+            with tnc.scope("victim"):
+                while time.perf_counter() < end:
+                    t0 = time.perf_counter()
+                    node.query(q)
+                    lats.append(time.perf_counter() - t0)
+            return lats
+
+        solo_p99 = p99(victim_round(round_s))     # hog-free, qos armed
+
+        stop = threading.Event()
+        hog_stats = {"attempts": 0, "granted": 0}
+        hlock = threading.Lock()
+
+        def hog():
+            while not stop.is_set():
+                try:
+                    with tnc.scope("hog"):
+                        node.query(q)
+                    with hlock:
+                        hog_stats["attempts"] += 1
+                        hog_stats["granted"] += 1
+                except (ResourceExhausted, DeadlineExceeded):
+                    with hlock:
+                        hog_stats["attempts"] += 1
+                time.sleep(0.0015)     # offered load, not a GIL-spin DoS
+
+        hogs = [threading.Thread(target=hog) for _ in range(2)]
+        for th in hogs:
+            th.start()
+        time.sleep(0.4)                # burn the hog's burst pre-window
+        fair_sched = node.dispatch_gate.fair
+        rounds = []
+        try:
+            for armed in (False, True, False, True, False):
+                # disarm = exactly what --no_qos disarms: quota admission
+                # and the fair queue; namespaces stay active
+                node.qos_enabled = armed
+                node.dispatch_gate.fair = fair_sched if armed else None
+                time.sleep(0.25)      # drain in-flight pre-toggle hogs
+                with hlock:
+                    h0 = dict(hog_stats)
+                lats = victim_round(round_s)
+                with hlock:
+                    h1 = dict(hog_stats)
+                rounds.append({
+                    "qos": armed, "n": len(lats),
+                    "p99_ms": round(p99(lats) * 1e3, 2),
+                    "hog_attempts": h1["attempts"] - h0["attempts"],
+                    "hog_granted": h1["granted"] - h0["granted"]})
+        finally:
+            node.qos_enabled = True
+            node.dispatch_gate.fair = fair_sched
+            stop.set()
+            for th in hogs:
+                th.join(timeout=10.0)
+            node.close()
+
+        on = [r["p99_ms"] for r in rounds if r["qos"]]
+        off = [r["p99_ms"] for r in rounds if not r["qos"]]
+        ratios = [(off[i] + off[i + 1]) / 2.0 / max(on[i], 1e-9)
+                  for i in range(len(on))]
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        # the 100x-offered claim is about the ARMED meter: attempts vs
+        # grants during qos-on rounds only (off rounds grant freely)
+        att_on = sum(r["hog_attempts"] for r in rounds if r["qos"])
+        grant_on = sum(r["hog_granted"] for r in rounds if r["qos"])
+        nn = {
+            "solo_p99_ms": round(solo_p99 * 1e3, 2),
+            "rounds": rounds,
+            "p99_on_ms": round(med(on), 2),
+            "p99_off_ms": round(med(off), 2),
+            "degradation_on": round(med(on) / max(solo_p99 * 1e3, 1e-9), 3),
+            "protection_ratio": round(med(ratios), 3),
+            "hog_armed": {"attempts": att_on, "granted": grant_on},
+        }
+    finally:
+        faults.GLOBAL.clear()
+
+    out = {"fair_share": fair, "noisy_neighbor": nn}
+    out["ok"] = bool(fair["max_rel_err"] < 0.35
+                     and nn["degradation_on"] <= 1.10
+                     and nn["protection_ratio"] > 1.25
+                     and nn["hog_armed"]["attempts"]
+                     >= 100 * max(nn["hog_armed"]["granted"], 1))
+    # reduced runs (smoke_qos.sh) must not clobber the trajectory artifact
+    if window_s == 2.0:
+        with open(QOS_ARTIFACT, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return out
+
+
 RESIDENCY_ARTIFACT = "RESIDENCY_r11.json"
 
 
@@ -2768,6 +2964,10 @@ def main():
     except Exception as e:  # live-subscription battery must not sink it
         live = {"error": f"{type(e).__name__}: {e}"}
     try:
+        qos = bench_qos()
+    except Exception as e:  # multi-tenant QoS battery must not sink it
+        qos = {"error": f"{type(e).__name__}: {e}"}
+    try:
         skew = bench_skew()
     except Exception as e:  # placement battery must not sink it either
         skew = {"error": f"{type(e).__name__}: {e}"}
@@ -2812,6 +3012,7 @@ def main():
         "batch": batch,
         "write": write,
         "live": live,
+        "qos": qos,
         "skew": skew,
         "residency": residency,
         "obs": obs,

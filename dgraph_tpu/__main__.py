@@ -57,7 +57,9 @@ def cmd_serve(args) -> int:
                 cost_regression_factor=args.cost_regression_factor,
                 devprof=not args.no_devprof,
                 lazy_folds=not args.no_lazy_folds,
-                delta_journal_max_keys=args.delta_journal_max_keys or None)
+                delta_journal_max_keys=args.delta_journal_max_keys or None,
+                qos=not args.no_qos,
+                tenants=args.tenants or None)
     if args.faults or args.faults_seed is not None:
         from dgraph_tpu.utils import faults as faults_mod
 
@@ -533,6 +535,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "an explicit ?timeoutMs= — consumed at every wait "
                          "point, typed DeadlineExceeded on overrun, never "
                          "a hang (0 = unbudgeted)")
+    sp.add_argument("--tenants", default=None,
+                    help="tenant QoS table: a JSON file path or inline "
+                         'JSON {"tenants": {name: {weight, '
+                         "device_ms_per_s, edges_per_s, bytes_per_s, "
+                         "burst_s, max_subs, sub_queue_max}}}; hot-"
+                         "reloadable via POST /admin/tenant")
+    sp.add_argument("--no_qos", action="store_true",
+                    help="disarm quota admission + weighted-fair device "
+                         "scheduling (namespaces stay active; a single-"
+                         "tenant deployment is byte-identical either way)")
     sp.add_argument("--faults", default=None,
                     help="arm fault injection: 'name:mode:p[:delay_s]"
                          "[:count],...' over the points in docs/ops.md "

@@ -21,6 +21,13 @@ Request formats:
   POST /admin/export[?dest=dir]      RDF+schema export (admin.go)
   POST /admin/shutdown               graceful stop
   POST /admin/config/memory_mb       body = MB; live budget reconfig
+  POST /admin/tenant                 tenant QoS table hot-reload
+                                     (?replace=true swaps the table)
+
+The X-Dgraph-Tenant header scopes a request to its tenant's namespace
+(ISSUE 20): predicates resolve as "<tenant>/<attr>" storage attrs, the
+tenant's DQL never sees the prefix, and namespace violations surface as
+403 ErrorNamespace. No header = the default (admin) namespace.
 """
 
 from __future__ import annotations
@@ -32,6 +39,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from dgraph_tpu import tenancy as tnc
 from dgraph_tpu.api.server import Node
 from dgraph_tpu.coord.zero import TxnConflict
 from dgraph_tpu.utils import faults
@@ -162,6 +170,34 @@ def _mesh_metrics(node: Node) -> dict:
         "unfused_queries": unfused,
         "fused_coverage_ratio": round(fused / (fused + unfused), 4)
         if fused + unfused else None,
+    }
+
+
+def _tenancy_metrics(node: Node) -> dict:
+    """Per-tenant QoS readout: the registry table (specs, bucket levels,
+    exact cost totals, sheds), the fair scheduler's vtime/EWMA state, and
+    storage accounting grouped by namespace prefix — tenant attrs are
+    distinct storage attrs, so overlay depth, journal keys, and predicate
+    counts attribute by tnc.split()."""
+    per: dict = {}
+
+    def row(tenant: str) -> dict:
+        return per.setdefault(tenant or "default", {
+            "preds": 0, "overlay_depth": 0, "journal_keys": 0})
+
+    for attr in node.store.predicates():
+        row(tnc.split(attr)[0])["preds"] += 1
+    for attr, depth in node._assembler.overlay_stats().items():
+        row(tnc.split(attr)[0])["overlay_depth"] += depth
+    for attr, keys in node.store.delta_log_by_attr().items():
+        row(tnc.split(attr)[0])["journal_keys"] += keys
+    fair = node.dispatch_gate.fair
+    return {
+        "qos": node.qos_enabled,
+        "configured": node.tenancy.configured,
+        "tenants": node.tenancy.table(),
+        "fair": fair.snapshot() if fair is not None else None,
+        "storage": per,
     }
 
 
@@ -370,6 +406,10 @@ def _serving_metrics(node: Node) -> dict:
             "notify_latency_s": m.histogram(
                 "dgraph_subs_notify_latency_s").snapshot(),
         },
+        # multi-tenant QoS (ISSUE 20, dgraph_tpu/tenancy/): tenant table
+        # with bucket levels + exact cost totals, fair-scheduler vtimes,
+        # and per-namespace storage accounting
+        "tenancy": _tenancy_metrics(node),
         # device-runtime observatory (ISSUE 19, obs/devprof.py): XLA
         # compile/retrace tracking, HBM high-water marks, and the
         # dispatch-timeline utilization meters — the full per-family
@@ -417,9 +457,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes --------------------------------------------------------------
 
+    def _tenant(self) -> str:
+        return self.headers.get(tnc.HTTP_HEADER, "").strip()
+
     def do_GET(self):
         try:
-            self._do_get()
+            with tnc.scope(self._tenant()):
+                self._do_get()
+        except tnc.NamespaceError as e:
+            self._send(403, _envelope_err("ErrorNamespace", str(e)))
         except Exception as e:
             self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
 
@@ -549,31 +595,36 @@ class _Handler(BaseHTTPRequestHandler):
         ep = self._OBSERVED.get(path)
         t0 = time.perf_counter()
         try:
-            if path == "/query":
-                self._query()
-            elif path == "/subscribe":
-                self._subscribe()
-            elif path == "/mutate":
-                self._mutate()
-            elif path == "/commit":
-                self._commit()
-            elif path == "/abort":
-                self._abort()
-            elif path == "/alter":
-                self._alter()
-            elif path == "/analytics":
-                self._analytics()
-            elif path == "/admin/export":
-                self._admin_export()
-            elif path == "/admin/shutdown":
-                self._admin_shutdown()
-            elif path == "/admin/config/memory_mb":
-                self._admin_memory()
-            elif path == "/debug/faults":
-                self._debug_faults()
-            else:
-                self._send(404, _envelope_err("ErrorInvalidRequest",
-                                              "no such path"))
+            # the X-Dgraph-Tenant header scopes the whole request: every
+            # predicate the body names resolves inside that namespace
+            with tnc.scope(self._tenant()):
+                if path == "/query":
+                    self._query()
+                elif path == "/subscribe":
+                    self._subscribe()
+                elif path == "/mutate":
+                    self._mutate()
+                elif path == "/commit":
+                    self._commit()
+                elif path == "/abort":
+                    self._abort()
+                elif path == "/alter":
+                    self._alter()
+                elif path == "/analytics":
+                    self._analytics()
+                elif path == "/admin/export":
+                    self._admin_export()
+                elif path == "/admin/shutdown":
+                    self._admin_shutdown()
+                elif path == "/admin/config/memory_mb":
+                    self._admin_memory()
+                elif path == "/admin/tenant":
+                    self._admin_tenant()
+                elif path == "/debug/faults":
+                    self._debug_faults()
+                else:
+                    self._send(404, _envelope_err("ErrorInvalidRequest",
+                                                  "no such path"))
         except TxnConflict as e:
             self._send(409, _envelope_err("ErrorAborted", str(e)))
         except DeadlineExceeded as e:
@@ -583,6 +634,9 @@ class _Handler(BaseHTTPRequestHandler):
         except ResourceExhausted as e:
             # shed under overload before consuming device time (429)
             self._send(429, _envelope_err("ErrorResourceExhausted", str(e)))
+        except tnc.NamespaceError as e:
+            # cross-namespace access / bad tenant name — typed, 403
+            self._send(403, _envelope_err("ErrorNamespace", str(e)))
         except Exception as e:  # surface parse/exec errors in the envelope
             self._send(400, _envelope_err("ErrorInvalidRequest", str(e)))
         finally:
@@ -667,6 +721,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.node.set_memory_budget(mb * (1 << 20))
         stats = self.node.enforce_memory(mb * (1 << 20))
         self._send(200, json.dumps({"code": "Success", **stats}).encode())
+
+    def _admin_tenant(self):
+        """POST /admin/tenant — hot-reload the tenant QoS table. Body:
+        {"tenants": {name: {weight, device_ms_per_s, edges_per_s,
+        bytes_per_s, burst_s, max_subs, sub_queue_max}}} (or the bare
+        name->spec map; "*" is the any-tenant default). ?replace=true
+        swaps the whole table; otherwise specs merge and only the
+        reconfigured tenants' buckets reset. Empty body = read back the
+        current table."""
+        body = self._read_body().strip()
+        cfg = json.loads(body) if body else {}
+        replace = self._qs().get("replace", "").lower() == "true"
+        table = self.node.configure_tenants(cfg, replace=replace) \
+            if cfg or replace else self.node.tenancy.table()
+        self._send(200, json.dumps(
+            {"code": "Success", "qos": self.node.qos_enabled,
+             "tenants": table}).encode())
 
     def _analytics(self):
         """POST /analytics — whole-graph OLAP over one predicate's tablet

@@ -41,6 +41,7 @@ from dgraph_tpu.storage.csr_build import (GraphSnapshot, PredData,
 from dgraph_tpu.storage.postings import Op
 from dgraph_tpu.storage.store import Store
 from dgraph_tpu.parallel.scheduler import Scheduler
+from dgraph_tpu import tenancy as tnc
 from dgraph_tpu.utils import deadline as dl
 from dgraph_tpu.utils import metrics
 from dgraph_tpu.utils.schema import parse_schema
@@ -112,7 +113,9 @@ class Node:
                  live_queue_max: int = 256,
                  live_idle_timeout_s: float = 300.0,
                  live_heartbeat_s: float = 15.0,
-                 devprof: bool = True) -> None:
+                 devprof: bool = True,
+                 qos: bool = True,
+                 tenants=None) -> None:
         # memory_mb enables the PAGED store: snapshot mmap'd, lists
         # materialize lazily, clean entries evict under the budget
         self.store = Store(dirpath,
@@ -303,6 +306,26 @@ class Node:
             heartbeat_s=live_heartbeat_s,
             batcher=self.batcher)
         self.store.on_delta_overflow = self.live.on_journal_overflow
+        # multi-tenant QoS (ISSUE 20, dgraph_tpu/tenancy/): namespaces are
+        # ALWAYS active for a non-default tenant (they are correctness —
+        # every request resolves predicates in its caller's namespace via
+        # NamespacedSnapshot/NamespacedSchema views); quota admission and
+        # weighted-fair device scheduling arm only when qos=True AND a
+        # tenants config is installed (serve --tenants / POST
+        # /admin/tenant). --no_qos keeps every serving seam reading one
+        # None attribute — single-tenant deployments stay byte-identical.
+        self.qos_enabled = bool(qos)
+        self.tenancy = tnc.TenantRegistry(self.metrics)
+        from collections import OrderedDict
+
+        # tenant snapshot views, cached per (tenant, base snapshot token)
+        # so engine-side attrs cached ON the snapshot object (known-uid
+        # sets) survive across requests within one base snapshot
+        self._ns_views: OrderedDict = OrderedDict()
+        self._ns_lock = threading.Lock()
+        self.zero.tenants = self.tenancy
+        if tenants:
+            self.configure_tenants(tenants)
         # device-runtime observatory (ISSUE 19, obs/devprof.py): XLA
         # compile/retrace tracking, HBM telemetry, and the dispatch
         # timeline, attached at the gate/mesh seams plus the module
@@ -371,6 +394,82 @@ class Node:
             if self.mesh_exec is not None:
                 self.mesh_exec._prof = None
             self.devprof = None
+
+    # -- multi-tenant QoS (ISSUE 20) -----------------------------------------
+
+    _NS_VIEW_CAP = 32
+
+    def configure_tenants(self, cfg, replace: bool = False) -> dict:
+        """Install/merge the tenant table (serve --tenants flag and the
+        POST /admin/tenant hot reload). `cfg` is a {"tenants": {...}} (or
+        bare name->spec) dict, a JSON string, or a path to a JSON file.
+        Arms quota admission + fair scheduling when qos is enabled."""
+        if isinstance(cfg, str):
+            import json as _json
+            import os
+
+            if os.path.exists(cfg):
+                with open(cfg, encoding="utf-8") as f:
+                    cfg = _json.load(f)
+            else:
+                cfg = _json.loads(cfg)
+        table = self.tenancy.configure(cfg, replace=replace)
+        self._arm_qos()
+        return table
+
+    def _arm_qos(self) -> None:
+        """Attach the fair scheduler + write-window caps + live-query caps
+        once qos is on and a tenant table exists. Idempotent; reconfigs
+        keep the armed scheduler's virtual clocks (weights re-read live
+        through weight_fn)."""
+        if not (self.qos_enabled and self.tenancy.configured):
+            return
+        gate = self.dispatch_gate
+        if gate.fair is None:
+            gate.fair = tnc.FairScheduler(weight_fn=self.tenancy.weight,
+                                          metrics=self.metrics)
+            gate.tenant_fn = tnc.current
+        wb = self.write_batcher
+        if wb is not None and wb.tenant_fn is None:
+            wb.tenant_fn = tnc.current
+            wb.tenant_cap_fn = lambda t: self.tenancy.window_share(
+                t, wb.max_batch)
+        self.live.registry = self.tenancy
+
+    def _ns_view(self, snap, tenant: str):
+        """The tenant's view of one snapshot, cached per (tenant, base
+        cache token): token equality implies identical committed content,
+        so one view object can serve every request of that (tenant,
+        snapshot) pair — and attrs the engine caches on the snapshot
+        object (known-uid sets) stay warm across them."""
+        key = (tenant, qcache.snapshot_token(snap))
+        with self._ns_lock:
+            v = self._ns_views.get(key)
+            if v is not None:
+                self._ns_views.move_to_end(key)
+                return v
+        v = tnc.NamespacedSnapshot(snap, tenant)
+        with self._ns_lock:
+            self._ns_views[key] = v
+            self._ns_views.move_to_end(key)
+            while len(self._ns_views) > self._NS_VIEW_CAP:
+                self._ns_views.popitem(last=False)
+        return v
+
+    def _schema_view(self):
+        """The caller's schema: the raw SchemaState for the default
+        namespace, a translating NamespacedSchema view for a tenant."""
+        t = tnc.current()
+        if t:
+            return tnc.NamespacedSchema(self.store.schema, t)
+        return self.store.schema
+
+    def _admit_tenant(self, tenant: str) -> None:
+        """API-edge quota admission (PR 7 shed discipline): over-quota
+        tenants get typed ResourceExhausted before any device work —
+        never a queue slot. Disarmed = one boolean check."""
+        if self.qos_enabled and self.tenancy.configured:
+            self.tenancy.admit(tenant)
 
     def set_memory_budget(self, budget_bytes: int) -> None:
         """Install/retarget the memory budget and ensure the background
@@ -638,7 +737,8 @@ class Node:
         during execution (engine only builds NEW GraphQuery nodes), so one
         AST serves every replay."""
         if self.plan_cache is not None:
-            return self.plan_cache.parse(q, variables)
+            return self.plan_cache.parse(q, variables,
+                                         ns=tnc.current())
         return dql.parse(q, variables)
 
     # -- Query ---------------------------------------------------------------
@@ -703,6 +803,10 @@ class Node:
         the placement controller's load book and the residency manager's
         admission/eviction scores (the same rate×log2(size) signal)."""
         attr = tq.attr[1:] if tq.attr.startswith("~") else tq.attr
+        # tablet accounting keys on STORAGE attrs: a tenant's task carries
+        # its bare name, so translate before the load book / residency
+        # touch (no-op for the default namespace)
+        attr = tnc.prefix(tnc.current(), attr)
         out_bytes = 0.0
         if getattr(res, "dest_uids", None) is not None:
             out_bytes = 8.0 * len(res.dest_uids)
@@ -745,7 +849,9 @@ class Node:
         # replays of one shape across variable bindings
         # _cost_endpoint="live" tags standing-subscription re-evals so
         # /debug/top?endpoint=live ranks them next to foreground shapes
-        lg = costs.CostLedger(endpoint=_cost_endpoint, shape=q) \
+        tenant = tnc.current()
+        lg = costs.CostLedger(endpoint=_cost_endpoint, shape=q,
+                              tenant=tenant) \
             if self.cost_ledger else None
         if lg is not None and _cost_subs:
             # per-subscription attribution (ISSUE 19): the live manager
@@ -755,6 +861,7 @@ class Node:
             lg.subs = tuple(_cost_subs)
         try:
           with sp, self._deadline_scope(timeout_ms), costs.scope(lg):
+            self._admit_tenant(tenant)
             req = self._parse(q, variables)
             tr.printf("parsed: %d query blocks", len(req.queries))
             if req.upsert is not None:
@@ -771,6 +878,12 @@ class Node:
                 read_ts, snap = start_ts, self.snapshot(start_ts)
             else:
                 read_ts, snap = self._read_view(start_ts)
+            if tenant:
+                # namespace seam: the executor, planner, caches, and
+                # batcher all run on the tenant's unprefixed vocabulary
+                # while reading only the tenant's storage tablets
+                snap = self._ns_view(snap, tenant)
+            schema = self._schema_view()
             sp.set(read_ts=int(read_ts))
             tr.printf("snapshot at ts %d (%d preds)", read_ts, len(snap.preds))
             pf_attrs = None
@@ -801,7 +914,7 @@ class Node:
             rkey = None
             if self.result_cache is not None and not req.mutations \
                     and not explain:
-                pk = qcache.plan_key(q, variables)
+                pk = qcache.plan_key(q, variables, tenant)
                 if pk is not None:
                     # the EFFECTIVE budget is part of the key: a shrunk
                     # budget (per-request or via set_query_edge_limit) must
@@ -827,13 +940,13 @@ class Node:
                 from dgraph_tpu.query import planner as plmod
 
                 def build():
-                    return plmod.build_plan(req, snap, self.store.schema,
+                    return plmod.build_plan(req, snap, schema,
                                             metrics=self.metrics,
                                             top_k=self.stats_top_k,
                                             trace=tr)
                 try:
                     plan = (self.plan_cache.plan(q, variables, req, snap,
-                                                 build)
+                                                 build, ns=tenant)
                             if self.plan_cache is not None else build())
                 except Exception:
                     # stats/planner trouble must never fail a query —
@@ -856,7 +969,7 @@ class Node:
                 # step — exactly the pre-lazy call site, so cache hits
                 # never paid for it
                 self.residency.prefetch(pf_attrs, snap)
-            out = Executor(snap, self.store.schema,
+            out = Executor(snap, schema,
                            cache=self.task_cache, gate=self.dispatch_gate,
                            edge_limit=edge_limit, plan=plan,
                            explain=recorder,
@@ -922,6 +1035,17 @@ class Node:
         lg.finish()
         rec = lg.to_dict()
         total = rec["total"]
+        # per-tenant attribution + quota debit (ISSUE 20): every admitted
+        # record's ledger units debit its tenant's buckets and advance
+        # the dgraph_tenant_* labeled series. Cache hits are trivial
+        # records (skipped above): they consumed no device resources, so
+        # they cost nothing — admission still gated them.
+        if lg.tenant or self.tenancy.configured:
+            self.tenancy.debit(
+                lg.tenant,
+                device_ms=float(total["device_ms"]),
+                edges=float(total["edges"]),
+                bytes_=float(total["h2d"] + total["d2h"]))
         tid = sp.trace_id if sp else ""
         ex = tid or None
         m.histogram("dgraph_query_cost_device_ms").observe(
@@ -957,12 +1081,17 @@ class Node:
         m = self.metrics
         m.meter("analytics").mark()
         t0 = time.perf_counter()
+        tenant = tnc.current()
         lg = costs.CostLedger(endpoint="analytics",
-                              shape=f"analytics:{kind}:{pred}") \
+                              shape=f"analytics:{kind}:{pred}",
+                              tenant=tenant) \
             if self.cost_ledger else None
         try:
             with sp, self._deadline_scope(timeout_ms), costs.scope(lg):
+                self._admit_tenant(tenant)
                 read_ts, snap = self._read_view(start_ts)
+                if tenant:
+                    snap = self._ns_view(snap, tenant)
                 sp.set(read_ts=int(read_ts))
                 rev = pred.startswith("~")
                 pd = snap.pred(pred[1:] if rev else pred)
@@ -1014,7 +1143,10 @@ class Node:
                 vars_map: dict = {}
                 if q.strip():
                     _, snap = self._read_view(ctx.start_ts)
-                    ex = Executor(snap, self.store.schema,
+                    tenant = tnc.current()
+                    if tenant:
+                        snap = self._ns_view(snap, tenant)
+                    ex = Executor(snap, self._schema_view(),
                                   cache=self.task_cache,
                                   gate=self.dispatch_gate,
                                   mesh=self.mesh_exec,
@@ -1033,11 +1165,11 @@ class Node:
                     if m.get("set_json") is not None:
                         nq_set += mut.nquads_from_json(
                             m["set_json"], Op.SET,
-                            schema=self.store.schema)
+                            schema=self._schema_view())
                     if m.get("delete_json") is not None:
                         nq_del += mut.nquads_from_json(
                             m["delete_json"], Op.DEL,
-                            schema=self.store.schema)
+                            schema=self._schema_view())
                     if not nq_set and not nq_del:
                         continue   # cond met but every quad's var was empty
                     res = self.mutate_quads(nq_set, nq_del, commit_now=False,
@@ -1057,7 +1189,9 @@ class Node:
     def _schema_json(self, preds: list[str]) -> list[dict]:
         from dgraph_tpu.utils.schema import schema_json
 
-        return schema_json(self.store.schema, preds)
+        # the tenant's schema view lists + strips its own entries, so a
+        # schema{} response never leaks another namespace (or the prefix)
+        return schema_json(self._schema_view(), preds)
 
     # -- Mutate --------------------------------------------------------------
 
@@ -1070,10 +1204,10 @@ class Node:
         nquads_del = rdf.parse(del_nquads) if del_nquads else []
         if set_json is not None:
             nquads_set += mut.nquads_from_json(set_json, Op.SET,
-                                               schema=self.store.schema)
+                                               schema=self._schema_view())
         if delete_json is not None:
             nquads_del += mut.nquads_from_json(delete_json, Op.DEL,
-                                               schema=self.store.schema)
+                                               schema=self._schema_view())
         return self.mutate_quads(nquads_set, nquads_del,
                                  commit_now=commit_now, start_ts=start_ts,
                                  timeout_ms=timeout_ms)
@@ -1088,6 +1222,20 @@ class Node:
         nquads_del = list(nquads_del)
         if not nquads_set and not nquads_del:
             raise mut.MutationError("empty mutation")
+        tenant = tnc.current()
+        if tenant:
+            # namespace seam for writes: the tenant's quads land on its
+            # own storage attrs. "S * *" wildcard deletion reads the
+            # store to learn its footprint — a tenant must not discover
+            # (or delete) predicates outside its namespace, so it gets
+            # the typed error instead.
+            self._admit_tenant(tenant)
+            for nq in nquads_set + nquads_del:
+                if nq.predicate == "*":
+                    raise tnc.NamespaceError(
+                        "wildcard predicate deletion (S * *) is not "
+                        "available inside a tenant namespace")
+                nq.predicate = tnc.prefix(tenant, nq.predicate)
         tr = self.traces.start(
             "mutate", f"{len(nquads_set)} set / {len(nquads_del)} del")
         sp = self._span("mutate", set=len(nquads_set),
@@ -1216,18 +1364,27 @@ class Node:
 
     def _alter_locked(self, schema_text: str, drop_attr: str,
                       drop_all: bool) -> None:
+        tenant = tnc.current()
         with self._lock:
             if drop_all:
-                for attr in set(self.store.predicates()) | \
-                        set(self.store.schema.predicates()):
+                attrs = set(self.store.predicates()) | \
+                    set(self.store.schema.predicates())
+                if tenant:
+                    # a tenant's drop_all empties ITS namespace only; the
+                    # default (admin) namespace keeps the whole-store drop
+                    attrs = {a for a in attrs
+                             if tnc.split(a)[0] == tenant}
+                for attr in attrs:
                     self.store.delete_predicate(attr)
                 self._invalidate_snapshots()
                 return
             if drop_attr:
-                self.store.delete_predicate(drop_attr)
+                self.store.delete_predicate(tnc.prefix(tenant, drop_attr))
                 self._invalidate_snapshots()
                 return
             for e in parse_schema(schema_text):
+                if tenant:
+                    e.predicate = tnc.prefix(tenant, e.predicate)
                 old = self.store.schema.get(e.predicate)
                 self.store.set_schema(e)
                 if idx.needs_reindex(old, e):

@@ -347,6 +347,10 @@ class Zero:
         # bound). Owners never appear as their own holders.
         self._replicas: dict[str, dict[int, int]] = {}
         self._moving: set[str] = set()     # tablets mid-move: writes blocked
+        # multi-tenant QoS (ISSUE 20): the serving node installs its
+        # TenantRegistry here so /state exposes the cluster's tenant
+        # table (specs + totals + sheds) next to the tablet map
+        self.tenants = None
         self._tlock = threading.Lock()
         self._dir = dirpath
         self._ts_ceiling = 0
@@ -535,7 +539,7 @@ class Zero:
 
     def state(self) -> dict:
         """Membership dump (reference /state, dgraph/cmd/zero/http.go:130)."""
-        return {
+        out = {
             "maxTxnTs": self.oracle.max_assigned,
             "maxLeaseId": self.uids.max_leased,
             # per-tablet last commit ts: the replica-read floor hedged
@@ -549,3 +553,6 @@ class Zero:
                 a for a, gg in self.tablets().items() if gg == g)}
                 for g in range(self.n_groups)},
         }
+        if self.tenants is not None and self.tenants.configured:
+            out["tenants"] = self.tenants.table()
+        return out

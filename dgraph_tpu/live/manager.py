@@ -45,6 +45,7 @@ import time
 from collections import deque
 
 from ..utils import locks
+from ..utils.deadline import ResourceExhausted
 from ..utils.errors import FailedPrecondition
 from .diff import canon, result_diff
 
@@ -73,11 +74,12 @@ class Subscription:
 
     def __init__(self, mgr: "LiveManager", sid: str, q: str,
                  variables: dict | None, attrs: frozenset | None,
-                 queue_max: int) -> None:
+                 queue_max: int, tenant: str = "") -> None:
         self.id = sid
         self.q = q
         self.variables = dict(variables) if variables else None
         self.attrs = attrs               # None = wake on every window
+        self.tenant = tenant             # registering namespace (ISSUE 20)
         self.queue_max = max(int(queue_max), 1)
         self.queue: deque = deque()
         self._mgr = mgr
@@ -132,11 +134,14 @@ class Subscription:
         return self._mgr.cancel(self.id)
 
     def snapshot(self) -> dict:
-        return {"id": self.id, "attrs": sorted(self.attrs)
-                if self.attrs is not None else None,
-                "cursor": self.cursor, "queued": len(self.queue),
-                "delivered": self.delivered, "sheds": self.sheds,
-                "resyncs": self.resyncs, "closed": self.closed}
+        out = {"id": self.id, "attrs": sorted(self.attrs)
+               if self.attrs is not None else None,
+               "cursor": self.cursor, "queued": len(self.queue),
+               "delivered": self.delivered, "sheds": self.sheds,
+               "resyncs": self.resyncs, "closed": self.closed}
+        if self.tenant:
+            out["tenant"] = self.tenant
+        return out
 
 
 class LiveManager:
@@ -174,6 +179,11 @@ class LiveManager:
         self.idle_timeout_s = float(idle_timeout_s)
         self.heartbeat_s = float(heartbeat_s)
         self._batcher = batcher
+        # multi-tenant QoS (ISSUE 20): Node injects its TenantRegistry so
+        # subscribe() can enforce per-tenant standing-subscription caps
+        # (typed ResourceExhausted at the edge) and clamp notify-queue
+        # bounds. None (the default, and --no_qos) = uncapped.
+        self.registry = None
         self._eval_workers = max(int(eval_workers), 1)
         self._lock = locks.Lock("live.LiveManager._lock")
         self._cv = threading.Condition(self._lock)
@@ -248,13 +258,35 @@ class LiveManager:
         from ..query import qcache
 
         attrs = qcache.subscription_attrs(req)
+        from .. import tenancy
+
+        tenant = tenancy.current()
+        if tenant and attrs is not None:
+            # the touch test compares against COMMITTED storage attrs,
+            # which carry the namespace prefix — translate the read set
+            # once at registration, not per window
+            attrs = tenancy.prefix_attrs(tenant, attrs)
+        reg = self.registry
+        qmax = queue_max or self.queue_max
+        if reg is not None:
+            cap_q = reg.sub_queue_max(tenant)
+            if cap_q is not None:
+                qmax = min(qmax, max(int(cap_q), 1))
         with self._cv:
             if self._closed:
                 raise FailedPrecondition("live manager is closed")
+            cap = reg.max_subs(tenant) if reg is not None else None
+            if cap is not None and sum(
+                    1 for s in self._subs.values()
+                    if s.tenant == tenant) >= cap:
+                reg.note_shed(tenant)
+                raise ResourceExhausted(
+                    f"tenant {tenant or 'default'!r} at max standing "
+                    f"subscriptions ({cap})")
             sid = f"s{self._seq}"
             self._seq += 1
             sub = Subscription(self, sid, q, variables, attrs,
-                               queue_max or self.queue_max)
+                               qmax, tenant)
             self._subs[sid] = sub
             if attrs is None:
                 self._wildcard.add(sid)
@@ -461,7 +493,10 @@ class LiveManager:
             return None
         groups: dict[tuple, tuple] = {}
         for sub in ready:
-            key = (sub.q, canon(sub.variables or {}))
+            # the tenant is part of the coalescing identity: two tenants'
+            # byte-identical DQL reads DIFFERENT tablets, so sharing one
+            # re-evaluation would leak namespace A's result into B
+            key = (sub.q, canon(sub.variables or {}), sub.tenant)
             if key in groups:
                 groups[key][1].append(sub)
             else:
@@ -493,10 +528,15 @@ class LiveManager:
             if hint is not None:
                 hint()
 
-        def run_one(q, variables, sub_ids):
+        def run_one(q, variables, sub_ids, tenant):
+            from .. import tenancy
+
             try:
-                return (True,
-                        canon(self._eval_at(q, variables, w, sub_ids)))
+                # the notifier thread carries no request context: install
+                # the group's tenant so the engine resolves its namespace
+                with tenancy.scope(tenant):
+                    return (True, canon(
+                        self._eval_at(q, variables, w, sub_ids)))
             except Exception as e:       # retried with backoff, then resync
                 return (False, f"{type(e).__name__}: {e}")
 
@@ -506,14 +546,14 @@ class LiveManager:
             # dgraph: allow(ctxvar-copy) re-evals mint their own ledgers/
             # deadlines; nothing context-bound crosses into the pool
             futs = {key: pool.submit(run_one, key[0], variables,
-                                     tuple(s.id for s in subs))
+                                     tuple(s.id for s in subs), key[2])
                     for key, (variables, subs) in items}
             for key, fut in futs.items():
                 results[key] = fut.result()
         else:
             for key, (variables, subs) in items:
                 results[key] = run_one(key[0], variables,
-                                       tuple(s.id for s in subs))
+                                       tuple(s.id for s in subs), key[2])
         now_p = time.perf_counter()
         latency_s = max(now_p - t_first, 0.0)
         with self._cv:
@@ -657,7 +697,7 @@ class LiveManager:
 
     def stats(self) -> dict:
         with self._cv:
-            return {
+            out = {
                 "active": len(self._subs),
                 "registered": self.registered,
                 "windows": self.windows,
@@ -667,6 +707,13 @@ class LiveManager:
                 "pinned_cursor": self._last_pin,
                 "pending": len(self._dirty),
             }
+            by_tenant: dict[str, int] = {}
+            for s in self._subs.values():
+                if s.tenant:
+                    by_tenant[s.tenant] = by_tenant.get(s.tenant, 0) + 1
+            if by_tenant:
+                out["tenants"] = by_tenant
+            return out
 
     def close(self) -> None:
         with self._cv:

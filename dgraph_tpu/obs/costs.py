@@ -99,16 +99,18 @@ class CostLedger:
     (contextvars are copied into the hedge pool; batch runners hold
     explicit references captured at submit time)."""
 
-    __slots__ = ("_lock", "endpoint", "shape", "t0", "wall_ms",
+    __slots__ = ("_lock", "endpoint", "shape", "tenant", "t0", "wall_ms",
                  "device_ms", "h2d_bytes", "d2h_bytes", "upload_bytes",
                  "edges", "rows", "tasks", "gate_wait_ms", "compile_ms",
                  "subs", "outcomes", "per_pred", "kernels", "groups",
                  "_attrs", "_kernel_depth")
 
-    def __init__(self, endpoint: str = "", shape: str = "") -> None:
+    def __init__(self, endpoint: str = "", shape: str = "",
+                 tenant: str = "") -> None:
         self._lock = threading.Lock()
         self.endpoint = endpoint
         self.shape = shape
+        self.tenant = tenant          # requesting namespace ("" = default)
         self.t0 = time.perf_counter()
         self.wall_ms = 0.0
         self.device_ms = 0.0          # device-kernel wall ms (fenced sites)
@@ -368,6 +370,8 @@ class CostLedger:
         total["kern"] = kern
         out2 = {"endpoint": self.endpoint, "shape": self.shape,
                 "total": total, "local": local, "groups": groups}
+        if self.tenant:
+            out2["tenant"] = self.tenant
         if self.subs:
             out2["subs"] = list(self.subs)
         return out2
@@ -628,7 +632,13 @@ class CostBook:
                     a["tasks"] += row[3]
                     a["records"] += 1
                 continue
-            gkey = ep if group == "endpoint" else shape
+            if group == "tenant":
+                # /debug/top?group=tenant — per-namespace attribution
+                # (ISSUE 20): every record is stamped with its minting
+                # tenant; unstamped records are the default namespace
+                gkey = rec.get("tenant") or "default"
+            else:
+                gkey = ep if group == "endpoint" else shape
             a = agg.setdefault(gkey, {
                 "device_ms": 0.0, "wall_ms": 0.0, "compile_ms": 0.0,
                 "edges": 0, "bytes": 0, "records": 0, "trace_id": ""})

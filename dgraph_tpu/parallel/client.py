@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import time
 
+from .. import tenancy as tnc
 from ..coord.zero import TxnConflict
 from ..coord.zero_service import ZeroClient
 from ..obs import costs, otrace
@@ -214,6 +215,17 @@ class ClusterClient:
         (CommitAmbiguous: re-running the txn could apply it twice)."""
         nq_set = rdf.parse(set_nquads) if set_nquads else []
         nq_del = rdf.parse(del_nquads) if del_nquads else []
+        tenant = tnc.current()
+        if tenant:
+            # tenant-scoped writes (ISSUE 20): predicates become the
+            # tenant's storage attrs before any edge leaves this client,
+            # so grouping, conflict keys, and journal rows are all scoped
+            for nq in nq_set + nq_del:
+                if nq.predicate == "*":
+                    raise tnc.NamespaceError(
+                        "wildcard predicate deletion (S * *) is not "
+                        "available inside a tenant namespace")
+                nq.predicate = tnc.prefix(tenant, nq.predicate)
         with self._scope(timeout_ms), \
                 self.tracer.root("mutate",
                                  attrs={"set": len(nq_set),
@@ -327,7 +339,8 @@ class ClusterClient:
         transport = transport_errors()
         qtitle = q.strip().splitlines()[0][:120] if q.strip() else ""
         self.last_degraded = None
-        lg = costs.CostLedger(endpoint="query", shape=q) \
+        lg = costs.CostLedger(endpoint="query", shape=q,
+                              tenant=tnc.current()) \
             if self.cost_ledger else None
         with self._scope(timeout_ms), \
                 self.tracer.root("query", kind="client",
@@ -400,6 +413,12 @@ class ClusterClient:
     def _query_once(self, q: str, variables: dict | None) -> dict:
         parsed = dql.parse(q, variables)
         schema = self.schema()
+        tenant = tnc.current()
+        if tenant:
+            # tenant view (ISSUE 20): the executor plans and validates on
+            # the tenant's unprefixed vocabulary; every task crossing the
+            # wire below carries the storage attr
+            schema = tnc.NamespacedSchema(schema, tenant)
         if parsed.schema_request is not None:
             # schema{} over the cluster: the merged GetSchemaOverNetwork
             # view, same JSON shape as the embedded server
@@ -433,8 +452,18 @@ class ClusterClient:
             tablet_replicas=replica_map, metrics=self.metrics,
             rr_counter=self._replica_rr)
         snap = GraphSnapshot(read_ts)
-        ex = Executor(snap, schema,
-                      dispatch=lambda tq: dispatcher.process_task(tq, read_ts))
+
+        def dispatch(tq):
+            if tenant:
+                # translate at the wire seam: routing (zero tablet map),
+                # the client task cache, and the worker all key on the
+                # tenant's storage attr
+                from dataclasses import replace as _replace
+
+                tq = _replace(tq, attr=tnc.prefix(tenant, tq.attr))
+            return dispatcher.process_task(tq, read_ts)
+
+        ex = Executor(snap, schema, dispatch=dispatch)
         return ex.execute(parsed)
 
     def close(self) -> None:

@@ -25,6 +25,7 @@ try:
 except ImportError:              # pragma: no cover
     grpc = None
 
+from .. import tenancy as tnc
 from ..obs import costs, otrace
 from ..protos import internal_pb2 as ipb
 from ..utils import deadline as dl
@@ -326,17 +327,24 @@ class WorkerService:
         record with per-group sub-records."""
         wire = None
         budget = None
+        tenant = ""
         if context is not None:
             md = context.invocation_metadata() or ()
             for k, v in md:
                 if k == otrace.WIRE_KEY:
                     wire = v
+                elif k == tnc.WIRE_KEY:
+                    # tenant continuation (ISSUE 20): attrs on the wire
+                    # are already storage-prefixed by the querying node;
+                    # the tenant rides along for cost attribution and the
+                    # batcher's tenant-scoped compatibility keys
+                    tenant = v
             budget = dl.from_metadata(md)
-        lg = costs.CostLedger(endpoint="serve_task") \
+        lg = costs.CostLedger(endpoint="serve_task", tenant=tenant) \
             if self.cost_ledger else None
         if not wire:
             try:
-                with dl.scope(budget), costs.scope(lg):
+                with tnc.scope(tenant), dl.scope(budget), costs.scope(lg):
                     return self._serve_task_inner(msg, context)
             finally:
                 self._ship_trailing(context, None, lg)
@@ -344,7 +352,7 @@ class WorkerService:
                               attrs={"attr": msg.attr,
                                      "addr": self.advertise_addr})
         try:
-            with sp, dl.scope(budget), costs.scope(lg):
+            with sp, tnc.scope(tenant), dl.scope(budget), costs.scope(lg):
                 return self._serve_task_inner(msg, context)
         finally:
             self._ship_trailing(context, sp, lg)
@@ -1407,6 +1415,12 @@ class RemoteWorker:
             dl.check(f"rpc:ServeTask {self.addr}")
             md.append(ddl)
             timeout = dl.clamp(None)
+        tenant = tnc.current()
+        if tenant:
+            # tenant continuation (ISSUE 20): same sidecar channel as the
+            # deadline and trace context — the worker scopes its ledger
+            # and batcher keys by it (attrs are already storage-prefixed)
+            md.append((tnc.WIRE_KEY, tenant))
         sp = otrace.current()
         lg = costs.current()
         if sp is None and lg is None:

@@ -110,8 +110,21 @@ def classify(snap, schema, q):
     if q.frontier is None:
         if fname != "similar_to":
             return None, "root_func", None
-        return _classify_vector(snap, schema, q)
-    return _classify_expand(snap, schema, q)
+        key, kind, work = _classify_vector(snap, schema, q)
+    else:
+        key, kind, work = _classify_expand(snap, schema, q)
+    if key is not None:
+        from dgraph_tpu import tenancy
+
+        # tenants never share CSR/index objects (namespace views keep
+        # PredData identity per storage tablet), so id() in the key
+        # already separates them — the explicit tenant component makes
+        # the isolation structural rather than incidental, and keys the
+        # batch-window metrics per namespace
+        t = tenancy.current()
+        if t:
+            key = key + (t,)
+    return key, kind, work
 
 
 def _classify_expand(snap, schema, q):

@@ -410,6 +410,16 @@ class DispatchGate:
         # mesh program) passes, so the timeline sees each exactly once.
         # None (--no_devprof) costs a single attribute load per dispatch.
         self.profiler = None
+        # weighted-fair tenant scheduling (ISSUE 20, tenancy/sched.py):
+        # the node arms `fair` (a FairScheduler) + `tenant_fn` (the
+        # tenancy contextvar reader) when QoS is on. Contended
+        # acquisitions then admit lowest-virtual-time tenant first, and
+        # every measured dispatch charges its wall-ms to the submitting
+        # tenant's clock. None (--no_qos / no tenants) costs one
+        # attribute load on the contended path only — the uncontended
+        # fast acquire above it is untouched.
+        self.fair = None
+        self.tenant_fn = None
         self._step_ewma = 0.0              # expected device-step seconds
         # per-kernel-class EWMAs (ISSUE 9): one global estimate spans ~1ms
         # host-cutover expands and ~100ms mesh/vector steps, making shed
@@ -439,6 +449,48 @@ class DispatchGate:
     def _acquire(self, klass: str | None = None) -> None:
         """Budget-aware semaphore acquisition. Raises typed errors instead
         of waiting past the caller's deadline."""
+        fair = self.fair
+        if fair is not None and self.tenant_fn is not None:
+            # tenant-fair admission SUBSUMES the non-blocking fast path:
+            # a hot thread re-grabbing the slot it just released barges
+            # past waiters parked inside the semaphore (they are invisible
+            # to any queue), and under saturation that hands one tenant
+            # the whole device. Armed gates therefore always contend in
+            # virtual-time order (sched.py), with the cheap typed sheds
+            # still applied up front for budgeted callers.
+            rem = dl.remaining()
+            if rem is not None:
+                if rem <= 0:
+                    raise DeadlineExceeded(
+                        "dispatch gate: budget exhausted")
+                est = self.expected_step(klass)
+                if est and rem < est:
+                    self._shed.inc()
+                    otrace.event("shed", where="dispatch_gate",
+                                 klass=klass or "",
+                                 remaining_ms=round(rem * 1000, 1),
+                                 expected_step_ms=round(est * 1000, 1))
+                    costs.note("shed")
+                    raise ResourceExhausted(
+                        f"shed: remaining budget {rem * 1000:.0f}ms < "
+                        f"expected {klass or 'device'} step "
+                        f"{est * 1000:.0f}ms")
+                if fair.depth() >= self.max_queue:
+                    self._shed.inc()
+                    otrace.event("shed", where="dispatch_gate",
+                                 queue=fair.depth())
+                    costs.note("shed")
+                    raise ResourceExhausted(
+                        f"shed: tenant fair queue full "
+                        f"({self.max_queue} waiting)")
+            t0 = time.perf_counter()
+            # deadline-safe: acquire() parks in dl.clamp(0.05) slices and
+            # raises a typed DeadlineExceeded once the budget expires, so
+            # a budgeted request can never hang in the fair queue
+            if fair.acquire(self.tenant_fn(), self._sem):
+                self._waits.inc()
+                costs.add_gate_wait((time.perf_counter() - t0) * 1e3)
+            return
         if self._sem.acquire(blocking=False):
             return
         self._waits.inc()
@@ -532,6 +584,11 @@ class DispatchGate:
                     (1 - self._EWMA_ALPHA) * cur + self._EWMA_ALPHA * dt)
             self._inflight.dec()
             self._sem.release()
+            fair = self.fair
+            if fair is not None and self.tenant_fn is not None:
+                # the measured dispatch is the deficit signal: charge its
+                # wall-ms / weight to the submitting tenant's clock
+                fair.charge(self.tenant_fn(), dt * 1e3)
             if prof is not None:
                 # timeline record: queue-entry (run() start) -> launch
                 # (slot acquired) -> fence (fn returned/raised). Bytes
@@ -549,19 +606,26 @@ class DispatchGate:
 # parsed-plan cache
 # ---------------------------------------------------------------------------
 
-def plan_key(q: str, variables: dict | None):
-    """(DQL text, variables signature) — None when the variables are not
-    canonicalizable (never the case for the JSON-shaped GraphQL vars the
-    HTTP surface accepts)."""
+def plan_key(q: str, variables: dict | None, ns: str = ""):
+    """(DQL text, variables signature[, namespace]) — None when the
+    variables are not canonicalizable (never the case for the JSON-shaped
+    GraphQL vars the HTTP surface accepts).
+
+    ns is the caller's tenant namespace (ISSUE 20): two tenants issuing
+    byte-identical DQL over same-named predicates read DIFFERENT storage
+    tablets, so every cache keyed on this — plan tier, physical-plan
+    tier, whole-query result tier — must separate them. The default
+    namespace keeps the exact pre-tenancy 2-tuple, so single-tenant
+    deployments key (and hit) byte-identically."""
     if not variables:
-        return (q, None)
+        return (q, None) if not ns else (q, None, ns)
     try:
         sig = tuple(sorted(
             (str(k), json.dumps(v, sort_keys=True, default=str))
             for k, v in variables.items()))
     except Exception:
         return None
-    return (q, sig)
+    return (q, sig) if not ns else (q, sig, ns)
 
 
 class ResultCache(_ByteLRU):
@@ -620,10 +684,13 @@ class PlanCache:
         self._plan_misses = self.metrics.counter(
             "dgraph_planner_cache_misses_total")
 
-    def parse(self, q: str, variables: dict | None = None):
+    def parse(self, q: str, variables: dict | None = None, ns: str = ""):
+        # ns separates tenants' ASTs too: the trees are name-identical
+        # across tenants today, but plans key on AST node object ids —
+        # sharing one AST would let tenant B's plan hit tenant A's tier
         from dgraph_tpu.query import dql
 
-        key = plan_key(q, variables)
+        key = plan_key(q, variables, ns)
         if key is None or self.size <= 0:
             return dql.parse(q, variables)
         with self._lock:
@@ -640,13 +707,14 @@ class PlanCache:
                 self._entries.popitem(last=False)
         return req
 
-    def plan(self, q: str, variables: dict | None, req, snap, build):
+    def plan(self, q: str, variables: dict | None, req, snap, build,
+             ns: str = ""):
         """Optimized-plan tier: serve the cached physical plan for this
         (query shape, stats version), else build one. Plans key on AST
         node object ids, so a hit must also match the cached AST object
         (`plan.req is req`) — an AST-tier eviction re-parse mints new
         node ids and the stale plan is rebuilt."""
-        key = plan_key(q, variables)
+        key = plan_key(q, variables, ns)
         if key is None or self.size <= 0:
             return build()
         pk = (key, result_token(req, snap))

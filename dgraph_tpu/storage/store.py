@@ -804,6 +804,13 @@ class Store:
                     "pinned_floor": self._delta_pin,
                     "base_floor": self._delta_base_floor}
 
+    def delta_log_by_attr(self) -> dict[str, int]:
+        """attr -> journal keys held. The per-tenant accounting input:
+        tenant attrs are distinct storage attrs, so grouping these by
+        namespace prefix attributes journal retention to its tenant."""
+        with self._lock:
+            return {attr: len(v) for attr, v in self._delta_log.items()}
+
     def applied_mark(self, attr: str):
         """The predicate's applied watermark (done_until mirrors
         pred_commit_ts[attr]); created lazily and advanced by every commit

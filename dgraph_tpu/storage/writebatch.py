@@ -54,15 +54,17 @@ from dgraph_tpu.utils.retry import CommitAmbiguous
 
 
 class _Entry:
-    __slots__ = ("start_ts", "keys", "solo", "dl", "lg", "event",
-                 "result", "error", "batch_size")
+    __slots__ = ("start_ts", "keys", "solo", "dl", "lg", "tenant",
+                 "event", "result", "error", "batch_size")
 
-    def __init__(self, start_ts: int, keys, solo: Callable) -> None:
+    def __init__(self, start_ts: int, keys, solo: Callable,
+                 tenant: str = "") -> None:
         self.start_ts = start_ts
         self.keys = keys
         self.solo = solo          # zero-arg exact per-commit path
         self.dl = dl.current()    # the committing caller's deadline
         self.lg = costs.current()  # ... and cost ledger (apportioned)
+        self.tenant = tenant      # committing namespace (slot caps)
         self.event = threading.Event()
         self.result: Any = None   # commit_ts on success
         self.error: BaseException | None = None
@@ -122,6 +124,17 @@ class WriteBatcher:
             "dgraph_write_batch_deadline_bypass_total")
         self._conflicts = m.counter(
             "dgraph_write_batch_conflict_aborts_total")
+        self._tenant_solo = m.counter(
+            "dgraph_write_batch_tenant_solo_total")
+        # multi-tenant QoS (dgraph_tpu/tenancy/; ISSUE 20): when armed,
+        # Node injects tenant_fn (tenancy.current) and tenant_cap_fn
+        # (tenant -> max window slots, None = uncapped). An over-cap
+        # tenant's commit runs the exact solo per-commit path — still
+        # correct, still durable, but it pays its OWN fsync instead of
+        # crowding lighter tenants out of the shared window. Disarmed
+        # (--no_qos / unconfigured): both stay None, zero overhead.
+        self.tenant_fn = None
+        self.tenant_cap_fn = None
 
     def _busy(self) -> bool:
         return self._own_inflight > 0
@@ -150,19 +163,37 @@ class WriteBatcher:
         one."""
         if self._deadline_bypasses():
             return solo()
-        entry = _Entry(start_ts, keys, solo)
+        tenant = self.tenant_fn() if self.tenant_fn is not None else ""
+        cap = self.tenant_cap_fn(tenant) \
+            if self.tenant_cap_fn is not None else None
+        entry = _Entry(start_ts, keys, solo, tenant)
+        over_cap = False
         with self._lock:
             b = self._open
             if b is not None and not b.closed and \
                     len(b.entries) < self.max_batch:
-                b.entries.append(entry)
-                if len(b.entries) >= self.max_batch:
-                    b.full.set()
-                leader = False
+                if cap is not None and sum(
+                        1 for en in b.entries
+                        if en.tenant == tenant) >= cap:
+                    # this tenant already holds its share of the window:
+                    # commit solo (own fsync) rather than crowding the
+                    # group — leading a FRESH window stays allowed, so a
+                    # lone heavy writer on an idle node still batches
+                    over_cap = True
+                else:
+                    b.entries.append(entry)
+                    if len(b.entries) >= self.max_batch:
+                        b.full.set()
+                    leader = False
             else:
                 b = _Batch(entry)
                 self._open = b
                 leader = True
+        if over_cap:
+            self._tenant_solo.inc()
+            costs.note("write_batch_tenant_cap")
+            otrace.event("write_batch_tenant_cap", tenant=tenant)
+            return solo()
         if not leader:
             rem = dl.remaining()
             wait_s = _FOLLOWER_WAIT_S if rem is None else \

@@ -350,6 +350,9 @@ class Registry:
                      "dgraph_write_batch_window_waits_total",
                      "dgraph_write_batch_deadline_bypass_total",
                      "dgraph_write_batch_conflict_aborts_total",
+                     # per-tenant window slot cap (ISSUE 20): commits a
+                     # window-hogging tenant ran solo instead of batching
+                     "dgraph_write_batch_tenant_solo_total",
                      # mesh deployment mode (parallel/mesh_exec.py;
                      # ISSUES 6 + 12)
                      "dgraph_mesh_dispatches_total",
@@ -447,6 +450,19 @@ class Registry:
             labels=("tier",))
         self.keyed_gauges["dgraph_devprof_hbm_highwater_bytes"] = \
             KeyedGauge(labels=("tier",))
+        # multi-tenant QoS (dgraph_tpu/tenancy/; ISSUE 20): per-tenant
+        # cost attribution in cost-ledger units plus the shed counter —
+        # labeled series so one Grafana row ranks tenants. Values are
+        # integer floors of the registry's float accumulators (KeyedGauge
+        # is integer; TenantRegistry keeps the exact floats).
+        self.keyed_gauges["dgraph_tenant_device_ms_total"] = KeyedGauge(
+            labels=("tenant",))
+        self.keyed_gauges["dgraph_tenant_edges_total"] = KeyedGauge(
+            labels=("tenant",))
+        self.keyed_gauges["dgraph_tenant_bytes_total"] = KeyedGauge(
+            labels=("tenant",))
+        self.keyed_gauges["dgraph_tenant_shed_total"] = KeyedGauge(
+            labels=("tenant",))
         for name in ("dgraph_query_latency_s", "dgraph_mutation_latency_s",
                      "dgraph_commit_latency_s", "dgraph_compaction_s",
                      "dgraph_planner_est_error_log2",

@@ -358,3 +358,52 @@ def test_debug_metrics_http_surface():
     finally:
         srv.shutdown()
         node.close()
+
+
+# ---------------------------------------------------------------------------
+# namespace isolation (ISSUE 20): colliding DQL across tenants
+# ---------------------------------------------------------------------------
+
+def test_colliding_dql_across_tenants_never_cross_hits():
+    """Two tenants issue the byte-identical query against predicates with
+    the same bare names but different data: every cache tier (plan, task,
+    result) must keep them apart, and repeats must still HIT within each
+    tenant."""
+    from dgraph_tpu import tenancy as tnc
+
+    node = Node()
+    q = '{ q(func: has(name)) { name } }'
+    for tenant, tag in (("acme", "a"), ("beta", "b")):
+        with tnc.scope(tenant):
+            node.alter(schema_text="name: string @index(exact) .")
+            node.mutate(set_nquads="\n".join(
+                f'<0x{i:x}> <name> "{tag}{i}" .' for i in (1, 2)),
+                commit_now=True)
+    try:
+        with tnc.scope("acme"):
+            a1, _ = node.query(q)
+        with tnc.scope("beta"):
+            b1, _ = node.query(q)          # same DQL, other namespace
+        assert {r["name"] for r in a1["q"]} == {"a1", "a2"}
+        assert {r["name"] for r in b1["q"]} == {"b1", "b2"}
+        hits0 = node.metrics.counter("dgraph_result_cache_hits_total").value
+        with tnc.scope("acme"):
+            a2, _ = node.query(q)          # replay: must hit acme's entry
+        with tnc.scope("beta"):
+            b2, _ = node.query(q)
+        assert a2 == a1 and b2 == b1
+        assert node.metrics.counter(
+            "dgraph_result_cache_hits_total").value >= hits0 + 2
+    finally:
+        node.close()
+
+
+def test_plan_cache_keys_include_namespace():
+    reg = Registry()
+    pc = qcache.PlanCache(8, reg)
+    q = "{ q(func: has(name)) { name } }"
+    r0 = pc.parse(q, None)
+    ra = pc.parse(q, None, ns="acme")
+    rb = pc.parse(q, None, ns="beta")
+    assert r0 is not ra and ra is not rb   # namespaces never share ASTs
+    assert pc.parse(q, None, ns="acme") is ra   # ...but replays hit

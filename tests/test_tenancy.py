@@ -1,0 +1,588 @@
+"""Multi-tenant QoS (ISSUE 20): namespaces, cost-metered quotas, and
+weighted-fair device scheduling.
+
+The correctness contract under test: every request resolves predicates
+inside its caller's namespace (tenant attrs are DISTINCT storage attrs),
+cross-namespace access is a typed NamespaceError, over-quota tenants shed
+typed ResourceExhausted at the API edge, and the default namespace with
+QoS disarmed behaves byte-identically to the pre-tenancy server.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from dgraph_tpu import tenancy as tnc
+from dgraph_tpu.api.server import Node
+from dgraph_tpu.tenancy.namespace import owns
+from dgraph_tpu.tenancy.quota import TenantRegistry
+from dgraph_tpu.tenancy.sched import FairScheduler
+from dgraph_tpu.utils.deadline import ResourceExhausted
+from dgraph_tpu.utils.metrics import Registry
+
+
+# ---------------------------------------------------------------------------
+# name translation primitives
+# ---------------------------------------------------------------------------
+
+def test_prefix_strip_roundtrip():
+    assert tnc.prefix("t1", "name") == "t1/name"
+    assert tnc.strip("t1", "t1/name") == "name"
+    assert tnc.prefix("", "name") == "name"          # default: no wrapper
+    assert tnc.strip("", "name") == "name"
+    # the reverse marker stays OUTSIDE the namespace prefix
+    assert tnc.prefix("t1", "~friend") == "~t1/friend"
+    assert tnc.strip("t1", "~t1/friend") == "~friend"
+    # '*' (wildcard / expand-all token) passes through untranslated
+    assert tnc.prefix("t1", "*") == "*"
+
+
+def test_split_and_owns():
+    assert tnc.split("t1/name") == ("t1", "name")
+    assert tnc.split("name") == ("", "name")
+    assert tnc.split("~t1/friend") == ("t1", "~friend")
+    assert owns("t1", "t1/name")
+    assert not owns("t1", "t2/name")
+    assert owns("", "name") and not owns("", "t1/name")
+
+
+def test_cross_namespace_reference_is_typed():
+    with pytest.raises(tnc.NamespaceError):
+        tnc.prefix("t1", "t2/name")
+
+
+def test_tenant_name_validation():
+    assert tnc.validate("") == ""
+    assert tnc.validate("acme-1.prod") == "acme-1.prod"
+    for bad in ("a/b", "~x", " lead", "-lead", "x" * 65):
+        with pytest.raises(tnc.NamespaceError):
+            tnc.validate(bad)
+
+
+def test_scope_contextvar():
+    assert tnc.current() == ""
+    with tnc.scope("t1"):
+        assert tnc.current() == "t1"
+        with tnc.scope(""):
+            assert tnc.current() == ""
+        assert tnc.current() == "t1"
+    assert tnc.current() == ""
+
+
+# ---------------------------------------------------------------------------
+# namespace isolation end to end
+# ---------------------------------------------------------------------------
+
+def _node(**kw):
+    return Node(**kw)
+
+
+def _seed(node, tenant, tag, n=3):
+    with tnc.scope(tenant):
+        node.alter(schema_text="name: string @index(exact) .\n"
+                               "friend: [uid] .")
+        node.mutate(set_nquads="\n".join(
+            [f'<0x{i:x}> <name> "{tag}{i}" .' for i in range(1, n + 1)] +
+            [f'<0x1> <friend> <0x{i:x}> .' for i in range(2, n + 1)]),
+            commit_now=True)
+
+
+Q = '{ q(func: has(name)) { name friend { name } } }'
+
+
+def test_tenants_see_only_their_data():
+    node = _node()
+    _seed(node, "", "root")
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        out0, _ = node.query(Q)
+        with tnc.scope("acme"):
+            outa, _ = node.query(Q)
+        with tnc.scope("beta"):
+            outb, _ = node.query(Q)
+        names = lambda o: {r["name"] for r in o["q"]}
+        assert names(outa) == {"a1", "a2", "a3"}
+        assert names(outb) == {"b1", "b2", "b3"}
+        assert names(out0) == {"root1", "root2", "root3"}
+    finally:
+        node.close()
+
+
+def test_tenant_storage_attrs_are_prefixed():
+    node = _node()
+    _seed(node, "acme", "a")
+    try:
+        preds = node.store.predicates()
+        assert "acme/name" in preds and "acme/friend" in preds
+        assert "name" not in preds          # nothing leaked to default
+    finally:
+        node.close()
+
+
+def test_cross_namespace_mutate_and_alter_are_typed():
+    node = _node()
+    try:
+        with tnc.scope("acme"):
+            with pytest.raises(tnc.NamespaceError):
+                node.mutate(set_nquads='_:a <beta/name> "steal" .',
+                            commit_now=True)
+            with pytest.raises(tnc.NamespaceError):
+                node.alter(schema_text="beta/name: string .")
+    finally:
+        node.close()
+
+
+def test_wildcard_delete_rejected_in_tenant_namespace():
+    node = _node()
+    _seed(node, "acme", "a")
+    try:
+        with tnc.scope("acme"), pytest.raises(tnc.NamespaceError):
+            node.mutate(del_nquads="<0x1> * * .", commit_now=True)
+        # the default (admin) namespace keeps full wildcard power
+        node.mutate(del_nquads="<0x1> * * .", commit_now=True)
+    finally:
+        node.close()
+
+
+def test_schema_view_strips_prefix():
+    node = _node()
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        with tnc.scope("acme"):
+            out, _ = node.query("schema {}")
+        preds = {e["predicate"] for e in out["schema"]}
+        assert preds == {"name", "friend"}
+        # default namespace (admin) sees every storage attr
+        out0, _ = node.query("schema {}")
+        preds0 = {e["predicate"] for e in out0["schema"]}
+        assert {"acme/name", "beta/name"} <= preds0
+    finally:
+        node.close()
+
+
+def test_expand_all_stays_in_namespace():
+    node = _node()
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        with tnc.scope("acme"):
+            out, _ = node.query(
+                '{ q(func: has(name)) { expand(_all_) } }')
+        blob = json.dumps(out)
+        assert "beta" not in blob and "/" not in blob.replace("\\/", "")
+    finally:
+        node.close()
+
+
+def test_tenant_drop_all_scoped_to_namespace():
+    node = _node()
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        with tnc.scope("acme"):
+            node.alter(drop_all=True)
+        preds = node.store.predicates()
+        assert not any(a.startswith("acme/") for a in preds)
+        assert "beta/name" in preds         # the neighbor survived
+    finally:
+        node.close()
+
+
+def test_tenant_drop_attr_scoped():
+    node = _node()
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        with tnc.scope("acme"):
+            node.alter(drop_attr="name")
+        preds = node.store.predicates()
+        assert "acme/name" not in preds and "beta/name" in preds
+    finally:
+        node.close()
+
+
+def test_default_namespace_unwrapped():
+    """The single-tenant fast path: no scope installed means raw
+    snapshot/schema objects — no view wrappers anywhere."""
+    node = _node(qos=False)
+    _seed(node, "", "root")
+    try:
+        snap = node._read_view(None)[1] if False else None
+        out, _ = node.query(Q)
+        assert {r["name"] for r in out["q"]} == {"root1", "root2", "root3"}
+        assert node.dispatch_gate.fair is None
+        assert node.write_batcher is None or \
+            node.write_batcher.tenant_fn is None
+        assert node.live.registry is None
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# quotas: token buckets in cost-ledger units
+# ---------------------------------------------------------------------------
+
+def test_quota_debt_sheds_typed_then_refills():
+    reg = TenantRegistry(Registry())
+    reg.configure({"tenants": {"t": {"device_ms_per_s": 50.0,
+                                     "burst_s": 0.2}}})
+    reg.admit("t")                       # fresh bucket: admitted
+    reg.debit("t", device_ms=1e6)        # way over: deep debt (floored)
+    with pytest.raises(ResourceExhausted):
+        reg.admit("t")
+    # debt is floored at one burst window (10 units here, refilling at
+    # 50/s): out of debt in ~200ms, never an unbounded lockout
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            reg.admit("t")
+            break
+        except ResourceExhausted:
+            time.sleep(0.005)
+    else:
+        pytest.fail("bucket never refilled out of debt")
+
+
+def test_quota_unlimited_units_never_shed():
+    reg = TenantRegistry(Registry())
+    reg.configure({"tenants": {"t": {"weight": 2.0}}})   # no rates
+    reg.debit("t", device_ms=1e9, edges=1e9, bytes_=1e9)
+    reg.admit("t")                       # unlimited: always admitted
+
+
+def test_default_spec_key_applies_to_unknown_tenants():
+    reg = TenantRegistry(Registry())
+    reg.configure({"tenants": {"*": {"edges_per_s": 1.0,
+                                     "burst_s": 60.0}}})
+    reg.debit("anyone", edges=1e6)
+    with pytest.raises(ResourceExhausted):
+        reg.admit("anyone")
+
+
+def test_shed_books_metrics():
+    m = Registry()
+    reg = TenantRegistry(m)
+    reg.configure({"tenants": {"t": {"device_ms_per_s": 1.0,
+                                     "burst_s": 60.0}}})
+    reg.debit("t", device_ms=1e6)
+    with pytest.raises(ResourceExhausted):
+        reg.admit("t")
+    assert m.counter("dgraph_shed_total").value == 1
+    assert m.keyed("dgraph_tenant_shed_total",
+                   labels=("tenant",)).get("t") == 1
+    assert reg.table()["t"]["sheds"] == 1
+
+
+def test_hot_reload_merges_and_resets_only_reconfigured_buckets():
+    reg = TenantRegistry(Registry())
+    reg.configure({"tenants": {"a": {"device_ms_per_s": 1.0,
+                                     "burst_s": 60.0},
+                               "b": {"device_ms_per_s": 1.0,
+                                     "burst_s": 60.0}}})
+    reg.debit("a", device_ms=1e6)
+    reg.debit("b", device_ms=1e6)
+    # reconfigure only b: a's debt must survive the reload
+    reg.configure({"tenants": {"b": {"device_ms_per_s": 1e9}}})
+    with pytest.raises(ResourceExhausted):
+        reg.admit("a")
+    reg.admit("b")                       # fresh generous bucket
+    # replace=True swaps the whole table
+    reg.configure({"tenants": {"c": {}}}, replace=True)
+    assert set(k for k in reg.table() if reg.table()[k]["spec"]) == {"c"}
+    reg.admit("a")                       # a has no spec anymore
+
+
+def test_window_share_is_weight_proportional():
+    reg = TenantRegistry(Registry())
+    reg.configure({"tenants": {"heavy": {"weight": 3.0},
+                               "light": {"weight": 1.0}}})
+    assert reg.window_share("heavy", 64) == 48
+    assert reg.window_share("light", 64) == 16
+    assert reg.window_share("unknown", 64) >= 1   # floor of one slot
+
+
+def test_unknown_quota_key_rejected():
+    reg = TenantRegistry(Registry())
+    with pytest.raises(ValueError):
+        reg.configure({"tenants": {"t": {"qps": 10}}})
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair scheduling
+# ---------------------------------------------------------------------------
+
+def test_fair_scheduler_vtime_orders_by_charged_share():
+    fs = FairScheduler(weight_fn={"a": 1.0, "b": 4.0}.get)
+    # equal measured work each round; a first-time tenant enters at the
+    # current floor, then advances by wall-ms / weight
+    fs.charge("a", 100.0)               # a = 100/1 = 100
+    fs.charge("b", 100.0)               # b = floor(100) + 100/4 = 125
+    fs.charge("a", 100.0)               # a = 200
+    fs.charge("b", 100.0)               # b = 150
+    snap = fs.snapshot()
+    assert snap["vtime_ms"]["a"] == 200.0
+    assert snap["vtime_ms"]["b"] == 150.0
+    # under sustained equal load the heavier-weighted tenant's clock
+    # falls behind: it goes first when both wait
+    fs._waiting = {"a": 1, "b": 1}
+    assert fs._turn_locked() == "b"
+
+
+def test_fair_scheduler_idle_reentry_at_floor():
+    fs = FairScheduler()
+    fs.charge("busy", 1000.0)
+    # a brand-new tenant enters at the floor (0 here is below busy's
+    # clock) and is admitted immediately — no banked burst, no penalty
+    t0 = time.monotonic()
+    fs.admit("newcomer")
+    assert time.monotonic() - t0 < 0.5
+    assert fs.snapshot()["vtime_ms"].get("newcomer", 0.0) <= 1000.0
+
+
+def test_fair_scheduler_ewma():
+    fs = FairScheduler()
+    fs.charge("t", 10.0)
+    assert fs.ewma_ms("t") == 10.0
+    fs.charge("t", 20.0)
+    assert 10.0 < fs.ewma_ms("t") < 20.0
+
+
+def test_gate_armed_only_with_config_and_qos():
+    node = _node(qos=True)
+    try:
+        assert node.dispatch_gate.fair is None       # unconfigured
+        node.configure_tenants({"tenants": {"a": {"weight": 2.0}}})
+        assert node.dispatch_gate.fair is not None
+        if node.write_batcher is not None:
+            assert node.write_batcher.tenant_fn is not None
+        assert node.live.registry is node.tenancy
+    finally:
+        node.close()
+
+    node = _node(qos=False, tenants={"tenants": {"a": {"weight": 2.0}}})
+    try:
+        # --no_qos: namespaces stay active, scheduling stays disarmed
+        assert node.dispatch_gate.fair is None
+        assert node.tenancy.configured
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# quota admission at the Node edge
+# ---------------------------------------------------------------------------
+
+def test_node_sheds_over_quota_tenant_typed():
+    node = _node(tenants={"tenants": {"acme": {"device_ms_per_s": 1.0,
+                                               "burst_s": 60.0}}})
+    _seed(node, "acme", "a")
+    try:
+        node.tenancy.debit("acme", device_ms=1e6)     # force debt
+        with tnc.scope("acme"), pytest.raises(ResourceExhausted):
+            node.query(Q)
+        # an unconstrained neighbor keeps serving
+        _seed(node, "beta", "b")
+        with tnc.scope("beta"):
+            out, _ = node.query(Q)
+        assert out["q"]
+    finally:
+        node.close()
+
+
+def test_cost_attribution_reaches_ledger_and_top():
+    node = _node(tenants={"tenants": {"acme": {"weight": 2.0}}})
+    _seed(node, "acme", "a")
+    try:
+        with tnc.scope("acme"):
+            node.query(Q)
+        top = node.cost_book.top(group="tenant")
+        keys = {row["key"] for row in top["top"]}
+        assert "acme" in keys
+        assert "acme" in node.tenancy.table()
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# live queries: per-tenant caps + namespace-scoped notification
+# ---------------------------------------------------------------------------
+
+def test_live_subscription_tenant_cap_and_isolation():
+    node = _node(tenants={"tenants": {"acme": {"max_subs": 1}}})
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        with tnc.scope("acme"):
+            sub = node.subscribe('{ q(func: has(name)) { name } }')
+            ev = sub.next(5.0)
+            assert ev is not None and ev["type"] == "init"
+            with pytest.raises(ResourceExhausted):
+                node.subscribe('{ q(func: has(name)) { uid } }')
+        # a commit in ANOTHER namespace must not touch acme's sub
+        _seed(node, "beta", "b2")
+        # a commit in acme's namespace must notify with acme's data
+        with tnc.scope("acme"):
+            node.mutate(set_nquads='<0x9> <name> "a-new" .',
+                        commit_now=True)
+        deadline = time.monotonic() + 10.0
+        diff = None
+        while time.monotonic() < deadline:
+            ev = sub.next(0.25)
+            if ev is not None and ev["type"] == "diff":
+                diff = ev
+                break
+        assert diff is not None, "no diff arrived for the tenant commit"
+        blob = json.dumps(diff)
+        assert "a-new" in blob and "b2" not in blob
+        sub.cancel()
+        stats = node.live.stats()
+        assert stats.get("tenants", {}).get("acme", 0) in (0, 1)
+    finally:
+        node.close()
+
+
+def test_live_same_dql_different_tenants_not_coalesced():
+    node = _node()
+    _seed(node, "acme", "a")
+    _seed(node, "beta", "b")
+    try:
+        q = '{ q(func: has(name)) { name } }'
+        with tnc.scope("acme"):
+            s1 = node.subscribe(q)
+        with tnc.scope("beta"):
+            s2 = node.subscribe(q)
+        e1, e2 = s1.next(5.0), s2.next(5.0)
+        assert "a1" in json.dumps(e1) and "a1" not in json.dumps(e2)
+        assert "b1" in json.dumps(e2)
+        s1.cancel()
+        s2.cancel()
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# write-window tenant slot caps
+# ---------------------------------------------------------------------------
+
+def test_write_batcher_tenant_cap_forces_solo():
+    from dgraph_tpu.storage import writebatch as wb_mod
+
+    class _Oracle:
+        pass
+
+    wb = wb_mod.WriteBatcher(_Oracle(), None, window_ms=50.0,
+                             max_batch=4, idle_fire=False)
+    wb.tenant_fn = lambda: "hog"
+    wb.tenant_cap_fn = lambda t: 1       # one slot per window for anyone
+    import threading
+
+    solos = []
+    results = []
+
+    def submit(i):
+        results.append(wb.submit(
+            100 + i, [b"k%d" % i], lambda i=i: solos.append(i) or i))
+
+    # first submit leads a window (allowed); the second would JOIN the
+    # open window over its 1-slot cap -> exact solo path
+    t1 = threading.Thread(target=submit, args=(0,))
+    t1.start()
+    time.sleep(0.01)                     # let the leader open the window
+    submit(1)
+    t1.join(5.0)
+    assert 1 in solos                    # capped joiner committed solo
+    assert wb.metrics.counter(
+        "dgraph_write_batch_tenant_solo_total").value == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge: header scoping, typed 403, hot reload, metrics surfaces
+# ---------------------------------------------------------------------------
+
+def _http(base, path, data=None, hdrs=None):
+    req = urllib.request.Request(base + path, data=data,
+                                 headers=hdrs or {})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_http_tenant_header_scopes_and_403s():
+    from dgraph_tpu.api.http import serve_forever
+
+    node = _node()
+    srv = serve_forever(node, port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        st, _ = _http(base, "/admin/tenant", json.dumps(
+            {"tenants": {"acme": {"weight": 4.0},
+                         "beta": {"weight": 1.0}}}).encode())
+        assert st == 200
+        st, _ = _http(base, "/mutate?commitNow=true",
+                      b'{ set { _:a <name> "acme-http" . } }',
+                      {"X-Dgraph-Tenant": "acme"})
+        assert st == 200
+        st, body = _http(base, "/query", b'{ q(func: has(name)) { name } }',
+                         {"X-Dgraph-Tenant": "acme"})
+        assert st == 200 and "acme-http" in body
+        st, body = _http(base, "/query", b'{ q(func: has(name)) { name } }',
+                         {"X-Dgraph-Tenant": "beta"})
+        assert st == 200 and "acme-http" not in body
+        # invalid tenant name and cross-namespace predicate: typed 403
+        st, body = _http(base, "/query", b"{ q(func: has(name)) { uid } }",
+                         {"X-Dgraph-Tenant": "no/slash"})
+        assert st == 403 and "ErrorNamespace" in body
+        st, body = _http(base, "/mutate?commitNow=true",
+                         b'{ set { _:a <beta/name> "x" . } }',
+                         {"X-Dgraph-Tenant": "acme"})
+        assert st == 403 and "ErrorNamespace" in body
+        # the serving readout carries the tenancy section
+        st, body = _http(base, "/debug/metrics")
+        m = json.loads(body)
+        assert m["tenancy"]["configured"]
+        assert "acme" in m["tenancy"]["tenants"]
+        assert "acme" in m["tenancy"]["storage"]
+        # /debug/top?group=tenant ranks by tenant
+        st, body = _http(base, "/debug/top?group=tenant")
+        assert st == 200 and json.loads(body)["group"] == "tenant"
+        # empty-body /admin/tenant reads the table back
+        st, body = _http(base, "/admin/tenant", b"")
+        assert st == 200 and "acme" in body
+    finally:
+        srv.shutdown()
+        node.close()
+
+
+def test_http_shed_is_429_and_labeled():
+    from dgraph_tpu.api.http import serve_forever
+
+    node = _node(tenants={"tenants": {"acme": {"edges_per_s": 1.0,
+                                               "burst_s": 60.0}}})
+    _seed(node, "acme", "a")
+    node.tenancy.debit("acme", edges=1e6)
+    srv = serve_forever(node, port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        st, body = _http(base, "/query", b"{ q(func: has(name)) { uid } }",
+                         {"X-Dgraph-Tenant": "acme"})
+        assert st == 429 and "ErrorResourceExhausted" in body
+        st, body = _http(base, "/metrics")
+        assert 'dgraph_tenant_shed_total{tenant="acme"} 1' in body
+    finally:
+        srv.shutdown()
+        node.close()
+
+
+def test_zero_state_carries_tenant_table():
+    node = _node(tenants={"tenants": {"acme": {"weight": 2.0}}})
+    try:
+        st = node.state()
+        assert "tenants" in st and "acme" in st["tenants"]
+    finally:
+        node.close()
